@@ -1,0 +1,76 @@
+"""Two-process demo of the secure transport layer.
+
+Run a listener (responder) and a dialer (initiator) as separate OS processes
+talking over a real TCP socket with the authenticated encrypted channel:
+
+    python examples/secure_echo.py listen 127.0.0.1:9410
+    python examples/secure_echo.py dial   127.0.0.1:9410 [server_pub_hex]
+
+The dialer sends `inference`-keyed messages; the listener streams back three
+`tokenChunk` messages and an `inferenceEnded`, mirroring the shape of the real
+provider hot path.
+"""
+
+import asyncio
+import sys
+
+from symmetry_tpu.identity import Identity
+from symmetry_tpu.network.peer import Peer
+from symmetry_tpu.protocol.keys import MessageKey
+from symmetry_tpu.transport import TcpTransport
+
+
+async def listen(addr: str) -> None:
+    ident = Identity.from_name("echo-server")
+    print(f"server identity: {ident.public_hex}", flush=True)
+    done = asyncio.Event()
+
+    async def handler(conn):
+        peer = await Peer.connect(conn, ident, initiator=False)
+        print(f"peer connected: {peer.remote_public_hex[:16]}… from {peer.remote_address}", flush=True)
+        async for msg in peer:
+            print(f"recv: key={msg.key} data={msg.data}", flush=True)
+            if msg.key == MessageKey.INFERENCE:
+                for tok in ["Hello", ", ", "world"]:
+                    await peer.send(MessageKey.TOKEN_CHUNK, {"token": tok})
+                await peer.send(MessageKey.INFERENCE_ENDED, {"tokens": 3})
+            elif msg.key == MessageKey.LEAVE:
+                done.set()
+                return
+
+    t = TcpTransport()
+    listener = await t.listen(f"tcp://{addr}", handler)
+    print(f"listening on {listener.address}", flush=True)
+    await done.wait()
+    await listener.close()
+    print("server done", flush=True)
+
+
+async def dial(addr: str, expected_hex: str | None) -> None:
+    ident = Identity.from_name("echo-client")
+    expected = bytes.fromhex(expected_hex) if expected_hex else None
+    t = TcpTransport()
+    conn = await t.dial(f"tcp://{addr}")
+    peer = await Peer.connect(conn, ident, initiator=True, expected_remote_key=expected)
+    print(f"connected; authenticated server = {peer.remote_public_hex}", flush=True)
+    await peer.send(MessageKey.INFERENCE, {"messages": [{"role": "user", "content": "hi"}]})
+    completion = ""
+    while True:
+        msg = await peer.recv()
+        if msg is None or msg.key == MessageKey.INFERENCE_ENDED:
+            print(f"ended: {msg.data if msg else None}", flush=True)
+            break
+        if msg.key == MessageKey.TOKEN_CHUNK:
+            completion += msg.data["token"]
+            print(f"chunk: {msg.data['token']!r}", flush=True)
+    print(f"completion: {completion!r}", flush=True)
+    await peer.send(MessageKey.LEAVE)
+    await peer.close()
+
+
+if __name__ == "__main__":
+    mode, addr = sys.argv[1], sys.argv[2]
+    if mode == "listen":
+        asyncio.run(listen(addr))
+    else:
+        asyncio.run(dial(addr, sys.argv[3] if len(sys.argv) > 3 else None))

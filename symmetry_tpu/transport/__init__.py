@@ -1,0 +1,12 @@
+from symmetry_tpu.transport.base import Connection, Listener, Transport
+from symmetry_tpu.transport.memory import MemoryTransport, memory_pair
+from symmetry_tpu.transport.tcp import TcpTransport
+
+__all__ = [
+    "Connection",
+    "Listener",
+    "Transport",
+    "MemoryTransport",
+    "memory_pair",
+    "TcpTransport",
+]

@@ -1,0 +1,13 @@
+"""Test configuration.
+
+Tests never require a real TPU: JAX is pinned to the CPU backend with 8 virtual
+devices so sharding/mesh tests exercise real multi-device compilation paths
+(SURVEY §4 build implication). This must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
