@@ -1,0 +1,189 @@
+"""The Symmetry client: request a provider from the server, stream completions.
+
+The reference's client was refactored out of the repo (the test still imports
+`SymmetryClient` from ../src/client — __test__/cli.test.ts:1 — which no longer
+exists; SURVEY §0.1). This is its re-creation against our wire protocol:
+
+    client = SymmetryClient(identity, transport)
+    details = await client.request_provider(server_addr, server_key, "llama3:8b")
+    async with await client.connect(details) as session:
+        async for delta in session.chat([{"role": "user", "content": "hi"}]):
+            print(delta, end="")
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator
+
+from symmetry_tpu.identity import Identity
+from symmetry_tpu.network.peer import Peer
+from symmetry_tpu.protocol.keys import MessageKey
+from symmetry_tpu.provider.backends.proxy import (
+    get_chat_data_from_provider,
+    safe_parse_stream_response,
+)
+from symmetry_tpu.transport.base import Transport
+from symmetry_tpu.utils.logging import logger
+
+
+class ClientError(RuntimeError):
+    pass
+
+
+@dataclass(slots=True)
+class ProviderDetails:
+    peer_key: str
+    address: str | None
+    model_name: str
+    session_token: dict | None = None
+    session_id: str | None = None
+    data_collection: bool = False
+    provider_dialect: str = "openai"  # chunk format hint for delta extraction
+    raw: dict = field(default_factory=dict)
+
+
+class ProviderSession:
+    """A live connection to one provider."""
+
+    def __init__(self, peer: Peer, details: ProviderDetails) -> None:
+        self._peer = peer
+        self._details = details
+
+    async def __aenter__(self) -> "ProviderSession":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def new_conversation(self) -> None:
+        await self._peer.send(MessageKey.NEW_CONVERSATION)
+
+    async def chat(
+        self,
+        messages: list[dict[str, str]],
+        *,
+        max_tokens: int | None = None,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        seed: int | None = None,
+    ) -> AsyncIterator[str]:
+        """Send one inference request; yield text deltas as they stream."""
+        payload: dict[str, Any] = {"key": "inference", "messages": messages}
+        if self._details.session_token is not None:
+            payload["sessionToken"] = self._details.session_token
+        for k, v in (("max_tokens", max_tokens), ("temperature", temperature),
+                     ("top_p", top_p), ("seed", seed)):
+            if v is not None:
+                payload[k] = v
+        await self._peer.send(MessageKey.INFERENCE, payload)
+        dialect = self._details.provider_dialect
+        while True:
+            msg = await self._peer.recv()
+            if msg is None:
+                raise ClientError("provider closed connection mid-stream")
+            if msg.key == MessageKey.INFERENCE:
+                # stream-start marker; carries the backend dialect
+                dialect = (msg.data or {}).get("provider", dialect)
+            elif msg.key == MessageKey.TOKEN_CHUNK:
+                raw = (msg.data or {}).get("raw", "")
+                parsed = safe_parse_stream_response(raw)
+                if parsed is None:
+                    continue
+                delta = get_chat_data_from_provider(dialect, parsed)
+                if delta:
+                    yield delta
+            elif msg.key == MessageKey.INFERENCE_ENDED:
+                return
+            elif msg.key == MessageKey.INFERENCE_ERROR:
+                raise ClientError((msg.data or {}).get("error", "inference failed"))
+            else:
+                logger.debug(f"client: ignoring key {msg.key!r}")
+
+    async def chat_text(self, messages: list[dict[str, str]], **kw) -> str:
+        return "".join([d async for d in self.chat(messages, **kw)])
+
+    async def close(self) -> None:
+        if not self._peer.closed:
+            try:
+                await self._peer.send(MessageKey.LEAVE)
+            except (ConnectionError, OSError):
+                pass
+        await self._peer.close()
+
+
+class SymmetryClient:
+    def __init__(self, identity: Identity | None = None,
+                 transport: Transport | None = None) -> None:
+        self.identity = identity or Identity.generate()
+        if transport is None:
+            from symmetry_tpu.transport.tcp import TcpTransport
+
+            transport = TcpTransport()
+        self._transport = transport
+
+    async def request_provider(
+        self, server_address: str, server_key: bytes, model_name: str | None = None,
+        timeout: float = 10.0,
+    ) -> ProviderDetails:
+        """Ask the server for a provider assignment (requestProvider →
+        providerDetails, reference keys src/constants.ts:16,14)."""
+        conn = await self._transport.dial(server_address)
+        peer = await Peer.connect(
+            conn, self.identity, initiator=True, expected_remote_key=server_key
+        )
+        try:
+            await peer.send(MessageKey.REQUEST_PROVIDER, {"modelName": model_name})
+            msg = await asyncio.wait_for(peer.recv(), timeout)
+            if msg is None or msg.key != MessageKey.PROVIDER_DETAILS:
+                raise ClientError(f"unexpected server reply: {msg and msg.key}")
+            data = msg.data or {}
+            if "error" in data:
+                raise ClientError(data["error"])
+            prov = data.get("provider") or {}
+            return ProviderDetails(
+                peer_key=prov.get("peerKey", ""),
+                address=prov.get("address"),
+                model_name=prov.get("modelName", model_name or ""),
+                session_token=data.get("sessionToken"),
+                session_id=data.get("sessionId"),
+                data_collection=bool(prov.get("dataCollectionEnabled", False)),
+                raw=data,
+            )
+        finally:
+            await peer.close()
+
+    async def list_models(self, server_address: str, server_key: bytes,
+                          timeout: float = 10.0) -> list[dict]:
+        conn = await self._transport.dial(server_address)
+        peer = await Peer.connect(
+            conn, self.identity, initiator=True, expected_remote_key=server_key
+        )
+        try:
+            await peer.send(MessageKey.PROVIDER_LIST)
+            msg = await asyncio.wait_for(peer.recv(), timeout)
+            return (msg.data or {}).get("models", []) if msg else []
+        finally:
+            await peer.close()
+
+    async def connect(self, details: ProviderDetails) -> ProviderSession:
+        """Dial a provider directly, pinning its key from providerDetails."""
+        if not details.address:
+            raise ClientError("provider has no dialable address")
+        conn = await self._transport.dial(details.address)
+        expected = bytes.fromhex(details.peer_key) if details.peer_key else None
+        peer = await Peer.connect(
+            conn, self.identity, initiator=True, expected_remote_key=expected
+        )
+        return ProviderSession(peer, details)
+
+    async def connect_direct(self, address: str, provider_key: bytes | None = None,
+                             model_name: str = "") -> ProviderSession:
+        """Direct connection to a known (possibly private) provider."""
+        details = ProviderDetails(
+            peer_key=provider_key.hex() if provider_key else "",
+            address=address,
+            model_name=model_name,
+        )
+        return await self.connect(details)

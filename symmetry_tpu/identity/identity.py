@@ -42,9 +42,14 @@ def discovery_key(public_key: bytes) -> bytes:
 
 
 def derive_seed(name: str, secret: bytes = b"") -> bytes:
-    """Deterministic 32-byte seed from a human name (+ optional local secret)."""
+    """Deterministic 32-byte seed from a human name (+ optional local secret).
+
+    The secret enters as the blake2b MAC key, not by concatenation, so
+    ('ab', b'c') and ('a', b'bc') cannot collide.
+    """
     return hashlib.blake2b(
-        name.encode("utf-8") + secret, digest_size=32, person=b"symmetry-seed"
+        name.encode("utf-8"), digest_size=32, key=secret[:64],
+        person=b"symmetry-seed",
     ).digest()
 
 

@@ -23,6 +23,7 @@ from symmetry_tpu.identity import (
     discovery_key,
     server_handshake,
 )
+from symmetry_tpu.protocol.framing import FrameError
 from symmetry_tpu.protocol.messages import Message, create_message, parse_message
 from symmetry_tpu.transport.base import Connection
 from symmetry_tpu.utils.logging import logger
@@ -91,7 +92,12 @@ class Peer:
     async def recv(self) -> Message | None:
         """Next message, or None on EOF. Malformed messages are skipped."""
         while True:
-            frame = await self._conn.recv()
+            try:
+                frame = await self._conn.recv()
+            except (FrameError, ConnectionError, OSError) as exc:
+                logger.warning(f"dropping peer {self.remote_public_hex[:12]}: {exc}")
+                await self.close()
+                return None
             if frame is None:
                 return None
             self.raw_bytes_read += len(frame)

@@ -1,6 +1,6 @@
 from symmetry_tpu.protocol.keys import MessageKey, SERVER_MESSAGE_KEYS
 from symmetry_tpu.protocol.messages import Message, create_message, parse_message
-from symmetry_tpu.protocol.framing import FrameReader, FrameWriter, encode_frame, MAX_FRAME_SIZE
+from symmetry_tpu.protocol.framing import FrameReader, encode_frame, MAX_FRAME_SIZE
 
 __all__ = [
     "MessageKey",
@@ -9,7 +9,6 @@ __all__ = [
     "create_message",
     "parse_message",
     "FrameReader",
-    "FrameWriter",
     "encode_frame",
     "MAX_FRAME_SIZE",
 ]

@@ -18,7 +18,6 @@ the always-available pure-Python path.
 from __future__ import annotations
 
 import struct
-from collections import deque
 from typing import Iterator
 
 MAX_FRAME_SIZE = 32 * 1024 * 1024  # 32 MiB — bounds memory per peer
@@ -48,9 +47,12 @@ class FrameReader:
             if self._need is None:
                 if len(self._buf) < _HEADER.size:
                     return
-                (self._need,) = _HEADER.unpack_from(self._buf)
-                if self._need > MAX_FRAME_SIZE:
-                    raise FrameError(f"frame too large: {self._need}")
+                (need,) = _HEADER.unpack_from(self._buf)
+                if need > MAX_FRAME_SIZE:
+                    # Don't poison state: a caller that keeps feeding after the
+                    # error must not start buffering toward the bogus length.
+                    raise FrameError(f"frame too large: {need}")
+                self._need = need
                 del self._buf[: _HEADER.size]
             if len(self._buf) < self._need:
                 return
@@ -60,18 +62,3 @@ class FrameReader:
             yield payload
 
 
-class FrameWriter:
-    """Buffers encoded frames for transports that pull (e.g. tests)."""
-
-    def __init__(self) -> None:
-        self._out: deque[bytes] = deque()
-
-    def write(self, payload: bytes) -> bytes:
-        frame = encode_frame(payload)
-        self._out.append(frame)
-        return frame
-
-    def drain(self) -> bytes:
-        data = b"".join(self._out)
-        self._out.clear()
-        return data

@@ -1,0 +1,40 @@
+"""Provider CLI: `python -m symmetry_tpu.provider [-c path]`.
+
+Parity with the reference bin (src/symmetry.ts:1-24): `-c/--config` defaults
+to ~/.config/symmetry/provider.yaml; constructs the provider and serves until
+SIGINT, then drains gracefully.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+from symmetry_tpu.provider.config import ConfigManager, default_config_path
+from symmetry_tpu.provider.provider import SymmetryProvider
+from symmetry_tpu.utils.logging import logger
+
+
+async def run(config_path: str) -> None:
+    provider = SymmetryProvider(ConfigManager(config_path))
+    await provider.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    logger.info("draining and shutting down…")
+    await provider.stop()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog="symmetry-provider")
+    parser.add_argument("-c", "--config", default=default_config_path(),
+                        help="path to provider.yaml")
+    args = parser.parse_args()
+    asyncio.run(run(args.config))
+
+
+if __name__ == "__main__":
+    main()

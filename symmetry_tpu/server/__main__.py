@@ -1,0 +1,6 @@
+import asyncio
+
+from symmetry_tpu.server.broker import main
+
+if __name__ == "__main__":
+    asyncio.run(main())

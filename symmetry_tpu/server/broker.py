@@ -1,0 +1,270 @@
+"""The Symmetry server: session broker, model router, load balancer.
+
+The reference repo ships only the provider; the server it registers with lives
+in an absent sibling repo (SURVEY §0.1). Its observable protocol — the
+`serverMessageKeys` vocabulary (reference src/constants.ts:3-20), the provider
+join flow (src/provider.ts:83-131), and the SQLite data model
+(src/types.ts:182-208) — is re-created here:
+
+  provider → join {config, discoveryKey, address}  → joinAck {key: serverKey}
+  provider → challenge {challenge}                 → challengeResponse {signature}
+  provider → connectionSize n                      → (load update)
+  provider → reportCompletion {tokens, sessionId}  → (usage record)
+  provider → leave                                 → (deregistered; graceful)
+  server   → ping (periodic)                       ← pong (liveness)
+  client   → requestProvider {modelName}           → providerDetails {provider, sessionToken}
+  client   → verifySession {sessionId}             → sessionValid {valid}
+  client   → providerList                          → providerList {models}
+
+Authentication is two-layer: the Noise handshake proves key ownership at
+connect time (enforced, unlike the reference's advisory check), and the
+challenge/response flow is kept for wire-level parity.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import uuid
+from typing import Any
+
+from symmetry_tpu.identity import Identity
+from symmetry_tpu.network.peer import Peer
+from symmetry_tpu.protocol.keys import MessageKey
+from symmetry_tpu.server import tokens
+from symmetry_tpu.server.registry import Registry
+from symmetry_tpu.transport.base import Connection, Listener, Transport
+from symmetry_tpu.utils.logging import logger
+
+PING_INTERVAL_S = 30.0
+STALE_AFTER_S = 90.0
+
+
+class SymmetryServer:
+    def __init__(
+        self,
+        identity: Identity,
+        transport: Transport,
+        *,
+        db_path: str = ":memory:",
+        ping_interval_s: float = PING_INTERVAL_S,
+        stale_after_s: float = STALE_AFTER_S,
+    ) -> None:
+        self.identity = identity
+        self._transport = transport
+        self.registry = Registry(db_path)
+        self._ping_interval = ping_interval_s
+        self._stale_after = stale_after_s
+        self._listener: Listener | None = None
+        self._provider_peers: dict[str, Peer] = {}  # peer_key hex → live peer
+        self._tasks: set[asyncio.Task] = set()
+        self._stopped = asyncio.Event()
+
+    @property
+    def address(self) -> str:
+        assert self._listener is not None, "server not started"
+        return self._listener.address
+
+    async def start(self, address: str) -> None:
+        self._listener = await self._transport.listen(address, self._on_connection)
+        self._spawn(self._liveness_loop())
+        logger.info(
+            f"symmetry server listening on {self.address} "
+            f"key={self.identity.public_hex}"
+        )
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        for task in list(self._tasks):
+            task.cancel()
+        for peer in list(self._provider_peers.values()):
+            await peer.close()
+        if self._listener is not None:
+            await self._listener.close()
+        self.registry.close()
+
+    def _spawn(self, coro) -> asyncio.Task:
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    # --- connection handling ---
+
+    async def _on_connection(self, conn: Connection) -> None:
+        peer = await Peer.connect(conn, self.identity, initiator=False)
+        peer_key = peer.remote_public_hex
+        logger.debug(f"server: peer {peer_key[:12]} connected")
+        try:
+            async for msg in peer:
+                await self._dispatch(peer, peer_key, msg.key, msg.data)
+        finally:
+            # A dropped connection is an implicit leave (the reference server
+            # detects departure via ping timeout; we do it immediately too).
+            if self._provider_peers.get(peer_key) is peer:
+                del self._provider_peers[peer_key]
+                self.registry.set_offline(peer_key)
+                logger.info(f"provider {peer_key[:12]} disconnected")
+
+    async def _dispatch(self, peer: Peer, peer_key: str, key: str, data: Any) -> None:
+        if key == MessageKey.CHALLENGE:
+            # Reference flow (src/provider.ts:95-101,143-171): peer sends random
+            # bytes, server returns its signature over them.
+            challenge_hex = (data or {}).get("challenge", "")
+            try:
+                challenge = bytes.fromhex(challenge_hex)
+            except ValueError:
+                challenge = b""
+            if not 8 <= len(challenge) <= 64:
+                await peer.send(MessageKey.INFERENCE_ERROR, {"error": "bad challenge"})
+                return
+            sig = self.identity.sign(challenge)
+            await peer.send(
+                MessageKey.CHALLENGE_RESPONSE,
+                {"signature": sig.hex(), "serverKey": self.identity.public_hex},
+            )
+        elif key == MessageKey.JOIN:
+            await self._handle_join(peer, peer_key, data or {})
+        elif key in (MessageKey.CONNECTION_SIZE,):
+            count = data if isinstance(data, int) else (data or {}).get("connections", 0)
+            self.registry.set_connections(peer_key, int(count))
+        elif key in (MessageKey.PONG, MessageKey.HEARTBEAT):
+            self.registry.touch(peer_key)
+        elif key == MessageKey.METRICS:
+            self.registry.touch(peer_key)
+        elif key == MessageKey.REPORT_COMPLETION:
+            d = data or {}
+            self.registry.report_completion(
+                peer_key=peer_key,
+                session_id=d.get("sessionId"),
+                tokens=int(d.get("tokens", 0)),
+            )
+        elif key == MessageKey.LEAVE:
+            self._provider_peers.pop(peer_key, None)
+            self.registry.set_offline(peer_key)
+            logger.info(f"provider {peer_key[:12]} left gracefully")
+        elif key == MessageKey.REQUEST_PROVIDER:
+            await self._handle_request_provider(peer, peer_key, data or {})
+        elif key == MessageKey.VERIFY_SESSION:
+            session_id = (data or {}).get("sessionId", "")
+            await peer.send(
+                MessageKey.SESSION_VALID,
+                {"sessionId": session_id, "valid": self.registry.session_valid(session_id)},
+            )
+        elif key == MessageKey.PROVIDER_LIST:
+            await peer.send(MessageKey.PROVIDER_LIST, {"models": self.registry.list_models()})
+        elif key == MessageKey.PING:
+            await peer.send(MessageKey.PONG)
+        else:
+            logger.debug(f"server: unhandled key {key!r} from {peer_key[:12]}")
+
+    async def _handle_join(self, peer: Peer, peer_key: str, data: dict) -> None:
+        config = data.get("config") or {}
+        model_name = config.get("modelName") or data.get("modelName")
+        if not model_name:
+            await peer.send(MessageKey.INFERENCE_ERROR, {"error": "join missing modelName"})
+            return
+        self.registry.upsert_provider(
+            peer_key=peer_key,
+            discovery_key=data.get("discoveryKey", peer.remote_discovery_key.hex()),
+            model_name=model_name,
+            name=config.get("name"),
+            address=data.get("address"),
+            public=bool(config.get("public", True)),
+            max_connections=int(config.get("maxConnections", 10)),
+            data_collection=bool(config.get("dataCollectionEnabled", False)),
+            config=config,
+        )
+        self._provider_peers[peer_key] = peer
+        await peer.send(MessageKey.JOIN_ACK, {"serverKey": self.identity.public_hex})
+        logger.info(f"provider {peer_key[:12]} joined serving {model_name!r}")
+
+    async def _handle_request_provider(self, peer: Peer, client_key: str, data: dict) -> None:
+        model_name = data.get("modelName")
+        row = self.registry.select_provider(model_name)
+        if row is None:
+            await peer.send(
+                MessageKey.PROVIDER_DETAILS,
+                {"error": f"no provider available for model {model_name!r}"},
+            )
+            return
+        session_id = str(uuid.uuid4())
+        self.registry.create_session(
+            session_id=session_id,
+            peer_key=row.peer_key,
+            client_key=client_key,
+            model_name=row.model_name,
+        )
+        token = tokens.mint(
+            self.identity,
+            session_id=session_id,
+            client_key=client_key,
+            model_name=row.model_name,
+        )
+        await peer.send(
+            MessageKey.PROVIDER_DETAILS,
+            {
+                "sessionId": session_id,
+                "sessionToken": token,
+                "provider": {
+                    "peerKey": row.peer_key,
+                    "discoveryKey": row.discovery_key,
+                    "address": row.address,
+                    "modelName": row.model_name,
+                    "name": row.name,
+                    "dataCollectionEnabled": row.data_collection,
+                },
+            },
+        )
+
+    # --- liveness (reference: server→provider ping, src/provider.ts:124-126) ---
+
+    async def _liveness_loop(self) -> None:
+        while not self._stopped.is_set():
+            await asyncio.sleep(self._ping_interval)
+            for peer_key, peer in list(self._provider_peers.items()):
+                if peer.closed:
+                    continue
+                try:
+                    await peer.send(MessageKey.PING)
+                except (ConnectionError, OSError):
+                    self._provider_peers.pop(peer_key, None)
+                    self.registry.set_offline(peer_key)
+            for peer_key in self.registry.stale_providers(self._stale_after):
+                logger.warning(f"provider {peer_key[:12]} stale; marking offline")
+                self._provider_peers.pop(peer_key, None)
+                self.registry.set_offline(peer_key)
+
+
+async def main() -> None:
+    """CLI entry: python -m symmetry_tpu.server [--port N] [--db PATH]"""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="Symmetry routing server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=4848)
+    parser.add_argument("--db", default=os.path.expanduser("~/.config/symmetry/server.db"))
+    parser.add_argument("--seed-name", default=None,
+                        help="derive a stable identity from this name")
+    args = parser.parse_args()
+
+    from symmetry_tpu.transport.tcp import TcpTransport
+
+    identity = (
+        Identity.from_name(args.seed_name) if args.seed_name else Identity.generate()
+    )
+    if args.db != ":memory:":
+        os.makedirs(os.path.dirname(args.db), exist_ok=True)
+    server = SymmetryServer(identity, TcpTransport(), db_path=args.db)
+    await server.start(f"tcp://{args.host}:{args.port}")
+    print(f"serverKey: {identity.public_hex}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await server.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

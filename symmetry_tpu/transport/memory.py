@@ -24,12 +24,17 @@ class MemoryConnection(Connection):
         self._rx = rx
         self._tx = tx
         self._peer_name = peer_name
+        self._peer: "MemoryConnection | None" = None  # set by memory_pair
         self._closed = False
         self._eof = False
 
     async def send(self, frame: bytes) -> None:
         if self._closed:
             raise ConnectionError("connection closed")
+        if self._peer is not None and self._peer._closed:
+            # Mirror TCP: writing to a reset connection raises, it doesn't
+            # buffer into the void until the queue wedges.
+            raise ConnectionError("connection reset by peer")
         await self._tx.put(frame)  # Queue(maxsize) gives natural backpressure
 
     async def recv(self) -> bytes | None:
@@ -72,10 +77,10 @@ def memory_pair(a_name: str = "a", b_name: str = "b") -> tuple[MemoryConnection,
     """A connected duplex pair — the unit-test workhorse."""
     q_ab: asyncio.Queue = asyncio.Queue(_MAX_QUEUE)
     q_ba: asyncio.Queue = asyncio.Queue(_MAX_QUEUE)
-    return (
-        MemoryConnection(rx=q_ba, tx=q_ab, peer_name=f"mem://{b_name}"),
-        MemoryConnection(rx=q_ab, tx=q_ba, peer_name=f"mem://{a_name}"),
-    )
+    a = MemoryConnection(rx=q_ba, tx=q_ab, peer_name=f"mem://{b_name}")
+    b = MemoryConnection(rx=q_ab, tx=q_ba, peer_name=f"mem://{a_name}")
+    a._peer, b._peer = b, a
+    return a, b
 
 
 class MemoryListener(Listener):

@@ -1,0 +1,81 @@
+"""Config manager: reference-parity validation + TPU extensions."""
+
+import pytest
+import yaml
+
+from symmetry_tpu.provider.config import ConfigError, ConfigManager, write_default_config
+
+BASE = {
+    "name": "node-1",
+    "public": True,
+    "serverKey": "ab" * 32,
+    "modelName": "llama3:8b",
+    "apiProvider": "ollama",
+    "apiHostname": "localhost",
+    "apiPort": 11434,
+    "apiPath": "/v1/chat/completions",
+    "apiProtocol": "http",
+}
+
+
+def test_valid_proxy_config():
+    cfg = ConfigManager(config=BASE)
+    assert cfg.model_name == "llama3:8b"
+    assert cfg.max_connections == 10  # default, reference install.sh:44
+    assert cfg.server_key == bytes.fromhex("ab" * 32)
+
+
+def test_missing_required_fields_rejected():
+    # Required-field validation parity (reference src/config.ts:19-45).
+    for drop in ("name", "modelName", "serverKey", "public", "apiHostname"):
+        broken = {k: v for k, v in BASE.items() if k != drop}
+        with pytest.raises(ConfigError, match=drop):
+            ConfigManager(config=broken)
+
+
+def test_public_must_be_boolean():
+    # Reference enforces boolean `public` (src/config.ts:40-44).
+    with pytest.raises(ConfigError, match="boolean"):
+        ConfigManager(config={**BASE, "public": "yes"})
+
+
+def test_tpu_native_needs_no_api_fields():
+    cfg = ConfigManager(config={
+        "name": "tpu-node", "public": False, "serverKey": "cd" * 32,
+        "modelName": "llama3:8b", "apiProvider": "tpu_native",
+        "tpu": {"mesh": {"data": 1, "model": 8}, "dtype": "bfloat16",
+                "max_batch_size": 16},
+    })
+    assert cfg.tpu.mesh == {"data": 1, "model": 8}
+    assert cfg.tpu.max_batch_size == 16
+    assert cfg.tpu.model_family == "llama"
+
+
+def test_unknown_provider_rejected():
+    with pytest.raises(ConfigError, match="apiProvider"):
+        ConfigManager(config={**BASE, "apiProvider": "vllm"})
+
+
+def test_unknown_tpu_keys_rejected():
+    with pytest.raises(ConfigError, match="unknown tpu"):
+        ConfigManager(config={**BASE, "apiProvider": "tpu_native",
+                              "tpu": {"mesh_shap": {}}})
+
+
+def test_api_key_stripped_from_public_view():
+    # The reference announces its full config incl. apiKey to the server
+    # (src/provider.ts:103-108) — we must not.
+    cfg = ConfigManager(config={**BASE, "apiKey": "sk-secret"})
+    assert "apiKey" not in cfg.public_view()
+    assert cfg.get("apiKey") == "sk-secret"
+
+
+def test_yaml_load_and_scaffold(tmp_path):
+    path = tmp_path / "provider.yaml"
+    write_default_config(str(path), name="scaffolded", server_key_hex="ef" * 32)
+    cfg = ConfigManager(config_path=str(path))
+    assert cfg.name == "scaffolded"
+    assert cfg.api_provider == "tpu_native"
+    # Round-trips through real YAML on disk.
+    raw = yaml.safe_load(path.read_text())
+    assert raw["serverKey"] == "ef" * 32
