@@ -1,0 +1,298 @@
+"""Llama-family decoder (llama 3.x, mistral, any HF-llama-shaped LM).
+
+Design (TPU-first, not a port — the reference has no model code to port):
+
+  - Params are a plain pytree; `forward` is a pure function of
+    (params, tokens, positions, cache). Everything jits.
+  - All decoder layers are STACKED along a leading `layers` dim and executed
+    with `lax.scan`: compile time is O(1) in depth (llama3-70b is 80 layers;
+    unrolled tracing would take minutes and bloat the executable).
+  - Projection weights stay fused 2-D ([embed, heads*head_dim]) so each layer
+    is a handful of large matmuls the MXU tiles well, with logical axes
+    mapped to the mesh by parallel/sharding.py (megatron-style TP by
+    default — XLA derives the per-layer collectives from the shardings).
+  - One forward serves prefill AND decode: masking is by absolute position
+    (ops/attention.py), cache writes are scatters at per-sample positions,
+    so a continuous batch of ragged requests runs at static shape.
+
+HF weight compatibility (BASELINE.json north star loads HF safetensors):
+tensor layout/naming map in `HF_LAYER_MAP` + `convert_hf_params`
+(engine/weights.py does the streaming file IO).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from symmetry_tpu.ops.attention import gqa_attention
+from symmetry_tpu.ops.norm import rms_norm
+from symmetry_tpu.ops.rope import apply_rope
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    intermediate_size: int
+    head_dim: int | None = None          # defaults to hidden//heads
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+    sliding_window: int | None = None    # mistral-v0.1 style local attention
+    max_position: int = 8192
+
+    @property
+    def dim_per_head(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.dim_per_head
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.dim_per_head
+
+
+# Named presets; sizes from the public HF configs of each model family.
+PRESETS: dict[str, ModelConfig] = {
+    # test-scale models (CPU-fast, exercised by the suite)
+    "tiny": ModelConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=128, rope_theta=10000.0,
+        max_position=512,
+    ),
+    "tiny-mha": ModelConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=4, intermediate_size=128, rope_theta=10000.0,
+        max_position=512,
+    ),
+    # production targets (BASELINE.json configs 2-5)
+    "llama3-8b": ModelConfig(
+        vocab_size=128256, hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, intermediate_size=14336, rope_theta=500000.0,
+    ),
+    "llama3-70b": ModelConfig(
+        vocab_size=128256, hidden_size=8192, num_layers=80, num_heads=64,
+        num_kv_heads=8, intermediate_size=28672, rope_theta=500000.0,
+    ),
+    "llama3.2-1b": ModelConfig(
+        vocab_size=128256, hidden_size=2048, num_layers=16, num_heads=32,
+        num_kv_heads=8, intermediate_size=8192, rope_theta=500000.0,
+        tie_embeddings=True,
+    ),
+    "mistral-7b": ModelConfig(
+        vocab_size=32768, hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, intermediate_size=14336, rope_theta=1000000.0,
+    ),
+}
+
+
+def preset(name: str) -> ModelConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown model preset {name!r}; have {sorted(PRESETS)}")
+    return PRESETS[name]
+
+
+class KVCache(NamedTuple):
+    """Static-shape KV cache: [layers, batch, capacity, kv_heads, head_dim]."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    lengths: jnp.ndarray  # [batch] int32: valid entries per slot
+
+
+def init_cache(
+    config: ModelConfig, batch: int, capacity: int, dtype=jnp.bfloat16
+) -> KVCache:
+    shape = (config.num_layers, batch, capacity, config.num_kv_heads,
+             config.dim_per_head)
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+
+
+def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
+    """Random init (scaled normal). Real serving loads HF weights instead."""
+    c = config
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(k, shape, scale=None):
+        scale = scale if scale is not None else shape[0] ** -0.5
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    L, E, F = c.num_layers, c.hidden_size, c.intermediate_size
+    params = {
+        "embed": dense(next(keys), (c.vocab_size, E), scale=0.02),
+        "layers": {
+            "attn_norm": jnp.ones((L, E), dtype),
+            "mlp_norm": jnp.ones((L, E), dtype),
+            "wq": dense(next(keys), (L, E, c.q_dim)),
+            "wk": dense(next(keys), (L, E, c.kv_dim)),
+            "wv": dense(next(keys), (L, E, c.kv_dim)),
+            "wo": dense(next(keys), (L, c.q_dim, E)),
+            "wg": dense(next(keys), (L, E, F)),
+            "wu": dense(next(keys), (L, E, F)),
+            "wd": dense(next(keys), (L, F, E)),
+        },
+        "final_norm": jnp.ones((E,), dtype),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = dense(next(keys), (E, c.vocab_size), scale=0.02)
+    return params
+
+
+def param_logical_axes(config: ModelConfig) -> dict:
+    """Pytree of logical-axis tuples, same structure as init_params output."""
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "wg": ("layers", "embed", "mlp"),
+            "wu": ("layers", "embed", "mlp"),
+            "wd": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+    }
+    if not config.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def cache_logical_axes() -> KVCache:
+    return KVCache(
+        k=("layers", "batch", "cache_seq", "kv_heads", "head_dim"),
+        v=("layers", "batch", "cache_seq", "kv_heads", "head_dim"),
+        lengths=("batch",),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Forward
+
+
+def _layer(
+    h: jnp.ndarray,             # [B, S, E]
+    lp: dict,                   # one layer's params (leading L dim stripped)
+    ck: jnp.ndarray,            # [B, T, K, D] this layer's key cache
+    cv: jnp.ndarray,
+    positions: jnp.ndarray,     # [B, S]
+    kv_valid: jnp.ndarray,      # [B] cache length AFTER this call's writes
+    config: ModelConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    B, S, E = h.shape
+    D, nq, nkv = config.dim_per_head, config.num_heads, config.num_kv_heads
+
+    x = rms_norm(h, lp["attn_norm"], config.rms_eps)
+    q = (x @ lp["wq"]).reshape(B, S, nq, D)
+    k = (x @ lp["wk"]).reshape(B, S, nkv, D)
+    v = (x @ lp["wv"]).reshape(B, S, nkv, D)
+    q = apply_rope(q, positions, config.rope_theta)
+    k = apply_rope(k, positions, config.rope_theta)
+
+    # Scatter the new K/V into the cache at their absolute positions. Padded
+    # tail tokens write garbage past kv_valid — never read, overwritten later.
+    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    ck = ck.at[b_idx, positions].set(k.astype(ck.dtype))
+    cv = cv.at[b_idx, positions].set(v.astype(cv.dtype))
+
+    attn = gqa_attention(q, ck, cv, positions, kv_valid,
+                         sliding_window=config.sliding_window)
+    h = h + attn.reshape(B, S, nq * D) @ lp["wo"]
+
+    x = rms_norm(h, lp["mlp_norm"], config.rms_eps)
+    h = h + (jax.nn.silu(x @ lp["wg"]) * (x @ lp["wu"])) @ lp["wd"]
+    return h, ck, cv
+
+
+def forward(
+    params: dict,
+    config: ModelConfig,
+    tokens: jnp.ndarray,      # [B, S] int32
+    cache: KVCache,           # lengths[b] = tokens already in cache for slot b
+    seq_lens: jnp.ndarray | None = None,  # [B] valid tokens in `tokens`; None = all S
+) -> tuple[jnp.ndarray, KVCache]:
+    """Run the decoder; returns (logits [B, S, vocab] f32, updated cache).
+
+    Serves prefill (S = padded prompt length, cache.lengths typically 0) and
+    decode (S = 1 per slot) with the same traced computation. Logits at
+    padded positions are garbage by contract; callers index the last valid
+    position.
+    """
+    B, S = tokens.shape
+    if seq_lens is None:
+        seq_lens = jnp.full((B,), S, jnp.int32)
+    positions = cache.lengths[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    kv_valid = cache.lengths + seq_lens
+
+    h = jnp.take(params["embed"], tokens, axis=0)
+
+    def body(h, xs):
+        lp, ck, cv = xs
+        h, ck, cv = _layer(h, lp, ck, cv, positions, kv_valid, config)
+        return h, (ck, cv)
+
+    h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], cache.k, cache.v))
+
+    h = rms_norm(h, params["final_norm"], config.rms_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = (h @ head).astype(jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v, lengths=kv_valid)
+
+
+# ---------------------------------------------------------------------------
+# HF weight layout map (used by engine/weights.py; kept here because it is
+# model knowledge). HF linear weights are [out, in] — transposed vs ours.
+
+HF_TOP_MAP = {
+    "model.embed_tokens.weight": ("embed", False),
+    "model.norm.weight": ("final_norm", False),
+    "lm_head.weight": ("lm_head", True),  # [V,E] -> [E,V]
+}
+HF_LAYER_MAP = {
+    "input_layernorm.weight": ("attn_norm", False),
+    "post_attention_layernorm.weight": ("mlp_norm", False),
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.o_proj.weight": ("wo", True),
+    "mlp.gate_proj.weight": ("wg", True),
+    "mlp.up_proj.weight": ("wu", True),
+    "mlp.down_proj.weight": ("wd", True),
+}
+
+
+def config_from_hf(hf: dict[str, Any]) -> ModelConfig:
+    """Build a ModelConfig from an HF config.json dict (llama/mistral shape)."""
+    return ModelConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        intermediate_size=hf["intermediate_size"],
+        head_dim=hf.get("head_dim"),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_eps=hf.get("rms_norm_eps", 1e-5),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+        sliding_window=hf.get("sliding_window"),
+        max_position=hf.get("max_position_embeddings", 8192),
+    )

@@ -1,0 +1,54 @@
+"""Token sampling: greedy / temperature / top-k / top-p, batched and jittable.
+
+Controls are per-slot arrays, not Python scalars, so one compiled sampler
+serves a continuous batch where every request carries its own temperature
+(InferenceRequest sampling fields, provider/backends/base.py). temperature==0
+selects greedy via masking rather than control flow — no recompiles, no
+data-dependent branching under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from symmetry_tpu.ops.attention import NEG_INF
+
+
+def sample_tokens(
+    logits: jnp.ndarray,        # [B, V] float
+    key: jax.Array,             # PRNG key
+    temperature: jnp.ndarray,   # [B] float; 0 => greedy
+    top_p: jnp.ndarray,         # [B] float in (0, 1]; 1 => disabled
+    top_k: jnp.ndarray,         # [B] int32; 0 => disabled
+) -> jnp.ndarray:
+    """Returns sampled token ids [B] int32."""
+    B, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # Scale by temperature (guard 0 to keep the math finite; result unused then).
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+
+    # Sort once, descending; apply top-k and top-p masks in sorted space.
+    sorted_idx = jnp.argsort(scaled, axis=-1)[:, ::-1]
+    sorted_logits = jnp.take_along_axis(scaled, sorted_idx, axis=-1)
+    ranks = jnp.arange(V, dtype=jnp.int32)[None, :]
+
+    keep = jnp.ones((B, V), dtype=bool)
+    # top-k: keep ranks < k (k==0 disables).
+    k = jnp.where(top_k > 0, top_k, V)
+    keep &= ranks < k[:, None]
+    # top-p: keep the smallest prefix whose probability mass reaches p.
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # token i is kept if the mass strictly before it is < p (always keeps rank 0)
+    mass_before = cum - probs
+    keep &= mass_before < top_p[:, None]
+
+    masked = jnp.where(keep, sorted_logits, NEG_INF)
+    choice_rank = jax.random.categorical(key, masked, axis=-1)  # [B]
+    sampled = jnp.take_along_axis(sorted_idx, choice_rank[:, None], axis=-1)[:, 0]
+
+    return jnp.where(temperature > 0, sampled.astype(jnp.int32), greedy)

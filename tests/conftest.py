@@ -2,12 +2,25 @@
 
 Tests never require a real TPU: JAX is pinned to the CPU backend with 8 virtual
 devices so sharding/mesh tests exercise real multi-device compilation paths
-(SURVEY §4 build implication). This must run before jax is imported anywhere.
+(SURVEY §4 build implication). This must run before jax is imported anywhere —
+and must OVERRIDE the outer environment, which may point JAX_PLATFORMS at a
+live TPU tunnel.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import after env pinning, by design)
+
+# A site hook may have re-pointed jax_platforms at a live TPU despite the env
+# var (observed: sitecustomize forcing "axon,cpu"); pin it back post-import.
+jax.config.update("jax_platforms", "cpu")
+
+# Tests run models in float32 and compare against f32 references; the default
+# matmul precision truncates f32 operands to bf16 passes, which swamps the
+# tolerances. Production serving uses bf16 params, where this is a no-op.
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,61 @@
+"""Tokenizer + incremental stream-decoding tests."""
+
+from symmetry_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder
+
+
+class TestByteTokenizer:
+    def test_roundtrip(self):
+        tok = ByteTokenizer()
+        text = "hello, wörld — ✓"
+        assert tok.decode(tok.encode(text, bos=False)) == text
+
+    def test_bos_eos(self):
+        tok = ByteTokenizer()
+        ids = tok.encode("x")
+        assert ids[0] == tok.bos_id
+        assert tok.EOS in tok.eos_ids
+        assert tok.decode(ids + [tok.EOS]) == "x"  # specials skipped
+
+    def test_chat_template_open_for_assistant(self):
+        tok = ByteTokenizer()
+        ids = tok.apply_chat_template(
+            [{"role": "user", "content": "hi"}])
+        assert tok.decode(ids).endswith("assistant: ")
+
+
+class TestStreamDecoder:
+    def test_ascii_streams_per_token(self):
+        tok = ByteTokenizer()
+        dec = StreamDecoder(tok)
+        got = [dec.push(i) for i in tok.encode("abc", bos=False)]
+        assert got == ["a", "b", "c"]
+
+    def test_multibyte_held_until_complete(self):
+        """A split UTF-8 codepoint must never be emitted partially."""
+        tok = ByteTokenizer()
+        dec = StreamDecoder(tok)
+        ids = tok.encode("é✓", bos=False)  # 2-byte + 3-byte codepoints
+        pieces = [dec.push(i) for i in ids]
+        assert "".join(pieces) == "é✓"
+        # No piece may contain a replacement char.
+        assert all("�" not in p for p in pieces)
+        # The bytes mid-codepoint must yield empty strings.
+        assert pieces[0] == ""
+        assert pieces[1] == "é"
+
+    def test_flush_emits_dangling(self):
+        tok = ByteTokenizer()
+        dec = StreamDecoder(tok)
+        ids = tok.encode("é", bos=False)
+        assert dec.push(ids[0]) == ""
+        assert dec.push(ids[1]) == "é"
+        assert dec.flush() == ""
+
+    def test_long_stream_linear_cost(self):
+        """The decode window must not grow with the stream (O(n^2) guard)."""
+        tok = ByteTokenizer()
+        dec = StreamDecoder(tok)
+        for i in tok.encode("x" * 5000, bos=False):
+            dec.push(i)
+        # Window is [prefix:], which must have stayed bounded.
+        assert len(dec._ids) - dec._prefix <= 4
