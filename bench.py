@@ -1,0 +1,114 @@
+"""Serving benchmark: aggregate decode throughput of the tpu_native engine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+vs_baseline is measured against the BASELINE.json north-star target of
+2000 tok/s aggregate (llama3:8b streaming on v5e-8 — reference publishes no
+numbers of its own, SURVEY §6, so the target is the yardstick).
+
+Modes:
+  python bench.py            # real chip: llama3.2-1b-shaped model, bf16
+  python bench.py --smoke    # CPU-safe tiny model (used by /verify)
+  python bench.py --preset llama3-8b --slots 16 --steps 256 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
+              max_seq: int, dtype_name: str, mesh_model: int,
+              block: int = 1) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
+    from symmetry_tpu.engine.tokenizer import ByteTokenizer
+    from symmetry_tpu.models import init_params, param_logical_axes, preset
+    from symmetry_tpu.parallel import MeshSpec, build_mesh, shardings_for
+
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype_name]
+    config = preset(preset_name)
+    params = init_params(config, jax.random.key(0), dtype)
+
+    mesh = None
+    if mesh_model > 1:
+        mesh = build_mesh(MeshSpec(data=1, model=mesh_model))
+        params = jax.device_put(
+            params, shardings_for(param_logical_axes(config), mesh))
+
+    engine = InferenceEngine(
+        config, params, ByteTokenizer(), mesh=mesh, max_slots=slots,
+        max_seq_len=max_seq, prefill_buckets=(prompt_len,),
+        cache_dtype=dtype, decode_block=block)
+
+    prompt = list(range(1, prompt_len + 1))
+    t_prefill0 = time.perf_counter()
+    for slot in range(slots):
+        engine.prefill_and_insert(slot, [p % 200 for p in prompt],
+                                  SamplingParams(temperature=0.7, seed=slot))
+    prefill_s = time.perf_counter() - t_prefill0
+
+    # Warmup decode (compile) then measure. `steps` counts decode steps;
+    # each dispatch advances `block` of them.
+    engine.decode_steps()
+    n_disp = max(1, steps // block)
+    t0 = time.perf_counter()
+    for _ in range(n_disp):
+        engine.decode_steps()  # np.asarray inside = host sync per block
+    dt = time.perf_counter() - t0
+
+    done_steps = n_disp * block
+    tok_s = slots * done_steps / dt
+    return {
+        "metric": f"aggregate decode tok/s ({preset_name} {dtype_name}, "
+                  f"{slots} slots, block {block}, "
+                  f"{jax.device_count()} {jax.default_backend()} dev)",
+        "value": round(tok_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / 2000.0, 3),
+        "per_slot_tok_s": round(tok_s / slots, 1),
+        "prefill_s_per_slot": round(prefill_s / slots, 3),
+        "decode_step_ms": round(1e3 * dt / done_steps, 2),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU-safe tiny-model run (verification, not perf)")
+    ap.add_argument("--preset", default="llama3.2-1b")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=128)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--max-seq", type=int, default=1024)
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=("bfloat16", "float32"))
+    ap.add_argument("--mesh-model", type=int, default=1,
+                    help="model-axis mesh size (tensor parallelism)")
+    ap.add_argument("--block", type=int, default=16,
+                    help="decode steps per device dispatch")
+    args = ap.parse_args()
+
+    if args.smoke:
+        # Smoke mode must not touch a TPU: pin the CPU backend before any
+        # jax usage (env alone can be overridden by site hooks).
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        result = run_bench("tiny", slots=2, steps=8, prompt_len=16,
+                           max_seq=64, dtype_name="float32", mesh_model=1,
+                           block=2)
+    else:
+        result = run_bench(args.preset, slots=args.slots, steps=args.steps,
+                           prompt_len=args.prompt_len, max_seq=args.max_seq,
+                           dtype_name=args.dtype, mesh_model=args.mesh_model,
+                           block=args.block)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,326 @@
+"""The serving engine: jitted prefill / insert / decode over a slot batch.
+
+Shape discipline (SURVEY §7 hard-part 1 — continuous batching under jit
+without recompile storms):
+
+  - PREFILL runs at batch 1, prompt padded to one of a few fixed buckets
+    (tpu.prefill_buckets) — one compiled program per bucket, ever.
+  - INSERT copies the prefilled KV prefix into slot `i` of the shared decode
+    cache with dynamic_update_slice — shapes static, slot index dynamic.
+  - DECODE advances ALL slots one token per step at a fixed [B, 1] shape;
+    per-slot raggedness lives in position/length arrays, not shapes.
+
+All three are donated-state jits: the decode cache (the big HBM tenant) is
+updated in place, never copied. Sampling controls are per-slot device arrays
+so one compiled step serves mixed greedy/sampled requests.
+
+The engine is synchronous and single-threaded by design — the asyncio bridge
+lives in the scheduler (scheduler.py), mirroring how the reference keeps all
+concurrency in one event loop (SURVEY §5.2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from symmetry_tpu.models.llama import (
+    KVCache,
+    ModelConfig,
+    cache_logical_axes,
+    forward,
+    forward_hidden,
+    init_params,
+    logits_from_hidden,
+    preset,
+)
+from symmetry_tpu.ops.sampling import sample_tokens
+from symmetry_tpu.parallel.mesh import MeshSpec, build_mesh
+from symmetry_tpu.parallel.sharding import shardings_for
+from symmetry_tpu.engine.tokenizer import Tokenizer, get_tokenizer
+
+
+class EngineError(RuntimeError):
+    pass
+
+
+class DecodeState(NamedTuple):
+    """Everything the decode step needs, all static-shape device arrays."""
+
+    cache: KVCache            # [L, B, T, K, D] x2 + lengths [B]
+    last_token: jnp.ndarray   # [B] int32 — token to feed next step
+    temperature: jnp.ndarray  # [B] float32
+    top_p: jnp.ndarray        # [B] float32
+    top_k: jnp.ndarray        # [B] int32
+    rng: jax.Array            # PRNG key
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.0
+    top_p: float = 1.0
+    top_k: int = 0
+    seed: int | None = None
+
+    @classmethod
+    def from_request(cls, req: Any) -> "SamplingParams":
+        return cls(
+            temperature=req.temperature if req.temperature is not None else 0.0,
+            top_p=req.top_p if req.top_p is not None else 1.0,
+            top_k=0,
+            seed=req.seed,
+        )
+
+
+class InferenceEngine:
+    """Owns params + decode state; exposes prefill/insert/decode primitives.
+
+    Thread-safety: NOT thread-safe; exactly one thread (the scheduler's
+    engine thread) may call the mutating methods.
+    """
+
+    def __init__(
+        self,
+        config: ModelConfig,
+        params: Any,
+        tokenizer: Tokenizer,
+        *,
+        mesh=None,
+        max_slots: int = 8,
+        max_seq_len: int = 2048,
+        prefill_buckets: tuple[int, ...] = (128, 512, 2048),
+        cache_dtype=jnp.bfloat16,
+        decode_block: int = 1,
+    ) -> None:
+        self.config = config
+        self.params = params
+        self.tokenizer = tokenizer
+        self.mesh = mesh
+        self.max_slots = max_slots
+        self.max_seq_len = max_seq_len
+        self.prefill_buckets = tuple(sorted(b for b in prefill_buckets
+                                            if b <= max_seq_len))
+        if not self.prefill_buckets:
+            raise EngineError("no prefill bucket fits within max_seq_len")
+        self.cache_dtype = cache_dtype
+        if decode_block < 1:
+            raise EngineError("decode_block must be >= 1")
+        # Prompts that leave less than decode_block headroom finish right
+        # after their first token (scheduler admission check), so buckets up
+        # to max_seq_len are allowed — they just can't decode far.
+        self.decode_block = decode_block
+
+        c = config
+        cache_shape = (c.num_layers, max_slots, max_seq_len, c.num_kv_heads,
+                       c.dim_per_head)
+
+        if mesh is not None:
+            cax = cache_logical_axes()
+            self._cache_shardings = KVCache(
+                *(shardings_for(a, mesh)
+                  for a in (cax.k, cax.v, cax.lengths)))
+            rep = jax.NamedSharding(mesh, jax.sharding.PartitionSpec())
+            self._state_shardings = DecodeState(
+                cache=self._cache_shardings, last_token=rep, temperature=rep,
+                top_p=rep, top_k=rep, rng=rep)
+        else:
+            self._cache_shardings = None
+            self._state_shardings = None
+
+        self.state = DecodeState(
+            cache=KVCache(
+                k=jnp.zeros(cache_shape, cache_dtype),
+                v=jnp.zeros(cache_shape, cache_dtype),
+                lengths=jnp.zeros((max_slots,), jnp.int32),
+            ),
+            last_token=jnp.zeros((max_slots,), jnp.int32),
+            temperature=jnp.zeros((max_slots,), jnp.float32),
+            top_p=jnp.ones((max_slots,), jnp.float32),
+            top_k=jnp.zeros((max_slots,), jnp.int32),
+            rng=jax.random.key(0),
+        )
+        if self._state_shardings is not None:
+            # Initial placement must match the jits' out_shardings exactly,
+            # or donated-buffer aliasing fails on the first insert.
+            self.state = jax.device_put(self.state, self._state_shardings)
+
+        self._build_jits()
+
+    # ------------------------------------------------------------------
+    # Jitted primitives
+
+    def _build_jits(self) -> None:
+        cfg = self.config
+
+        def prefill(params, tokens, true_len, temp, top_p, top_k, rng):
+            """tokens [1, Sb] padded; returns (first sampled token, prefix KV)."""
+            S = tokens.shape[1]
+            cache = KVCache(
+                k=jnp.zeros((cfg.num_layers, 1, S, cfg.num_kv_heads,
+                             cfg.dim_per_head), self.cache_dtype),
+                v=jnp.zeros((cfg.num_layers, 1, S, cfg.num_kv_heads,
+                             cfg.dim_per_head), self.cache_dtype),
+                lengths=jnp.zeros((1,), jnp.int32),
+            )
+            h, cache = forward_hidden(params, cfg, tokens, cache,
+                                      seq_lens=true_len[None])
+            # Project ONLY the last valid position through the LM head —
+            # head cost is per-position × vocab, and padded positions are
+            # garbage anyway.
+            h_last = jnp.take_along_axis(
+                h, (true_len - 1)[None, None, None].astype(jnp.int32),
+                axis=1)  # [1, 1, E]
+            last = logits_from_hidden(params, cfg, h_last)[:, 0]  # [1, V]
+            tok = sample_tokens(last, rng, temp[None], top_p[None],
+                                top_k[None])  # [1]
+            return tok[0], cache
+
+        def insert(state: DecodeState, prefix: KVCache, slot, true_len,
+                   first_token, temp, top_p, top_k) -> DecodeState:
+            """Copy a batch-1 prefilled prefix into decode slot `slot`."""
+            Sb = prefix.k.shape[2]
+
+            def place(big, small):
+                # big [L,B,T,K,D] <- small [L,1,Sb,K,D] at [:, slot, 0]
+                return jax.lax.dynamic_update_slice(
+                    big, small.astype(big.dtype), (0, slot, 0, 0, 0))
+
+            cache = KVCache(
+                k=place(state.cache.k, prefix.k),
+                v=place(state.cache.v, prefix.v),
+                # The first sampled token's KV is not here yet: the next
+                # decode step writes it at position true_len.
+                lengths=state.cache.lengths.at[slot].set(true_len),
+            )
+            return DecodeState(
+                cache=cache,
+                last_token=state.last_token.at[slot].set(first_token),
+                temperature=state.temperature.at[slot].set(temp),
+                top_p=state.top_p.at[slot].set(top_p),
+                top_k=state.top_k.at[slot].set(top_k),
+                rng=state.rng,
+            )
+
+        def decode_one(state: DecodeState, params):
+            """Advance every slot one token."""
+            logits, cache = forward(params, cfg, state.last_token[:, None],
+                                    state.cache)
+            rng, step_key = jax.random.split(state.rng)
+            toks = sample_tokens(logits[:, 0], step_key, state.temperature,
+                                 state.top_p, state.top_k)
+            return DecodeState(
+                cache=cache, last_token=toks, temperature=state.temperature,
+                top_p=state.top_p, top_k=state.top_k, rng=rng,
+            ), toks
+
+        def decode_block(params, state: DecodeState):
+            """K decode steps in ONE dispatch. Host→device round-trips cost
+            ~100ms here (remote chip); amortizing them K× is the difference
+            between ~80 and >1000 tok/s aggregate (SURVEY §7 hard-part 3:
+            streaming latency discipline). Returns (state, tokens [K, B])."""
+            return jax.lax.scan(
+                lambda s, _: decode_one(s, params), state, None,
+                length=self.decode_block)
+
+        state_shard = self._state_shardings
+        self._prefill = jax.jit(prefill)
+        self._insert = jax.jit(
+            insert, donate_argnums=(0,),
+            out_shardings=state_shard)
+        self._decode = jax.jit(
+            decode_block, donate_argnums=(1,),
+            out_shardings=(state_shard, None) if state_shard else None)
+
+    # ------------------------------------------------------------------
+    # Host-side API (called by the scheduler's engine thread)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.prefill_buckets:
+            if prompt_len <= b:
+                return b
+        raise EngineError(
+            f"prompt of {prompt_len} tokens exceeds the largest prefill "
+            f"bucket ({self.prefill_buckets[-1]})")
+
+    def prefill_and_insert(self, slot: int, prompt_ids: list[int],
+                           sampling: SamplingParams) -> int:
+        """Prefill a prompt and install it in `slot`; returns first token."""
+        n = len(prompt_ids)
+        if n == 0:
+            raise EngineError("empty prompt")
+        bucket = self.bucket_for(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = prompt_ids
+
+        key = jax.random.key(sampling.seed) if sampling.seed is not None \
+            else jax.random.fold_in(jax.random.key(42), slot)
+        tok, prefix = self._prefill(
+            self.params, jnp.asarray(padded), jnp.int32(n),
+            jnp.float32(sampling.temperature), jnp.float32(sampling.top_p),
+            jnp.int32(sampling.top_k), key)
+        self.state = self._insert(
+            self.state, prefix, jnp.int32(slot), jnp.int32(n), tok,
+            jnp.float32(sampling.temperature), jnp.float32(sampling.top_p),
+            jnp.int32(sampling.top_k))
+        return int(tok)
+
+    def decode_steps(self) -> np.ndarray:
+        """decode_block tokens for every slot; host gets [K, B] int32."""
+        self.state, toks = self._decode(self.params, self.state)
+        return np.asarray(toks)
+
+    def decode_step(self) -> np.ndarray:
+        """One decode step [B] (requires decode_block == 1; tests/bench)."""
+        assert self.decode_block == 1, "decode_step needs decode_block=1"
+        return self.decode_steps()[0]
+
+    def slot_length(self, slot: int) -> int:
+        return int(self.state.cache.lengths[slot])
+
+    @property
+    def slot_capacity(self) -> int:
+        return self.max_seq_len
+
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_tpu_config(cls, tpu_cfg: Any, *, platform_devices=None
+                        ) -> "InferenceEngine":
+        """Build from a provider.yaml `tpu:` section (provider/config.py)."""
+        mesh_spec = MeshSpec.from_dict(tpu_cfg.mesh)
+        devices = platform_devices or jax.devices()
+        mesh = build_mesh(mesh_spec, devices) if mesh_spec.size > 1 else None
+
+        dtypes = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+                  "float16": jnp.float16}
+        if tpu_cfg.dtype not in dtypes:
+            raise EngineError(f"unsupported tpu.dtype {tpu_cfg.dtype!r}; "
+                              f"expected one of {sorted(dtypes)}")
+        dtype = dtypes[tpu_cfg.dtype]
+        tokenizer = get_tokenizer(tpu_cfg.tokenizer_path)
+
+        if tpu_cfg.checkpoint_path:
+            from symmetry_tpu.engine.weights import load_checkpoint
+
+            params, config = load_checkpoint(
+                tpu_cfg.checkpoint_path, mesh=mesh, dtype=dtype)
+        else:
+            config = preset(tpu_cfg.model_preset or "tiny")
+            params = init_params(config, jax.random.key(0), dtype)
+            if mesh is not None:
+                from symmetry_tpu.models.llama import param_logical_axes
+
+                params = jax.device_put(
+                    params, shardings_for(param_logical_axes(config), mesh))
+        return cls(
+            config, params, tokenizer, mesh=mesh,
+            max_slots=tpu_cfg.max_batch_size,
+            max_seq_len=tpu_cfg.max_seq_len,
+            prefill_buckets=tpu_cfg.prefill_buckets,
+            cache_dtype=dtype,
+            decode_block=getattr(tpu_cfg, "decode_block", 1),
+        )

@@ -1,0 +1,319 @@
+"""Continuous batching scheduler: requests in, per-request token streams out.
+
+The reference's hot loop pumped one HTTP response per peer with backpressure
+(reference: src/provider.ts:240-258). Here the equivalent loop is the decode
+step over a slot batch: requests are inserted the moment a slot frees
+(insert-on-arrival), every step advances all active slots one token, and
+slots are evicted on EOS / token budget / client cancellation — BASELINE
+config 3 (16 concurrent clients, continuous batching).
+
+Threading model: one dedicated engine thread owns all JAX calls (the engine
+is single-threaded by contract); asyncio callers talk to it through
+queue.Queue (in) and asyncio-loop-safe callbacks (out). This preserves the
+reference's "all concurrency in one event loop" simplicity (SURVEY §5.2)
+while keeping device dispatch off the loop.
+
+Slot-accounting invariants are checked every step when `debug_invariants`
+is on (SURVEY §5.2: an invariant-checking debug mode for the batch
+scheduler): a slot is in exactly one of {free, active}; an active slot's
+request has a live stream; cache length never exceeds capacity.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
+from symmetry_tpu.engine.tokenizer import StreamDecoder
+from symmetry_tpu.utils.logging import logger as log
+
+
+@dataclass
+class GenRequest:
+    """One generation job as the scheduler sees it."""
+
+    prompt_ids: list[int]
+    sampling: SamplingParams
+    max_new_tokens: int
+    # Called from the engine thread via loop.call_soon_threadsafe.
+    emit: Callable[["TokenEvent"], None]
+    cancelled: Callable[[], bool] = lambda: False
+    id: str = ""
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+
+@dataclass(slots=True)
+class TokenEvent:
+    """One streamed increment: text delta and/or terminal marker."""
+
+    text: str
+    token_id: int | None
+    done: bool = False
+    finish_reason: str | None = None  # "stop" | "length" | "cancelled" | "error"
+    error: str | None = None
+    # serving metrics (SURVEY §5.1: TTFT and tok/s are first-class)
+    ttft_s: float | None = None
+    tokens_generated: int = 0
+
+
+@dataclass
+class _ActiveSlot:
+    req: GenRequest
+    decoder: StreamDecoder
+    generated: int = 0
+    prompt_len: int = 0
+    first_token_at: float | None = None
+
+
+class Scheduler:
+    """Drives an InferenceEngine from a request queue on its own thread."""
+
+    def __init__(self, engine: InferenceEngine, *,
+                 debug_invariants: bool = False) -> None:
+        self.engine = engine
+        self._inbox: queue.Queue[GenRequest | None] = queue.Queue()
+        self._slots: dict[int, _ActiveSlot] = {}
+        self._free: list[int] = list(range(engine.max_slots))[::-1]
+        self._debug = debug_invariants
+        self._thread: threading.Thread | None = None
+        self._stopping = threading.Event()
+        self.metrics = {"requests": 0, "tokens": 0, "evictions": 0,
+                        "steps": 0, "peak_occupancy": 0}
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="engine-loop",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Graceful drain: no new inserts, finish active slots, then join.
+
+        (The reference never drained in-flight requests on shutdown —
+        SURVEY §3.4 calls that out; we do.)
+        """
+        self._stopping.set()
+        self._inbox.put(None)  # wake the loop
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def submit(self, req: GenRequest) -> None:
+        if self._stopping.is_set():
+            raise RuntimeError("scheduler is stopping")
+        self.metrics["requests"] += 1
+        self._inbox.put(req)
+
+    @property
+    def occupancy(self) -> int:
+        return len(self._slots)
+
+    # ------------------------------------------------------------- the loop
+
+    def _run(self) -> None:
+        """Thread target: contain crashes so no stream ever hangs open."""
+        try:
+            self._loop_forever()
+        except BaseException as exc:  # noqa: BLE001 — fatal engine failure
+            log.error(f"engine loop died: {exc!r}; failing open streams")
+            for slot, active in list(self._slots.items()):
+                self._emit(active, TokenEvent(
+                    text="", token_id=None, done=True, finish_reason="error",
+                    error=f"engine failure: {exc}"))
+                del self._slots[slot]
+            while True:
+                try:
+                    item = self._inbox.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not None:
+                    self._emit_cb(item, TokenEvent(
+                        text="", token_id=None, done=True,
+                        finish_reason="error", error=f"engine failure: {exc}"))
+            raise
+
+    def _loop_forever(self) -> None:
+        eos = self.engine.tokenizer.eos_ids
+        while True:
+            drained = self._admit_new()
+            if not self._slots:
+                if self._stopping.is_set() and drained:
+                    return
+                # Idle: block until work arrives (no busy spin).
+                item = self._inbox.get()
+                if item is None:
+                    if self._stopping.is_set():
+                        return
+                    continue
+                self._place(item)
+                continue
+
+            # One dispatch yields a [K, B] block of tokens (K = decode_block);
+            # host-side bookkeeping runs per block, not per step — a device
+            # read every step would sync a ~100ms round-trip each time
+            # (SURVEY §7 hard-part 3).
+            toks = self.engine.decode_steps()
+            self.metrics["steps"] += toks.shape[0]
+            now = time.monotonic()
+            K = toks.shape[0]
+            for slot, active in list(self._slots.items()):
+                if active.first_token_at is None:
+                    active.first_token_at = now
+                cancelled = active.req.cancelled()
+                finish = "cancelled" if cancelled else None
+                text_parts: list[str] = []
+                last_tok = None
+                for k in range(K):
+                    if finish is not None:
+                        break  # discard block remainder past the finish
+                    tok = int(toks[k, slot])
+                    last_tok = tok
+                    active.generated += 1
+                    self.metrics["tokens"] += 1
+                    if tok in eos:
+                        finish = "stop"
+                        break
+                    text_parts.append(active.decoder.push(tok))
+                    if active.generated >= active.req.max_new_tokens:
+                        finish = "length"
+                # The NEXT block grows every active slot's cache by K entries;
+                # a slot that can't absorb them must finish now (cache holds
+                # prompt_len + generated - 1 entries after this block).
+                if finish is None and (active.prompt_len + active.generated
+                                       + K > self.engine.slot_capacity):
+                    finish = "length"
+                text = "".join(text_parts)
+                if finish is None:
+                    if text:
+                        self._emit(active, TokenEvent(
+                            text=text, token_id=last_tok,
+                            tokens_generated=active.generated))
+                else:
+                    self._finish(slot, active, finish, last_tok, text)
+            if self._debug:
+                self._check_invariants()
+
+    def _admit_new(self) -> bool:
+        """Place queued requests into free slots. Returns True if inbox empty."""
+        while self._free:
+            try:
+                item = self._inbox.get_nowait()
+            except queue.Empty:
+                return True
+            if item is None:
+                continue
+            self._place(item)
+        return self._inbox.empty()
+
+    def _place(self, req: GenRequest) -> None:
+        if req.cancelled():
+            # Cancelled while queued still gets its terminal event — the
+            # consumer is awaiting it (same contract as active cancellation).
+            self._emit_cb(req, TokenEvent(
+                text="", token_id=None, done=True, finish_reason="cancelled"))
+            return
+        try:
+            slot = self._free.pop()
+        except IndexError:  # raced: requeue
+            self._inbox.put(req)
+            return
+        try:
+            first = self.engine.prefill_and_insert(slot, req.prompt_ids,
+                                                   req.sampling)
+        except Exception as exc:  # noqa: BLE001 — engine errors → stream error
+            self._free.append(slot)
+            log.error(f"prefill failed for request {req.id}: {exc}")
+            self._emit_cb(req, TokenEvent(
+                text="", token_id=None, done=True, finish_reason="error",
+                error=str(exc)))
+            return
+        active = _ActiveSlot(req=req, decoder=self.engine.tokenizer.stream_decoder(),
+                             prompt_len=len(req.prompt_ids))
+        active.first_token_at = time.monotonic()
+        self._slots[slot] = active
+        self.metrics["peak_occupancy"] = max(self.metrics["peak_occupancy"],
+                                             len(self._slots))
+        active.generated = 1
+        if first in self.engine.tokenizer.eos_ids:
+            self._finish(slot, active, "stop", first, "")
+            return
+        # A prompt so long the cache can't absorb one more decode block must
+        # finish now — otherwise the block's KV writes land past capacity
+        # (silently dropped scatters) and the client would stream garbage.
+        if (active.prompt_len + active.generated + self.engine.decode_block
+                > self.engine.slot_capacity):
+            text = active.decoder.push(first)
+            self._finish(slot, active, "length", first, text)
+            return
+        text = active.decoder.push(first)
+        if text:
+            self._emit(active, TokenEvent(
+                text=text, token_id=first, tokens_generated=1,
+                ttft_s=active.first_token_at - req.enqueued_at))
+
+    def _finish(self, slot: int, active: _ActiveSlot, reason: str,
+                tok: int | None, text: str) -> None:
+        tail = text + active.decoder.flush()
+        ttft = (active.first_token_at - active.req.enqueued_at
+                if active.first_token_at else None)
+        self._emit(active, TokenEvent(
+            text=tail, token_id=tok, done=True, finish_reason=reason,
+            ttft_s=ttft, tokens_generated=active.generated))
+        del self._slots[slot]
+        self._free.append(slot)
+        self.metrics["evictions"] += 1
+
+    def _emit(self, active: _ActiveSlot, ev: TokenEvent) -> None:
+        self._emit_cb(active.req, ev)
+
+    @staticmethod
+    def _emit_cb(req: GenRequest, ev: TokenEvent) -> None:
+        try:
+            req.emit(ev)
+        except Exception as exc:  # noqa: BLE001 — emit must never kill the loop
+            log.error(f"emit callback failed for request {req.id}: {exc}")
+
+    def _check_invariants(self) -> None:
+        active = set(self._slots)
+        free = set(self._free)
+        assert not (active & free), f"slot in both active and free: {active & free}"
+        assert active | free == set(range(self.engine.max_slots)), \
+            "slot leak: some slot neither active nor free"
+        for slot in active:
+            assert self.engine.slot_length(slot) <= self.engine.slot_capacity
+
+
+class AsyncSession:
+    """Asyncio-side handle: submit a request, async-iterate token events."""
+
+    def __init__(self, scheduler: Scheduler, *,
+                 loop: asyncio.AbstractEventLoop | None = None) -> None:
+        self._scheduler = scheduler
+        self._loop = loop or asyncio.get_event_loop()
+        self._queue: asyncio.Queue[TokenEvent] = asyncio.Queue()
+        self._cancelled = False
+
+    def cancel(self) -> None:
+        self._cancelled = True
+
+    def submit(self, prompt_ids: list[int], sampling: SamplingParams,
+               max_new_tokens: int, request_id: str = "") -> None:
+        def emit(ev: TokenEvent) -> None:
+            self._loop.call_soon_threadsafe(self._queue.put_nowait, ev)
+
+        self._scheduler.submit(GenRequest(
+            prompt_ids=prompt_ids, sampling=sampling,
+            max_new_tokens=max_new_tokens, emit=emit,
+            cancelled=lambda: self._cancelled, id=request_id))
+
+    async def events(self):
+        while True:
+            ev = await self._queue.get()
+            yield ev
+            if ev.done:
+                return

@@ -223,19 +223,18 @@ def _layer(
     return h, ck, cv
 
 
-def forward(
+def forward_hidden(
     params: dict,
     config: ModelConfig,
     tokens: jnp.ndarray,      # [B, S] int32
     cache: KVCache,           # lengths[b] = tokens already in cache for slot b
     seq_lens: jnp.ndarray | None = None,  # [B] valid tokens in `tokens`; None = all S
 ) -> tuple[jnp.ndarray, KVCache]:
-    """Run the decoder; returns (logits [B, S, vocab] f32, updated cache).
+    """Decoder trunk: returns (final-norm hidden states [B, S, E], cache).
 
-    Serves prefill (S = padded prompt length, cache.lengths typically 0) and
-    decode (S = 1 per slot) with the same traced computation. Logits at
-    padded positions are garbage by contract; callers index the last valid
-    position.
+    Split from the LM head so prefill can project only the last valid
+    position — at 128k vocab the head matmul over a full padded bucket would
+    dominate prefill cost.
     """
     B, S = tokens.shape
     if seq_lens is None:
@@ -253,9 +252,32 @@ def forward(
     h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], cache.k, cache.v))
 
     h = rms_norm(h, params["final_norm"], config.rms_eps)
+    return h, KVCache(k=new_k, v=new_v, lengths=kv_valid)
+
+
+def logits_from_hidden(params: dict, config: ModelConfig,
+                       h: jnp.ndarray) -> jnp.ndarray:
+    """LM head: [B, S, E] hidden -> [B, S, vocab] float32 logits."""
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    logits = (h @ head).astype(jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v, lengths=kv_valid)
+    return (h @ head).astype(jnp.float32)
+
+
+def forward(
+    params: dict,
+    config: ModelConfig,
+    tokens: jnp.ndarray,      # [B, S] int32
+    cache: KVCache,
+    seq_lens: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Run the decoder; returns (logits [B, S, vocab] f32, updated cache).
+
+    Serves prefill (S = padded prompt length, cache.lengths typically 0) and
+    decode (S = 1 per slot) with the same traced computation. Logits at
+    padded positions are garbage by contract; callers index the last valid
+    position.
+    """
+    h, cache = forward_hidden(params, config, tokens, cache, seq_lens)
+    return logits_from_hidden(params, config, h), cache
 
 
 # ---------------------------------------------------------------------------

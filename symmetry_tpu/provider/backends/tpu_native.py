@@ -1,0 +1,115 @@
+"""tpu_native backend: the in-process JAX engine as an apiProvider.
+
+The flagship of the rebuild (BASELINE.json north star): where the reference
+could only proxy to an external GPU server (reference: src/provider.ts:
+210-214), this backend hosts the model itself — HF weights pjit-sharded over
+the provider's TPU slice, continuous batching across peers, tokens streamed
+back as OpenAI-style chat.completion.chunk SSE lines so existing clients
+can't tell the difference (same wire format the proxy backends forward).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Any, AsyncIterator
+
+from symmetry_tpu.engine.engine import EngineError, InferenceEngine, SamplingParams
+from symmetry_tpu.engine.scheduler import AsyncSession, Scheduler
+from symmetry_tpu.provider.backends.base import (
+    BackendError,
+    InferenceBackend,
+    InferenceRequest,
+    StreamChunk,
+)
+from symmetry_tpu.utils.logging import logger as log
+
+DEFAULT_MAX_NEW_TOKENS = 512
+
+
+class TpuNativeBackend(InferenceBackend):
+    name = "tpu_native"
+
+    def __init__(self, config: Any) -> None:
+        self._config = config
+        self._model_name = config.model_name
+        self._engine: InferenceEngine | None = None
+        self._scheduler: Scheduler | None = None
+
+    async def start(self) -> None:
+        """Load weights and start the engine thread (may take minutes for
+        large checkpoints; runs in a worker thread to keep the loop live)."""
+        if self._engine is not None:
+            return
+        tpu_cfg = self._config.tpu
+
+        def build() -> InferenceEngine:
+            return InferenceEngine.from_tpu_config(tpu_cfg)
+
+        self._engine = await asyncio.to_thread(build)
+        self._scheduler = Scheduler(self._engine)
+        self._scheduler.start()
+        log.info(
+            f"tpu_native engine up: model={self._model_name} "
+            f"slots={self._engine.max_slots} seq={self._engine.max_seq_len}")
+
+    async def stop(self) -> None:
+        if self._scheduler is not None:
+            await asyncio.to_thread(self._scheduler.stop)
+            self._scheduler = None
+            self._engine = None
+
+    async def healthy(self) -> bool:
+        """Engine liveness: a wedged decode loop must fail this (SURVEY §5.3
+        — an engine wedge unregisters the provider)."""
+        if self._engine is None or self._scheduler is None:
+            return False
+        thread = self._scheduler._thread
+        return thread is not None and thread.is_alive()
+
+    async def stream(self, request: InferenceRequest) -> AsyncIterator[StreamChunk]:
+        if self._engine is None or self._scheduler is None:
+            raise BackendError("tpu_native backend not started")
+        engine = self._engine
+
+        try:
+            prompt_ids = engine.tokenizer.apply_chat_template(request.messages)
+        except Exception as exc:  # tokenizer/template failure
+            raise BackendError(f"tokenization failed: {exc}") from exc
+
+        max_new = request.max_tokens or DEFAULT_MAX_NEW_TOKENS
+        session = AsyncSession(self._scheduler,
+                               loop=asyncio.get_running_loop())
+        request_id = f"chatcmpl-{uuid.uuid4().hex[:16]}"
+        session.submit(prompt_ids, SamplingParams.from_request(request),
+                       max_new, request_id=request_id)
+        created = int(time.time())
+
+        def chunk_line(delta: dict, finish: str | None = None) -> str:
+            payload = {
+                "id": request_id,
+                "object": "chat.completion.chunk",
+                "created": created,
+                "model": self._model_name,
+                "choices": [{"index": 0, "delta": delta,
+                             "finish_reason": finish}],
+            }
+            return f"data: {json.dumps(payload)}"
+
+        try:
+            yield StreamChunk(raw=chunk_line({"role": "assistant"}), text="")
+            async for ev in session.events():
+                if ev.error is not None:
+                    raise BackendError(ev.error)
+                if ev.text:
+                    yield StreamChunk(raw=chunk_line({"content": ev.text}),
+                                      text=ev.text)
+                if ev.done:
+                    yield StreamChunk(
+                        raw=chunk_line({}, finish=ev.finish_reason or "stop"),
+                        text="")
+                    yield StreamChunk(raw="data: [DONE]", text="", done=True)
+        finally:
+            session.cancel()  # no-op if complete; frees the slot if client left

@@ -46,6 +46,7 @@ class TpuConfig:
     max_batch_size: int = 8            # decode slots (continuous batching)
     max_seq_len: int = 2048            # KV capacity per slot
     prefill_buckets: tuple[int, ...] = (128, 512, 2048)
+    decode_block: int = 8              # decode steps per device dispatch
     checkpoint_path: str | None = None  # HF safetensors dir; None → random init
     tokenizer_path: str | None = None   # tokenizer.json; None → byte tokenizer
     model_family: str = "llama"         # models/registry key

@@ -1,0 +1,342 @@
+"""Engine + continuous-batching scheduler tests (tiny model, CPU).
+
+The key property: a continuous batch must be invisible to each request —
+greedy tokens from a slot-batched engine equal tokens from a plain
+sequential forward loop, regardless of what the other slots are doing.
+"""
+
+import asyncio
+import queue
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symmetry_tpu.engine.engine import (
+    EngineError,
+    InferenceEngine,
+    SamplingParams,
+)
+from symmetry_tpu.engine.scheduler import GenRequest, Scheduler, TokenEvent
+from symmetry_tpu.engine.tokenizer import ByteTokenizer
+from symmetry_tpu.models import forward, init_cache, init_params, preset
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = preset("tiny")
+    params = init_params(cfg, jax.random.key(0), jnp.float32)
+    return cfg, params
+
+
+def make_engine(cfg, params, slots=2, seq=64, buckets=(16, 32), block=1):
+    return InferenceEngine(cfg, params, ByteTokenizer(), max_slots=slots,
+                           max_seq_len=seq, prefill_buckets=buckets,
+                           cache_dtype=jnp.float32, decode_block=block)
+
+
+def reference_greedy(cfg, params, prompt_ids, n_tokens):
+    """Plain sequential decode loop — the engine must reproduce this."""
+    cache = init_cache(cfg, 1, 64, jnp.float32)
+    tokens = jnp.asarray([prompt_ids], jnp.int32)
+    logits, cache = forward(params, cfg, tokens, cache)
+    out = []
+    last = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+    out.append(int(last[0]))
+    for _ in range(n_tokens - 1):
+        logits, cache = forward(params, cfg, last[:, None], cache)
+        last = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+        out.append(int(last[0]))
+    return out
+
+
+def run_scheduler_requests(engine, requests):
+    """Drive a Scheduler synchronously; returns per-request event lists."""
+    sched = Scheduler(engine, debug_invariants=True)
+    results = {i: [] for i in range(len(requests))}
+    done = {i: threading.Event() for i in range(len(requests))}
+
+    for i, (ids, sampling, max_new) in enumerate(requests):
+        def emit(ev, i=i):
+            results[i].append(ev)
+            if ev.done:
+                done[i].set()
+        sched.submit(GenRequest(prompt_ids=ids, sampling=sampling,
+                                max_new_tokens=max_new, emit=emit,
+                                id=f"r{i}"))
+    sched.start()
+    for ev in done.values():
+        assert ev.wait(120), "request did not complete"
+    sched.stop()
+    return results
+
+
+class TestEnginePrimitives:
+    def test_greedy_matches_reference(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params)
+        prompt = list(b"hello world")
+        want = reference_greedy(cfg, params, prompt, 8)
+
+        first = engine.prefill_and_insert(0, prompt, SamplingParams())
+        got = [first]
+        for _ in range(7):
+            got.append(int(engine.decode_step()[0]))
+        assert got == want
+
+    def test_two_slots_independent(self, setup):
+        """Slot 1's stream must not perturb slot 0's greedy tokens."""
+        cfg, params = setup
+        engine = make_engine(cfg, params)
+        pa, pb = list(b"first prompt"), list(b"second, quite different")
+        want_a = reference_greedy(cfg, params, pa, 6)
+        want_b = reference_greedy(cfg, params, pb, 6)
+
+        got_a = [engine.prefill_and_insert(0, pa, SamplingParams())]
+        # Interleave: insert b after a has started decoding.
+        got_a.append(int(engine.decode_step()[0]))
+        got_b = [engine.prefill_and_insert(1, pb, SamplingParams())]
+        for _ in range(4):
+            toks = engine.decode_step()
+            got_a.append(int(toks[0]))
+            got_b.append(int(toks[1]))
+        got_b.append(int(engine.decode_step()[1]))
+        assert got_a == want_a
+        assert got_b == want_b
+
+    def test_prompt_too_long_raises(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params, buckets=(16,))
+        with pytest.raises(EngineError, match="exceeds"):
+            engine.prefill_and_insert(0, list(range(40)), SamplingParams())
+
+    def test_bucket_selection(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params, buckets=(16, 32))
+        assert engine.bucket_for(3) == 16
+        assert engine.bucket_for(16) == 16
+        assert engine.bucket_for(17) == 32
+
+
+class TestScheduler:
+    def test_streams_match_reference(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params)
+        pa, pb = list(b"alpha beta"), list(b"gamma")
+        results = run_scheduler_requests(engine, [
+            (pa, SamplingParams(), 6),
+            (pb, SamplingParams(), 6),
+        ])
+        for ids, res in ((pa, results[0]), (pb, results[1])):
+            want = reference_greedy(cfg, params, ids, 6)
+            want_text = ByteTokenizer().decode(want)
+            got_text = "".join(ev.text for ev in res)
+            # Events carry only completed text; the concatenation must equal
+            # the reference decode (modulo a trailing incomplete codepoint,
+            # which flush renders as replacement chars).
+            assert got_text.rstrip("�") == want_text.rstrip("�")
+            assert res[-1].done
+            assert res[-1].finish_reason in ("length", "stop")
+
+    def test_more_requests_than_slots(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params, slots=2)
+        sched = Scheduler(engine, debug_invariants=True)
+        results = {i: [] for i in range(5)}
+        done = {i: threading.Event() for i in range(5)}
+        for i in range(5):
+            def emit(ev, i=i):
+                results[i].append(ev)
+                if ev.done:
+                    done[i].set()
+            sched.submit(GenRequest(prompt_ids=list(b"req %d" % i),
+                                    sampling=SamplingParams(),
+                                    max_new_tokens=4, emit=emit, id=f"r{i}"))
+        sched.start()
+        for ev in done.values():
+            assert ev.wait(120)
+        assert all(res[-1].done for res in results.values())
+        # All slots free after drain; none leaked.
+        assert sched.occupancy == 0
+        assert sorted(sched._free) == [0, 1]
+        sched.stop()
+
+    def test_block_decode_matches_single_step(self, setup):
+        """decode_block=4 must stream the same text as decode_block=1."""
+        cfg, params = setup
+        prompt = list(b"block decoding test")
+        out = {}
+        for block in (1, 4):
+            engine = make_engine(cfg, params, block=block)
+            results = run_scheduler_requests(
+                engine, [(prompt, SamplingParams(), 10)])
+            out[block] = ("".join(ev.text for ev in results[0]),
+                          results[0][-1].finish_reason,
+                          results[0][-1].tokens_generated)
+        assert out[1] == out[4]
+
+    def test_eos_finishes_stream(self, setup):
+        """Force EOS by making it the argmax everywhere: bias the lm head."""
+        cfg, params = setup
+        eos = ByteTokenizer().EOS
+        biased = dict(params)
+        lm = np.array(params["lm_head"])
+        lm[:, eos] = 10.0
+        biased["lm_head"] = jnp.asarray(lm)
+        engine = make_engine(cfg, biased)
+        results = run_scheduler_requests(
+            engine, [(list(b"hi"), SamplingParams(), 50)])
+        assert results[0][-1].finish_reason == "stop"
+        assert results[0][-1].tokens_generated <= 2
+
+    def test_capacity_eviction(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params, seq=20, buckets=(16,))
+        results = run_scheduler_requests(
+            engine, [(list(b"0123456789"), SamplingParams(), 500)])
+        last = results[0][-1]
+        assert last.done and last.finish_reason == "length"
+        # 10 prompt + g generated reaches capacity 20 at g=10.
+        assert last.tokens_generated == 10
+
+    def test_cancellation_frees_slot(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params, slots=1)
+        sched = Scheduler(engine, debug_invariants=True)
+        events: list[TokenEvent] = []
+        done = threading.Event()
+        cancelled = threading.Event()
+
+        def emit(ev):
+            events.append(ev)
+            if len(events) >= 2:
+                cancelled.set()
+            if ev.done:
+                done.set()
+
+        sched.submit(GenRequest(
+            prompt_ids=list(b"cancel me"), sampling=SamplingParams(),
+            max_new_tokens=10_000, emit=emit,
+            cancelled=cancelled.is_set, id="c"))
+        sched.start()
+        assert done.wait(120)
+        assert events[-1].finish_reason == "cancelled"
+        # Slot must be reusable afterwards.
+        done2 = threading.Event()
+        sched.submit(GenRequest(
+            prompt_ids=list(b"next"), sampling=SamplingParams(),
+            max_new_tokens=3, emit=lambda ev: ev.done and done2.set(),
+            id="n"))
+        assert done2.wait(120)
+        sched.stop()
+
+    def test_engine_crash_fails_open_streams(self, setup):
+        """A dying engine loop must emit error events, never hang streams."""
+        cfg, params = setup
+        engine = make_engine(cfg, params)
+        engine.decode_steps = lambda: (_ for _ in ()).throw(
+            RuntimeError("device wedged"))
+        sched = Scheduler(engine)
+        events = []
+        done = threading.Event()
+
+        def emit(ev):
+            events.append(ev)
+            if ev.done:
+                done.set()
+
+        sched.submit(GenRequest(prompt_ids=list(b"boom"),
+                                sampling=SamplingParams(),
+                                max_new_tokens=10, emit=emit, id="x"))
+        sched.start()
+        assert done.wait(60)
+        assert events[-1].finish_reason == "error"
+        assert "device wedged" in events[-1].error
+
+    def test_cancelled_while_queued_gets_terminal_event(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params, slots=1)
+        sched = Scheduler(engine)
+        ev_a_done = threading.Event()
+        ev_b = []
+        ev_b_done = threading.Event()
+        b_cancelled = threading.Event()
+        b_cancelled.set()  # cancelled before it ever reaches a slot
+
+        sched.submit(GenRequest(prompt_ids=list(b"occupier"),
+                                sampling=SamplingParams(), max_new_tokens=6,
+                                emit=lambda ev: ev.done and ev_a_done.set(),
+                                id="a"))
+        sched.submit(GenRequest(prompt_ids=list(b"queued"),
+                                sampling=SamplingParams(), max_new_tokens=6,
+                                emit=lambda ev: (ev_b.append(ev),
+                                                 ev.done and ev_b_done.set()),
+                                cancelled=b_cancelled.is_set, id="b"))
+        sched.start()
+        assert ev_a_done.wait(120)
+        assert ev_b_done.wait(120)
+        assert ev_b[-1].finish_reason == "cancelled"
+        sched.stop()
+
+    def test_overlong_prompt_finishes_immediately(self, setup):
+        """Prompt with no decode headroom: first token, then length-finish —
+        never a decode block whose KV writes would be dropped."""
+        cfg, params = setup
+        engine = make_engine(cfg, params, seq=20, buckets=(16,), block=8)
+        results = run_scheduler_requests(
+            engine, [(list(b"0123456789abcdef"), SamplingParams(), 100)])
+        last = results[0][-1]
+        assert last.done and last.finish_reason == "length"
+        assert last.tokens_generated == 1
+
+    def test_ttft_metric_reported(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params)
+        results = run_scheduler_requests(
+            engine, [(list(b"metrics"), SamplingParams(), 3)])
+        ttfts = [ev.ttft_s for ev in results[0] if ev.ttft_s is not None]
+        assert ttfts and all(t >= 0 for t in ttfts)
+
+
+class TestTpuNativeBackend:
+    def test_openai_sse_stream(self, setup):
+        from symmetry_tpu.provider.backends.tpu_native import TpuNativeBackend
+        from symmetry_tpu.provider.config import ConfigManager
+
+        cfg_mgr = ConfigManager(config={
+            "name": "t", "public": False, "serverKey": "00" * 32,
+            "modelName": "tiny-test", "apiProvider": "tpu_native",
+            "tpu": {"model_preset": "tiny", "dtype": "float32",
+                    "max_batch_size": 2, "max_seq_len": 64,
+                    "prefill_buckets": [16, 32]},
+        })
+
+        async def drive():
+            import json as _json
+
+            backend = TpuNativeBackend(cfg_mgr)
+            await backend.start()
+            assert await backend.healthy()
+            chunks = []
+            from symmetry_tpu.provider.backends.base import InferenceRequest
+
+            async for ch in backend.stream(InferenceRequest(
+                    messages=[{"role": "user", "content": "ping"}],
+                    max_tokens=5)):
+                chunks.append(ch)
+            await backend.stop()
+            assert not await backend.healthy()
+
+            assert chunks[0].raw.startswith("data: ")
+            first = _json.loads(chunks[0].raw[6:])
+            assert first["choices"][0]["delta"] == {"role": "assistant"}
+            assert first["model"] == "tiny-test"
+            assert chunks[-1].raw == "data: [DONE]"
+            assert chunks[-1].done
+            fin = _json.loads(chunks[-2].raw[6:])
+            assert fin["choices"][0]["finish_reason"] in ("length", "stop")
+            return True
+
+        assert asyncio.run(asyncio.wait_for(drive(), 180))
