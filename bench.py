@@ -21,7 +21,7 @@ import time
 
 def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
               max_seq: int, dtype_name: str, mesh_model: int,
-              block: int = 1) -> dict:
+              block: int = 1, quant: str | None = None) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -39,6 +39,13 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
         mesh = build_mesh(MeshSpec(data=1, model=mesh_model))
         params = jax.device_put(
             params, shardings_for(param_logical_axes(config), mesh))
+
+    # Quantize AFTER placement: the dense sharding tree doesn't prefix-match
+    # QuantizedTensor leaves; the jitted quantize preserves input shardings.
+    if quant == "int8":
+        from symmetry_tpu.models.llama import quantize_params
+
+        params = quantize_params(params)
 
     engine = InferenceEngine(
         config, params, ByteTokenizer(), mesh=mesh, max_slots=slots,
@@ -63,6 +70,8 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
 
     done_steps = n_disp * block
     tok_s = slots * done_steps / dt
+    dtype_label = f"{dtype_name}+{quant}" if quant else dtype_name
+    dtype_name = dtype_label
     return {
         "metric": f"aggregate decode tok/s ({preset_name} {dtype_name}, "
                   f"{slots} slots, block {block}, "
@@ -91,6 +100,8 @@ def main() -> None:
                     help="model-axis mesh size (tensor parallelism)")
     ap.add_argument("--block", type=int, default=16,
                     help="decode steps per device dispatch")
+    ap.add_argument("--quant", default=None, choices=(None, "int8"),
+                    help="weight quantization")
     args = ap.parse_args()
 
     if args.smoke:
@@ -106,7 +117,7 @@ def main() -> None:
         result = run_bench(args.preset, slots=args.slots, steps=args.steps,
                            prompt_len=args.prompt_len, max_seq=args.max_seq,
                            dtype_name=args.dtype, mesh_model=args.mesh_model,
-                           block=args.block)
+                           block=args.block, quant=args.quant)
     print(json.dumps(result))
 
 

@@ -21,6 +21,7 @@ concurrency in one event loop (SURVEY §5.2).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any, NamedTuple
 
@@ -148,6 +149,10 @@ class InferenceEngine:
             # or donated-buffer aliasing fails on the first insert.
             self.state = jax.device_put(self.state, self._state_shardings)
 
+        self._base_key = jax.random.key(
+            int.from_bytes(os.urandom(4), "little"))
+        self._requests_served = 0
+
         self._build_jits()
 
     # ------------------------------------------------------------------
@@ -167,7 +172,8 @@ class InferenceEngine:
                 lengths=jnp.zeros((1,), jnp.int32),
             )
             h, cache = forward_hidden(params, cfg, tokens, cache,
-                                      seq_lens=true_len[None])
+                                      seq_lens=true_len[None],
+                                      prefill_flash=True)
             # Project ONLY the last valid position through the LM head —
             # head cost is per-position × vocab, and padded positions are
             # garbage anyway.
@@ -256,8 +262,13 @@ class InferenceEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = prompt_ids
 
-        key = jax.random.key(sampling.seed) if sampling.seed is not None \
-            else jax.random.fold_in(jax.random.key(42), slot)
+        if sampling.seed is not None:
+            key = jax.random.key(sampling.seed)
+        else:
+            # Per-request entropy: a fixed per-slot key would make the same
+            # unseeded prompt sample the same first token on every request.
+            self._requests_served += 1
+            key = jax.random.fold_in(self._base_key, self._requests_served)
         tok, prefix = self._prefill(
             self.params, jnp.asarray(padded), jnp.int32(n),
             jnp.float32(sampling.temperature), jnp.float32(sampling.top_p),
@@ -316,6 +327,13 @@ class InferenceEngine:
 
                 params = jax.device_put(
                     params, shardings_for(param_logical_axes(config), mesh))
+        if tpu_cfg.quantization == "int8":
+            from symmetry_tpu.models.llama import quantize_params
+
+            params = quantize_params(params)
+        elif tpu_cfg.quantization is not None:
+            raise EngineError(
+                f"unsupported tpu.quantization {tpu_cfg.quantization!r}")
         return cls(
             config, params, tokenizer, mesh=mesh,
             max_slots=tpu_cfg.max_batch_size,

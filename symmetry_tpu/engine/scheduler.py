@@ -162,8 +162,6 @@ class Scheduler:
             now = time.monotonic()
             K = toks.shape[0]
             for slot, active in list(self._slots.items()):
-                if active.first_token_at is None:
-                    active.first_token_at = now
                 cancelled = active.req.cancelled()
                 finish = "cancelled" if cancelled else None
                 text_parts: list[str] = []
@@ -242,10 +240,13 @@ class Scheduler:
         if first in self.engine.tokenizer.eos_ids:
             self._finish(slot, active, "stop", first, "")
             return
-        # A prompt so long the cache can't absorb one more decode block must
-        # finish now — otherwise the block's KV writes land past capacity
-        # (silently dropped scatters) and the client would stream garbage.
-        if (active.prompt_len + active.generated + self.engine.decode_block
+        # Finish before the first decode block if (a) the request's token
+        # budget is already spent by the prefill token, or (b) the prompt is
+        # so long the cache can't absorb one more block — otherwise the
+        # block's KV writes land past capacity (silently dropped scatters)
+        # and the client would stream garbage.
+        if (active.generated >= req.max_new_tokens
+                or active.prompt_len + active.generated + self.engine.decode_block
                 > self.engine.slot_capacity):
             text = active.decoder.push(first)
             self._finish(slot, active, "length", first, text)

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from symmetry_tpu.ops.attention import gqa_attention
 from symmetry_tpu.ops.norm import rms_norm
+from symmetry_tpu.ops.quant import QuantizedTensor, qmatmul, quantize_tree
 from symmetry_tpu.ops.rope import apply_rope
 
 
@@ -192,35 +193,55 @@ def cache_logical_axes() -> KVCache:
 def _layer(
     h: jnp.ndarray,             # [B, S, E]
     lp: dict,                   # one layer's params (leading L dim stripped)
-    ck: jnp.ndarray,            # [B, T, K, D] this layer's key cache
-    cv: jnp.ndarray,
+    all_k: jnp.ndarray,         # [L, B, T, K, D] FULL key cache
+    all_v: jnp.ndarray,
+    layer: jnp.ndarray,         # scalar int32 layer index
     positions: jnp.ndarray,     # [B, S]
     kv_valid: jnp.ndarray,      # [B] cache length AFTER this call's writes
+    seq_lens: jnp.ndarray,      # [B] valid tokens in this call's input
     config: ModelConfig,
+    prefill_flash: bool,        # static: flash self-attention (fresh cache)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     B, S, E = h.shape
     D, nq, nkv = config.dim_per_head, config.num_heads, config.num_kv_heads
 
     x = rms_norm(h, lp["attn_norm"], config.rms_eps)
-    q = (x @ lp["wq"]).reshape(B, S, nq, D)
-    k = (x @ lp["wk"]).reshape(B, S, nkv, D)
-    v = (x @ lp["wv"]).reshape(B, S, nkv, D)
+    q = qmatmul(x, lp["wq"]).reshape(B, S, nq, D)
+    k = qmatmul(x, lp["wk"]).reshape(B, S, nkv, D)
+    v = qmatmul(x, lp["wv"]).reshape(B, S, nkv, D)
     q = apply_rope(q, positions, config.rope_theta)
     k = apply_rope(k, positions, config.rope_theta)
 
-    # Scatter the new K/V into the cache at their absolute positions. Padded
-    # tail tokens write garbage past kv_valid — never read, overwritten later.
+    # Scatter the new K/V straight into the full cache at (layer, batch,
+    # position) — an in-place row write on the scan carry; a per-layer
+    # slice-out/slice-in would stream the whole layer slice through HBM.
+    # Padded tail tokens write garbage past kv_valid — never read,
+    # overwritten later.
     b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
-    ck = ck.at[b_idx, positions].set(k.astype(ck.dtype))
-    cv = cv.at[b_idx, positions].set(v.astype(cv.dtype))
+    l_idx = jnp.full((B, S), layer, jnp.int32)
+    all_k = all_k.at[l_idx, b_idx, positions].set(k.astype(all_k.dtype))
+    all_v = all_v.at[l_idx, b_idx, positions].set(v.astype(all_v.dtype))
 
-    attn = gqa_attention(q, ck, cv, positions, kv_valid,
-                         sliding_window=config.sliding_window)
-    h = h + attn.reshape(B, S, nq * D) @ lp["wo"]
+    if prefill_flash:
+        # Prefill-from-empty: attention is over this call's own K/V — the
+        # Pallas kernel streams K/V blocks through VMEM instead of
+        # materializing [H, S, S] scores (ops/flash.py); the cache slice is
+        # never read back.
+        from symmetry_tpu.ops.flash import flash_prefill
+
+        attn = flash_prefill(q, k, v, seq_lens,
+                             interpret=jax.default_backend() != "tpu")
+    else:
+        ck = jax.lax.dynamic_index_in_dim(all_k, layer, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(all_v, layer, 0, keepdims=False)
+        attn = gqa_attention(q, ck, cv, positions, kv_valid,
+                             sliding_window=config.sliding_window)
+    h = h + qmatmul(attn.reshape(B, S, nq * D), lp["wo"])
 
     x = rms_norm(h, lp["mlp_norm"], config.rms_eps)
-    h = h + (jax.nn.silu(x @ lp["wg"]) * (x @ lp["wu"])) @ lp["wd"]
-    return h, ck, cv
+    h = h + qmatmul(jax.nn.silu(qmatmul(x, lp["wg"])) * qmatmul(x, lp["wu"]),
+                    lp["wd"])
+    return h, all_k, all_v
 
 
 def forward_hidden(
@@ -229,27 +250,41 @@ def forward_hidden(
     tokens: jnp.ndarray,      # [B, S] int32
     cache: KVCache,           # lengths[b] = tokens already in cache for slot b
     seq_lens: jnp.ndarray | None = None,  # [B] valid tokens in `tokens`; None = all S
+    *,
+    prefill_flash: bool = False,  # static: caller guarantees cache is empty
 ) -> tuple[jnp.ndarray, KVCache]:
     """Decoder trunk: returns (final-norm hidden states [B, S, E], cache).
 
     Split from the LM head so prefill can project only the last valid
     position — at 128k vocab the head matmul over a full padded bucket would
     dominate prefill cost.
+
+    prefill_flash=True routes attention through the Pallas flash kernel
+    (valid only when cache.lengths are all zero — engine prefill's case);
+    sliding-window models fall back to the masked path.
     """
     B, S = tokens.shape
     if seq_lens is None:
         seq_lens = jnp.full((B,), S, jnp.int32)
     positions = cache.lengths[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
     kv_valid = cache.lengths + seq_lens
+    use_flash = prefill_flash and S > 1 and config.sliding_window is None
+
+    def body(carry, xs):
+        # The cache rides the CARRY, scatter-updated in place: scan xs/ys
+        # would stream the full [L, B, T, K, D] arrays through HBM every
+        # forward — at decode that re-writes ~0.5 GB per token.
+        h, all_k, all_v = carry
+        lp, l = xs
+        h, all_k, all_v = _layer(h, lp, all_k, all_v, l, positions, kv_valid,
+                                 seq_lens, config, use_flash)
+        return (h, all_k, all_v), None
 
     h = jnp.take(params["embed"], tokens, axis=0)
 
-    def body(h, xs):
-        lp, ck, cv = xs
-        h, ck, cv = _layer(h, lp, ck, cv, positions, kv_valid, config)
-        return h, (ck, cv)
-
-    h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], cache.k, cache.v))
+    (h, new_k, new_v), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v),
+        (params["layers"], jnp.arange(config.num_layers, dtype=jnp.int32)))
 
     h = rms_norm(h, params["final_norm"], config.rms_eps)
     return h, KVCache(k=new_k, v=new_v, lengths=kv_valid)
@@ -259,7 +294,36 @@ def logits_from_hidden(params: dict, config: ModelConfig,
                        h: jnp.ndarray) -> jnp.ndarray:
     """LM head: [B, S, E] hidden -> [B, S, vocab] float32 logits."""
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    return (h @ head).astype(jnp.float32)
+    return qmatmul(h, head).astype(jnp.float32)
+
+
+# Weights eligible for int8 quantization (all the large matmuls; the
+# embedding stays dense — it is gathered, not contracted).
+QUANT_KEYS = ("wq", "wk", "wv", "wo", "wg", "wu", "wd", "lm_head")
+
+
+def quantize_params(params: dict) -> dict:
+    """In-place int8 quantization of all QUANT_KEYS leaves (ops/quant.py)."""
+    return quantize_tree(params, QUANT_KEYS)
+
+
+def quantized_logical_axes(axes: dict) -> dict:
+    """Map a dense logical-axes tree to its quantized counterpart: the int8
+    payload keeps the dense axes; per-column scales drop the contraction
+    (second-to-last) axis."""
+    def visit(node):
+        out = {}
+        for name, child in node.items():
+            if isinstance(child, dict):
+                out[name] = visit(child)
+            elif name in QUANT_KEYS:
+                out[name] = QuantizedTensor(
+                    q=child, scale=child[:-2] + child[-1:])
+            else:
+                out[name] = child
+        return out
+
+    return visit(axes)
 
 
 def forward(

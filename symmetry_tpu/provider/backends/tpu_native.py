@@ -79,7 +79,10 @@ class TpuNativeBackend(InferenceBackend):
         except Exception as exc:  # tokenizer/template failure
             raise BackendError(f"tokenization failed: {exc}") from exc
 
-        max_new = request.max_tokens or DEFAULT_MAX_NEW_TOKENS
+        max_new = (request.max_tokens if request.max_tokens is not None
+                   else DEFAULT_MAX_NEW_TOKENS)
+        if max_new < 1:
+            raise BackendError(f"max_tokens must be >= 1, got {max_new}")
         session = AsyncSession(self._scheduler,
                                loop=asyncio.get_running_loop())
         request_id = f"chatcmpl-{uuid.uuid4().hex[:16]}"

@@ -1,0 +1,90 @@
+"""Int8 weight quantization: op-level exactness bounds + model integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
+from symmetry_tpu.engine.tokenizer import ByteTokenizer
+from symmetry_tpu.models import forward, init_cache, init_params, preset
+from symmetry_tpu.models.llama import (
+    param_logical_axes,
+    quantize_params,
+    quantized_logical_axes,
+)
+from symmetry_tpu.ops.quant import QuantizedTensor, dequantize, qmatmul, quantize
+
+
+class TestQuantOps:
+    def test_roundtrip_error_bounded(self):
+        w = jax.random.normal(jax.random.key(0), (64, 32), jnp.float32)
+        qt = quantize(w)
+        assert qt.q.dtype == jnp.int8
+        assert qt.scale.shape == (32,)
+        err = jnp.abs(dequantize(qt) - w)
+        # Error per element bounded by half a quantization step per column.
+        step = jnp.max(jnp.abs(w), axis=0) / 127.0
+        assert bool(jnp.all(err <= 0.51 * step[None, :]))
+
+    def test_qmatmul_matches_dequant_matmul(self):
+        x = jax.random.normal(jax.random.key(1), (4, 64), jnp.float32)
+        w = jax.random.normal(jax.random.key(2), (64, 32), jnp.float32)
+        qt = quantize(w)
+        got = qmatmul(x, qt)
+        want = x @ dequantize(qt)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_qmatmul_passthrough_dense(self):
+        x = jnp.ones((2, 8))
+        w = jnp.ones((8, 4))
+        np.testing.assert_allclose(np.asarray(qmatmul(x, w)),
+                                   np.asarray(x @ w))
+
+    def test_stacked_layer_quantization(self):
+        w = jax.random.normal(jax.random.key(3), (3, 16, 8), jnp.float32)
+        qt = quantize(w)
+        assert qt.q.shape == (3, 16, 8)
+        assert qt.scale.shape == (3, 8)
+
+
+class TestQuantModel:
+    def test_quantized_forward_close_to_dense(self):
+        cfg = preset("tiny")
+        params = init_params(cfg, jax.random.key(0), jnp.float32)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 512, (1, 12)), jnp.int32)
+
+        dense_logits, _ = forward(params, cfg, tokens,
+                                  init_cache(cfg, 1, 16, jnp.float32))
+        qparams = quantize_params(jax.tree.map(lambda a: a, params))
+        q_logits, _ = forward(qparams, cfg, tokens,
+                              init_cache(cfg, 1, 16, jnp.float32))
+        # int8 noise is real but small; top-1 prediction must survive.
+        np.testing.assert_allclose(np.asarray(q_logits),
+                                   np.asarray(dense_logits),
+                                   rtol=0.3, atol=0.3)
+        agree = (np.argmax(np.asarray(q_logits), -1)
+                 == np.argmax(np.asarray(dense_logits), -1)).mean()
+        assert agree >= 0.8
+
+    def test_quantized_logical_axes_structure(self):
+        cfg = preset("tiny")
+        axes = quantized_logical_axes(param_logical_axes(cfg))
+        assert isinstance(axes["layers"]["wq"], QuantizedTensor)
+        assert axes["layers"]["wq"].q == ("layers", "embed", "heads")
+        assert axes["layers"]["wq"].scale == ("layers", "heads")
+        assert axes["embed"] == ("vocab", "embed")
+
+    def test_engine_runs_int8(self):
+        cfg = preset("tiny")
+        params = quantize_params(init_params(cfg, jax.random.key(0),
+                                             jnp.float32))
+        engine = InferenceEngine(cfg, params, ByteTokenizer(), max_slots=2,
+                                 max_seq_len=64, prefill_buckets=(16,),
+                                 cache_dtype=jnp.float32)
+        first = engine.prefill_and_insert(0, list(b"quantized"),
+                                          SamplingParams())
+        toks = engine.decode_step()
+        assert toks.shape == (2,)
+        assert 0 <= first < cfg.vocab_size
