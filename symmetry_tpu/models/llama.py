@@ -201,6 +201,7 @@ def _layer(
     seq_lens: jnp.ndarray,      # [B] valid tokens in this call's input
     config: ModelConfig,
     prefill_flash: bool,        # static: flash self-attention (fresh cache)
+    ring_mesh=None,             # static: Mesh => ring attention over context
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     B, S, E = h.shape
     D, nq, nkv = config.dim_per_head, config.num_heads, config.num_kv_heads
@@ -222,7 +223,13 @@ def _layer(
     all_k = all_k.at[l_idx, b_idx, positions].set(k.astype(all_k.dtype))
     all_v = all_v.at[l_idx, b_idx, positions].set(v.astype(all_v.dtype))
 
-    if prefill_flash:
+    if ring_mesh is not None:
+        # Long-context prefill: sequence sharded over the `context` mesh
+        # axis, K/V blocks rotating on ICI (parallel/ring.py).
+        from symmetry_tpu.parallel.ring import ring_attention
+
+        attn = ring_attention(q, k, v, seq_lens, ring_mesh)
+    elif prefill_flash:
         # Prefill-from-empty: attention is over this call's own K/V — the
         # Pallas kernel streams K/V blocks through VMEM instead of
         # materializing [H, S, S] scores (ops/flash.py); the cache slice is
@@ -252,6 +259,7 @@ def forward_hidden(
     seq_lens: jnp.ndarray | None = None,  # [B] valid tokens in `tokens`; None = all S
     *,
     prefill_flash: bool = False,  # static: caller guarantees cache is empty
+    ring_mesh=None,               # static: context-parallel prefill mesh
 ) -> tuple[jnp.ndarray, KVCache]:
     """Decoder trunk: returns (final-norm hidden states [B, S, E], cache).
 
@@ -259,16 +267,29 @@ def forward_hidden(
     position — at 128k vocab the head matmul over a full padded bucket would
     dominate prefill cost.
 
-    prefill_flash=True routes attention through the Pallas flash kernel
-    (valid only when cache.lengths are all zero — engine prefill's case);
-    sliding-window models fall back to the masked path.
+    prefill_flash=True routes attention through the Pallas flash kernel.
+    VALID ONLY when cache.lengths are all zero (engine prefill's case) —
+    both fast paths attend to this call's own K/V, not the cache.
+    ring_mesh additionally shards the sequence over the mesh's `context`
+    axis (ring attention, parallel/ring.py); it requires prefill_flash's
+    empty-cache contract and S divisible by the ring size. Sliding-window
+    models (mistral-v0.1) fall back to the masked path in all cases.
     """
     B, S = tokens.shape
     if seq_lens is None:
         seq_lens = jnp.full((B,), S, jnp.int32)
     positions = cache.lengths[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
     kv_valid = cache.lengths + seq_lens
-    use_flash = prefill_flash and S > 1 and config.sliding_window is None
+    if ring_mesh is not None and not prefill_flash:
+        # Ring q/kv positions start at 0 and ignore cached entries — only
+        # the prefill-from-empty contract makes that correct. Fail loudly
+        # rather than silently mis-attend on a continuation call.
+        raise ValueError("ring_mesh requires prefill_flash=True "
+                         "(prefill-from-empty contract)")
+    use_ring = ring_mesh if (ring_mesh is not None and S > 1
+                             and config.sliding_window is None) else None
+    use_flash = (prefill_flash and use_ring is None and S > 1
+                 and config.sliding_window is None)
 
     def body(carry, xs):
         # The cache rides the CARRY, scatter-updated in place: scan xs/ys
@@ -277,7 +298,8 @@ def forward_hidden(
         h, all_k, all_v = carry
         lp, l = xs
         h, all_k, all_v = _layer(h, lp, all_k, all_v, l, positions, kv_valid,
-                                 seq_lens, config, use_flash)
+                                 seq_lens, config, use_flash,
+                                 ring_mesh=use_ring)
         return (h, all_k, all_v), None
 
     h = jnp.take(params["embed"], tokens, axis=0)
