@@ -32,20 +32,25 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
 
     dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype_name]
     config = preset(preset_name)
-    params = init_params(config, jax.random.key(0), dtype)
 
-    mesh = None
     if mesh_model > 1:
         mesh = build_mesh(MeshSpec(data=1, model=mesh_model))
         params = jax.device_put(
-            params, shardings_for(param_logical_axes(config), mesh))
+            init_params(config, jax.random.key(0), dtype),
+            shardings_for(param_logical_axes(config), mesh))
+        # Quantize AFTER placement: the dense sharding tree doesn't
+        # prefix-match QuantizedTensor leaves; jitted quantize preserves
+        # input shardings.
+        if quant == "int8":
+            from symmetry_tpu.models.llama import quantize_params
 
-    # Quantize AFTER placement: the dense sharding tree doesn't prefix-match
-    # QuantizedTensor leaves; the jitted quantize preserves input shardings.
-    if quant == "int8":
-        from symmetry_tpu.models.llama import quantize_params
-
-        params = quantize_params(params)
+            params = quantize_params(params)
+    else:
+        mesh = None
+        # Single chip: init leaves directly in int8 so models whose bf16
+        # form exceeds HBM (llama3-8b on v5e) still fit.
+        params = init_params(config, jax.random.key(0), dtype,
+                             quantize=quant == "int8")
 
     engine = InferenceEngine(
         config, params, ByteTokenizer(), mesh=mesh, max_slots=slots,
@@ -89,18 +94,18 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="CPU-safe tiny-model run (verification, not perf)")
-    ap.add_argument("--preset", default="llama3.2-1b")
-    ap.add_argument("--slots", type=int, default=8)
-    ap.add_argument("--steps", type=int, default=128)
+    ap.add_argument("--preset", default="llama3-8b")
+    ap.add_argument("--slots", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=192)
     ap.add_argument("--prompt-len", type=int, default=128)
     ap.add_argument("--max-seq", type=int, default=1024)
     ap.add_argument("--dtype", default="bfloat16",
                     choices=("bfloat16", "float32"))
     ap.add_argument("--mesh-model", type=int, default=1,
                     help="model-axis mesh size (tensor parallelism)")
-    ap.add_argument("--block", type=int, default=16,
+    ap.add_argument("--block", type=int, default=64,
                     help="decode steps per device dispatch")
-    ap.add_argument("--quant", default=None, choices=(None, "int8"),
+    ap.add_argument("--quant", default="int8", choices=("none", "int8"),
                     help="weight quantization")
     args = ap.parse_args()
 
@@ -117,7 +122,8 @@ def main() -> None:
         result = run_bench(args.preset, slots=args.slots, steps=args.steps,
                            prompt_len=args.prompt_len, max_seq=args.max_seq,
                            dtype_name=args.dtype, mesh_model=args.mesh_model,
-                           block=args.block, quant=args.quant)
+                           block=args.block,
+                           quant=None if args.quant == "none" else args.quant)
     print(json.dumps(result))
 
 

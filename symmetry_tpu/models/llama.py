@@ -126,14 +126,25 @@ def init_cache(
 # Parameters
 
 
-def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
-    """Random init (scaled normal). Real serving loads HF weights instead."""
+def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16,
+                *, quantize: bool = False) -> dict:
+    """Random init (scaled normal). Real serving loads HF weights instead.
+
+    quantize=True materializes QUANT_KEYS leaves as int8 directly — the
+    whole random-init→scale→quantize pipeline for a leaf runs as ONE
+    compiled program (ops/quant.py make_leaf), so no full-precision copy of
+    a leaf ever lands in HBM beyond that program's fused temporaries. That
+    is what lets an 8B-parameter model initialize on a 16 GB chip.
+    """
     c = config
     keys = iter(jax.random.split(key, 16))
 
-    def dense(k, shape, scale=None):
+    from symmetry_tpu.ops.quant import make_leaf
+
+    def dense(k, shape, scale=None, name=None):
         scale = scale if scale is not None else shape[0] ** -0.5
-        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+        return make_leaf(k, shape, scale, dtype,
+                         quantized=quantize and name in QUANT_KEYS)
 
     L, E, F = c.num_layers, c.hidden_size, c.intermediate_size
     params = {
@@ -141,18 +152,19 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict
         "layers": {
             "attn_norm": jnp.ones((L, E), dtype),
             "mlp_norm": jnp.ones((L, E), dtype),
-            "wq": dense(next(keys), (L, E, c.q_dim)),
-            "wk": dense(next(keys), (L, E, c.kv_dim)),
-            "wv": dense(next(keys), (L, E, c.kv_dim)),
-            "wo": dense(next(keys), (L, c.q_dim, E)),
-            "wg": dense(next(keys), (L, E, F)),
-            "wu": dense(next(keys), (L, E, F)),
-            "wd": dense(next(keys), (L, F, E)),
+            "wq": dense(next(keys), (L, E, c.q_dim), name="wq"),
+            "wk": dense(next(keys), (L, E, c.kv_dim), name="wk"),
+            "wv": dense(next(keys), (L, E, c.kv_dim), name="wv"),
+            "wo": dense(next(keys), (L, c.q_dim, E), name="wo"),
+            "wg": dense(next(keys), (L, E, F), name="wg"),
+            "wu": dense(next(keys), (L, E, F), name="wu"),
+            "wd": dense(next(keys), (L, F, E), name="wd"),
         },
         "final_norm": jnp.ones((E,), dtype),
     }
     if not c.tie_embeddings:
-        params["lm_head"] = dense(next(keys), (E, c.vocab_size), scale=0.02)
+        params["lm_head"] = dense(next(keys), (E, c.vocab_size), scale=0.02,
+                                  name="lm_head")
     return params
 
 

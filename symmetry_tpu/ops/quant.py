@@ -12,6 +12,7 @@ shard with NamedShardings, and donate exactly like dense ones.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -41,26 +42,49 @@ def dequantize(qt: QuantizedTensor, dtype=jnp.float32,
 def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
     """x @ w for dense arrays or QuantizedTensor ([in, out] contraction).
 
-    The int8→activation-dtype convert fuses into the dot's operand read on
-    TPU, so HBM sees int8; scales apply to the [.., out] result columns.
+    Uses a mixed-precision dot with the int8 operand passed directly — no
+    `astype` on the weight, so XLA never materializes a bf16 copy (for a
+    128k-vocab head that copy alone is >1 GB). Accumulates f32, applies the
+    per-column scales, casts back to the activation dtype.
     """
     if isinstance(w, QuantizedTensor):
-        y = x @ w.q.astype(x.dtype)
-        return y * w.scale.astype(x.dtype)
+        y = jax.lax.dot_general(
+            x, w.q,
+            dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return (y * w.scale).astype(x.dtype)
     return x @ w
+
+
+# One shared jitted quantizer: donating the dense original lets XLA reuse
+# its buffer; both post-hoc tree quantization and quantized init go through
+# this single definition.
+quantize_jit = jax.jit(quantize, donate_argnums=(0,))
 
 
 def quantize_tree(params: dict, keys: tuple[str, ...]) -> dict:
     """Quantize the named leaves of a params dict in place (donating the
     dense originals one at a time to bound peak memory)."""
-    jq = jax.jit(quantize, donate_argnums=(0,))
 
     def visit(node):
         for name, child in list(node.items()):
             if isinstance(child, dict):
                 visit(child)
             elif name in keys:
-                node[name] = jq(child)
+                node[name] = quantize_jit(child)
 
     visit(params)
     return params
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("shape", "scale", "dtype", "quantized"))
+def make_leaf(key, shape: tuple[int, ...], scale: float, dtype,
+              quantized: bool = False):
+    """Random-init one parameter leaf fully inside ONE compiled program:
+    normal → scale → cast (→ quantize). Nothing full-precision survives the
+    program, so peak memory per leaf is its fused temporaries — which is
+    what makes 8B-scale quantized init fit on one chip."""
+    w = (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+    return quantize(w) if quantized else w

@@ -43,13 +43,15 @@ def sample_tokens(
 
     # Partial sort: [B, cap] descending, with original vocab indices.
     top_logits, top_idx = jax.lax.top_k(scaled, cap)
-    greedy = top_idx[:, 0].astype(jnp.int32)
 
     ranks = jnp.arange(cap, dtype=jnp.int32)[None, :]
-    keep = jnp.ones((B, cap), dtype=bool)
     # top-k: keep ranks < k (0 disables; anything beyond cap acts as cap).
+    # Greedy (temperature == 0) is expressed as k = 1: with only rank 0
+    # unmasked, the categorical below deterministically returns the argmax —
+    # one select lane, no separate greedy branch.
     k = jnp.where(top_k > 0, top_k, cap)
-    keep &= ranks < k[:, None]
+    k = jnp.where(temperature > 0, k, 1)
+    keep = ranks < k[:, None]
     # top-p: keep the smallest prefix whose probability mass reaches p.
     # (Mass is computed over the top-cap window — the tail beyond cap is
     # treated as zero, see module docstring.)
@@ -62,5 +64,4 @@ def sample_tokens(
     masked = jnp.where(keep, top_logits, NEG_INF)
     choice_rank = jax.random.categorical(key, masked, axis=-1)  # [B]
     sampled = jnp.take_along_axis(top_idx, choice_rank[:, None], axis=-1)[:, 0]
-
-    return jnp.where(temperature > 0, sampled.astype(jnp.int32), greedy)
+    return sampled.astype(jnp.int32)
