@@ -121,10 +121,15 @@ class InferenceEngine:
 
         if mesh is not None:
             cax = cache_logical_axes()
-            self._cache_shardings = KVCache(
-                *(shardings_for(a, mesh)
-                  for a in (cax.k, cax.v, cax.lengths)))
             rep = jax.NamedSharding(mesh, jax.sharding.PartitionSpec())
+            self._cache_shardings = KVCache(
+                k=shardings_for(cax.k, mesh),
+                v=shardings_for(cax.v, mesh),
+                # lengths stays REPLICATED (O(slots) int32): the host reads
+                # individual slots, and on a multi-process data axis a
+                # batch-sharded slot may live on another host.
+                lengths=rep,
+            )
             self._state_shardings = DecodeState(
                 cache=self._cache_shardings, last_token=rep, temperature=rep,
                 top_p=rep, top_k=rep, rng=rep)
@@ -132,22 +137,30 @@ class InferenceEngine:
             self._cache_shardings = None
             self._state_shardings = None
 
-        self.state = DecodeState(
-            cache=KVCache(
-                k=jnp.zeros(cache_shape, cache_dtype),
-                v=jnp.zeros(cache_shape, cache_dtype),
-                lengths=jnp.zeros((max_slots,), jnp.int32),
-            ),
-            last_token=jnp.zeros((max_slots,), jnp.int32),
-            temperature=jnp.zeros((max_slots,), jnp.float32),
-            top_p=jnp.ones((max_slots,), jnp.float32),
-            top_k=jnp.zeros((max_slots,), jnp.int32),
-            rng=jax.random.key(0),
-        )
+        def _init_state() -> DecodeState:
+            return DecodeState(
+                cache=KVCache(
+                    k=jnp.zeros(cache_shape, cache_dtype),
+                    v=jnp.zeros(cache_shape, cache_dtype),
+                    lengths=jnp.zeros((max_slots,), jnp.int32),
+                ),
+                last_token=jnp.zeros((max_slots,), jnp.int32),
+                temperature=jnp.zeros((max_slots,), jnp.float32),
+                top_p=jnp.ones((max_slots,), jnp.float32),
+                top_k=jnp.zeros((max_slots,), jnp.int32),
+                rng=jax.random.key(0),
+            )
+
         if self._state_shardings is not None:
-            # Initial placement must match the jits' out_shardings exactly,
-            # or donated-buffer aliasing fails on the first insert.
-            self.state = jax.device_put(self.state, self._state_shardings)
+            # Initial placement must match the jits' out_shardings exactly
+            # (donated-buffer aliasing on the first insert), and must work
+            # when the mesh spans processes — jit-with-out_shardings creates
+            # the global arrays in place; device_put of host values cannot
+            # address other hosts' devices.
+            self.state = jax.jit(_init_state,
+                                 out_shardings=self._state_shardings)()
+        else:
+            self.state = _init_state()
 
         self._base_key = jax.random.key(
             int.from_bytes(os.urandom(4), "little"))
@@ -233,13 +246,29 @@ class InferenceEngine:
                 length=self.decode_block)
 
         state_shard = self._state_shardings
-        self._prefill = jax.jit(prefill)
+        if self.mesh is not None:
+            # Host-read outputs (sampled tokens) must be fully replicated —
+            # on a multi-process mesh np.asarray of a sharded global array
+            # is not addressable. The prefill KV prefix keeps the cache's
+            # kv_heads-on-model sharding; its batch dim (1) stays unsharded.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(self.mesh, P())
+            prefix_shard = KVCache(
+                k=NamedSharding(self.mesh, P(None, None, None, "model", None)),
+                v=NamedSharding(self.mesh, P(None, None, None, "model", None)),
+                lengths=rep,
+            )
+            self._prefill = jax.jit(prefill,
+                                    out_shardings=(rep, prefix_shard))
+            self._decode = jax.jit(decode_block, donate_argnums=(1,),
+                                   out_shardings=(state_shard, rep))
+        else:
+            self._prefill = jax.jit(prefill)
+            self._decode = jax.jit(decode_block, donate_argnums=(1,))
         self._insert = jax.jit(
             insert, donate_argnums=(0,),
             out_shardings=state_shard)
-        self._decode = jax.jit(
-            decode_block, donate_argnums=(1,),
-            out_shardings=(state_shard, None) if state_shard else None)
 
     # ------------------------------------------------------------------
     # Host-side API (called by the scheduler's engine thread)
@@ -301,10 +330,24 @@ class InferenceEngine:
     @classmethod
     def from_tpu_config(cls, tpu_cfg: Any, *, platform_devices=None
                         ) -> "InferenceEngine":
-        """Build from a provider.yaml `tpu:` section (provider/config.py)."""
+        """Build from a provider.yaml `tpu:` section (provider/config.py).
+
+        With `tpu.multihost` set, joins the jax.distributed job first and
+        builds the hybrid DCN×ICI mesh over the GLOBAL device set — every
+        process (rank 0 and workers) constructs the engine identically.
+        """
         mesh_spec = MeshSpec.from_dict(tpu_cfg.mesh)
-        devices = platform_devices or jax.devices()
-        mesh = build_mesh(mesh_spec, devices) if mesh_spec.size > 1 else None
+        if tpu_cfg.multihost:
+            from symmetry_tpu.parallel.multihost import (
+                build_multihost_mesh, init_distributed)
+
+            mh = tpu_cfg.multihost
+            init_distributed(mh["coordinator"], mh["num_processes"],
+                             mh["process_id"])
+            mesh = build_multihost_mesh(mesh_spec, mh.get("dcn_data", 1))
+        else:
+            devices = platform_devices or jax.devices()
+            mesh = build_mesh(mesh_spec, devices) if mesh_spec.size > 1 else None
 
         dtypes = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
                   "float16": jnp.float16}
@@ -314,26 +357,43 @@ class InferenceEngine:
         dtype = dtypes[tpu_cfg.dtype]
         tokenizer = get_tokenizer(tpu_cfg.tokenizer_path)
 
+        if tpu_cfg.quantization not in (None, "int8"):
+            raise EngineError(
+                f"unsupported tpu.quantization {tpu_cfg.quantization!r}")
+        quant = tpu_cfg.quantization == "int8"
+
         if tpu_cfg.checkpoint_path:
             from symmetry_tpu.engine.weights import load_checkpoint
 
             params, config = load_checkpoint(
                 tpu_cfg.checkpoint_path, mesh=mesh, dtype=dtype)
+            if quant:
+                from symmetry_tpu.models.llama import quantize_params
+
+                params = quantize_params(params)
         else:
             config = preset(tpu_cfg.model_preset or "tiny")
-            params = init_params(config, jax.random.key(0), dtype)
             if mesh is not None:
                 from symmetry_tpu.models.llama import param_logical_axes
 
-                params = jax.device_put(
-                    params, shardings_for(param_logical_axes(config), mesh))
-        if tpu_cfg.quantization == "int8":
-            from symmetry_tpu.models.llama import quantize_params
+                # Initialize directly as global sharded arrays (works when
+                # the mesh spans processes; device_put of host values
+                # cannot). Quantized leaves init int8 in the same program.
+                shardings = shardings_for(param_logical_axes(config), mesh)
+                if quant:
+                    from symmetry_tpu.models.llama import (
+                        quantized_logical_axes)
 
-            params = quantize_params(params)
-        elif tpu_cfg.quantization is not None:
-            raise EngineError(
-                f"unsupported tpu.quantization {tpu_cfg.quantization!r}")
+                    shardings = shardings_for(
+                        quantized_logical_axes(param_logical_axes(config)),
+                        mesh)
+                params = jax.jit(
+                    lambda: init_params(config, jax.random.key(0), dtype,
+                                        quantize=quant),
+                    out_shardings=shardings)()
+            else:
+                params = init_params(config, jax.random.key(0), dtype,
+                                     quantize=quant)
         return cls(
             config, params, tokenizer, mesh=mesh,
             max_slots=tpu_cfg.max_batch_size,

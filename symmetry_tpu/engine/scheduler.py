@@ -144,8 +144,17 @@ class Scheduler:
             if not self._slots:
                 if self._stopping.is_set() and drained:
                     return
-                # Idle: block until work arrives (no busy spin).
-                item = self._inbox.get()
+                # Idle: block until work arrives (no busy spin). Engines
+                # with an idle_tick (multi-host rank 0) get a periodic
+                # heartbeat so worker ranks' pending collective doesn't hit
+                # the distributed runtime's timeout.
+                tick = getattr(self.engine, "idle_tick", None)
+                try:
+                    item = self._inbox.get(
+                        timeout=10.0 if tick is not None else None)
+                except queue.Empty:
+                    tick()
+                    continue
                 if item is None:
                     if self._stopping.is_set():
                         return

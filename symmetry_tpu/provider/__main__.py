@@ -28,12 +28,33 @@ async def run(config_path: str) -> None:
     await provider.stop()
 
 
+def run_worker(config_path: str) -> None:
+    """Non-rank-0 process of a multi-host provider: no networking — build
+    the identical engine and mirror rank 0's jitted calls until stopped."""
+    from symmetry_tpu.engine.engine import InferenceEngine
+    from symmetry_tpu.parallel.multihost import CommandLoop
+
+    config = ConfigManager(config_path)
+    mh = config.tpu.multihost
+    if not mh or mh.get("process_id", 0) == 0:
+        raise SystemExit("--worker requires tpu.multihost with process_id > 0")
+    engine = InferenceEngine.from_tpu_config(config.tpu)
+    logger.info(f"worker rank {mh['process_id']} following rank 0…")
+    CommandLoop(engine, is_coordinator=False).follow_forever()
+    logger.info("worker stopped")
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(prog="symmetry-provider")
     parser.add_argument("-c", "--config", default=default_config_path(),
                         help="path to provider.yaml")
+    parser.add_argument("--worker", action="store_true",
+                        help="run as a multi-host worker rank (no network)")
     args = parser.parse_args()
-    asyncio.run(run(args.config))
+    if args.worker:
+        run_worker(args.config)
+    else:
+        asyncio.run(run(args.config))
 
 
 if __name__ == "__main__":

@@ -37,6 +37,7 @@ class TpuNativeBackend(InferenceBackend):
         self._model_name = config.model_name
         self._engine: InferenceEngine | None = None
         self._scheduler: Scheduler | None = None
+        self._command_loop = None
 
     async def start(self) -> None:
         """Load weights and start the engine thread (may take minutes for
@@ -44,12 +45,30 @@ class TpuNativeBackend(InferenceBackend):
         if self._engine is not None:
             return
         tpu_cfg = self._config.tpu
+        mh = tpu_cfg.multihost
+        if mh and mh.get("num_processes", 1) > 1 and mh["process_id"] != 0:
+            # Refuse BEFORE joining the distributed job / loading weights —
+            # a wrong-rank provider would become a dead participant the
+            # other ranks hang on.
+            raise BackendError(
+                "only rank 0 runs the provider; start other ranks with "
+                "`python -m symmetry_tpu.provider --worker`")
 
         def build() -> InferenceEngine:
             return InferenceEngine.from_tpu_config(tpu_cfg)
 
         self._engine = await asyncio.to_thread(build)
-        self._scheduler = Scheduler(self._engine)
+        sched_engine = self._engine
+        if mh and mh.get("num_processes", 1) > 1:
+            # Rank 0 fronts the network; its scheduler drives all ranks in
+            # lockstep through the command loop (parallel/multihost.py).
+            from symmetry_tpu.parallel.multihost import (
+                CommandLoop, MultihostEngine)
+
+            self._command_loop = CommandLoop(self._engine,
+                                             is_coordinator=True)
+            sched_engine = MultihostEngine(self._command_loop)
+        self._scheduler = Scheduler(sched_engine)
         self._scheduler.start()
         log.info(
             f"tpu_native engine up: model={self._model_name} "
@@ -58,6 +77,9 @@ class TpuNativeBackend(InferenceBackend):
     async def stop(self) -> None:
         if self._scheduler is not None:
             await asyncio.to_thread(self._scheduler.stop)
+            if self._command_loop is not None:
+                self._command_loop.stop()  # release worker ranks
+                self._command_loop = None
             self._scheduler = None
             self._engine = None
 

@@ -51,6 +51,11 @@ class TpuConfig:
     tokenizer_path: str | None = None   # tokenizer.json; None → byte tokenizer
     model_family: str = "llama"         # models/registry key
     model_preset: str | None = None     # e.g. "llama3-8b", "tiny" (tests)
+    # Multi-host provider (SURVEY §7 stage 6): one logical provider backed
+    # by N JAX processes. Keys: coordinator ("host:port"), num_processes,
+    # process_id, dcn_data (hosts on the data axis). Rank 0 fronts the
+    # network; other ranks run `python -m symmetry_tpu.provider --worker`.
+    multihost: dict[str, Any] | None = None
 
     @classmethod
     def from_dict(cls, raw: dict[str, Any]) -> "TpuConfig":

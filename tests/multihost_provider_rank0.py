@@ -1,0 +1,75 @@
+"""Rank-0 process for the multi-host provider E2E test: runs the server,
+the rank-0 provider (tpu_native, 2-process mesh), and a client chat — the
+full BASELINE config-5 shape at tiny scale."""
+
+import asyncio
+import json
+import os
+import sys
+
+
+def main() -> None:
+    port = sys.argv[1]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    from symmetry_tpu.client.client import SymmetryClient
+    from symmetry_tpu.identity import Identity
+    from symmetry_tpu.provider.config import ConfigManager
+    from symmetry_tpu.provider.provider import SymmetryProvider
+    from symmetry_tpu.server.broker import SymmetryServer
+    from symmetry_tpu.transport.memory import MemoryTransport
+
+    async def run() -> None:
+        hub = MemoryTransport()
+        server_ident = Identity.from_name("mh-server")
+        server = SymmetryServer(server_ident, hub, ping_interval_s=30.0)
+        await server.start("mem://server")
+
+        cfg = ConfigManager(config={
+            "name": "mh-prov", "public": True,
+            "serverKey": server_ident.public_hex,
+            "modelName": "tiny:mh", "apiProvider": "tpu_native",
+            "tpu": {
+                "model_preset": "tiny", "dtype": "float32",
+                "max_batch_size": 2, "max_seq_len": 64,
+                "prefill_buckets": [32], "decode_block": 2,
+                "mesh": {"model": 2},
+                "multihost": {"coordinator": f"127.0.0.1:{port}",
+                              "num_processes": 2, "process_id": 0,
+                              "dcn_data": 2},
+            },
+        })
+        provider = SymmetryProvider(cfg, transport=hub,
+                                    identity=Identity.from_name("mh-prov"),
+                                    server_address="mem://server")
+        await provider.start("mem://mh-prov")
+        await provider.wait_registered()
+
+        client = SymmetryClient(Identity.from_name("mh-cli"), hub)
+        details = await client.request_provider(
+            "mem://server", server_ident.public_key, "tiny:mh")
+        session = await client.connect(details)
+        deltas = []
+        async for d in session.chat([{"role": "user", "content": "hi"}],
+                                    max_tokens=6):
+            deltas.append(d)
+        await session.close()
+        await provider.stop()   # also releases the worker rank
+        await server.stop()
+        print("RESULT " + json.dumps({"text_len": len("".join(deltas)),
+                                      "ok": True}), flush=True)
+
+    asyncio.run(asyncio.wait_for(run(), 240))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Subprocess entry for the multi-host lockstep test (test_multihost.py).
+
+Runs as N real OS processes joined via jax.distributed on the CPU backend:
+rank 0 leads a CommandLoop (prefill, decode blocks, stop), workers follow.
+Every rank prints its final per-slot decode tokens; the parent asserts all
+ranks stayed in lockstep and match the single-process reference.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    port = sys.argv[3]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    from symmetry_tpu.parallel.multihost import (
+        Command, CMD_DECODE, CMD_PREFILL, CommandLoop, MultihostEngine,
+        init_distributed,
+    )
+
+    init_distributed(f"127.0.0.1:{port}", nprocs, rank)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
+    from symmetry_tpu.engine.tokenizer import ByteTokenizer
+    from symmetry_tpu.models import init_params, preset
+
+    # Identical replicated engine on every process (same init seed).
+    cfg = preset("tiny")
+    params = init_params(cfg, jax.random.key(0), jnp.float32)
+    engine = InferenceEngine(cfg, params, ByteTokenizer(), max_slots=2,
+                             max_seq_len=64, prefill_buckets=(16,),
+                             cache_dtype=jnp.float32, decode_block=2)
+    loop = CommandLoop(engine, is_coordinator=rank == 0)
+
+    collected: list[list[int]] = []
+    if rank == 0:
+        mh = MultihostEngine(loop)
+        first = mh.prefill_and_insert(0, list(b"multi host"),
+                                      SamplingParams(seed=7, temperature=0.5))
+        collected.append([first])
+        for _ in range(3):
+            toks = mh.decode_steps()
+            collected.append(np.asarray(toks)[:, 0].tolist())  # slot 0 tokens
+        loop.stop()
+    else:
+        # Workers mirror; capture their own engine's view afterwards.
+        orig_execute = loop._execute
+        def record(cmd):
+            out = orig_execute(cmd)
+            if cmd.kind == CMD_PREFILL:
+                collected.append([int(out)])
+            elif cmd.kind == CMD_DECODE:
+                collected.append(np.asarray(out)[:, 0].tolist())
+            return out
+        loop._execute = record
+        loop.follow_forever()
+
+    lengths = [engine.slot_length(s) for s in range(2)]
+    print("RESULT " + json.dumps({"rank": rank, "tokens": collected,
+                                  "lengths": lengths}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

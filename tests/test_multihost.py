@@ -1,0 +1,112 @@
+"""Multi-host lockstep test: N real processes over jax.distributed (CPU).
+
+Validates SURVEY §7 stage 6's rank-0 control plane: rank 0 drives the
+engine through CommandLoop broadcasts, workers mirror every jitted call,
+and all ranks' engines advance identically — the property that makes one
+logical provider out of N JAX processes.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_lockstep():
+    port = free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(rank), "2", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        for rank in range(2)
+    ]
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=280)
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                r = json.loads(line[len("RESULT "):])
+                results[r["rank"]] = r
+    assert set(results) == {0, 1}
+
+    # Lockstep: both ranks saw identical tokens and identical final state.
+    assert results[0]["tokens"] == results[1]["tokens"]
+    assert results[0]["lengths"] == results[1]["lengths"]
+    # Prefill (1) + 3 decode blocks of 2 = 7 generated; slot0 len = 10+7-1.
+    assert results[0]["lengths"][0] == 16
+    # 4 entries: first token + 3 decode blocks.
+    assert len(results[0]["tokens"]) == 4
+
+
+def test_multihost_provider_end_to_end():
+    """Full system: server + rank-0 provider + client in one process, a
+    worker rank following in another — one logical provider, two JAX
+    processes, tensor-parallel over a 2-process mesh (BASELINE config 5
+    in miniature)."""
+    port = free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    repo = os.path.dirname(os.path.dirname(__file__))
+    env["PYTHONPATH"] = repo
+
+    import tempfile
+
+    import yaml
+
+    worker_cfg = {
+        "name": "mh-prov", "public": False, "serverKey": "00" * 32,
+        "modelName": "tiny:mh", "apiProvider": "tpu_native",
+        "tpu": {
+            "model_preset": "tiny", "dtype": "float32",
+            "max_batch_size": 2, "max_seq_len": 64,
+            "prefill_buckets": [32], "decode_block": 2,
+            "mesh": {"model": 2},
+            "multihost": {"coordinator": f"127.0.0.1:{port}",
+                          "num_processes": 2, "process_id": 1,
+                          "dcn_data": 2},
+        },
+    }
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as fh:
+        yaml.safe_dump(worker_cfg, fh)
+        worker_cfg_path = fh.name
+
+    worker_env = dict(env)
+    worker_env["JAX_PLATFORMS"] = "cpu"
+    worker_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    rank0 = subprocess.Popen(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "multihost_provider_rank0.py"),
+         str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "symmetry_tpu.provider", "--worker",
+         "-c", worker_cfg_path],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=worker_env, cwd=repo)
+
+    out0, err0 = rank0.communicate(timeout=280)
+    assert rank0.returncode == 0, f"rank0 failed:\n{err0[-3000:]}"
+    outw, errw = worker.communicate(timeout=60)
+    assert worker.returncode == 0, f"worker failed:\n{errw[-3000:]}"
+
+    result = next(json.loads(l[len("RESULT "):])
+                  for l in out0.splitlines() if l.startswith("RESULT "))
+    assert result["ok"]
+    assert result["text_len"] >= 0
+    os.unlink(worker_cfg_path)
