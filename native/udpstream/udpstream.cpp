@@ -1,0 +1,402 @@
+// udpstream: reliable, ordered, frame-preserving streams over UDP.
+//
+// The TPU-native counterpart of the reference's udx-native dependency (C
+// addon under hyperswarm; SURVEY §2.2): multiplexed logical connections on
+// one UDP socket, segment sequencing with cumulative ACKs, fixed-RTO
+// retransmission, a bounded in-flight window for flow control, and frame
+// boundaries preserved via an end-of-frame bit — the contract the Python
+// Transport seam expects (symmetry_tpu/transport/base.py). Encryption is
+// deliberately NOT here: the Noise layer above the transport owns it
+// (symmetry_tpu/network/peer.py), mirroring udx-under-secret-stream.
+//
+// Single background thread per socket context: socket recv with a short
+// timeout doubles as the retransmit/keepalive tick. The C API is blocking
+// (condition variables); the Python asyncio adapter runs it in worker
+// threads (symmetry_tpu/transport/udp.py).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t MAGIC = 0xD5;
+constexpr uint8_t F_SYN = 1;
+constexpr uint8_t F_ACK = 2;
+constexpr uint8_t F_FIN = 4;
+constexpr uint8_t F_DATA = 8;
+constexpr uint8_t F_EOFR = 16;  // last segment of a frame
+
+constexpr size_t HDR = 16;
+constexpr size_t MTU_PAYLOAD = 1200;
+constexpr int WINDOW = 128;          // max unacked segments in flight
+constexpr int64_t RTO_MS = 200;
+constexpr int MAX_RETRIES = 50;      // ~10 s before declaring a peer dead
+constexpr int64_t TICK_MS = 20;
+
+int64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+struct Addr {
+  sockaddr_in sa{};
+  bool operator<(const Addr& o) const {
+    if (sa.sin_addr.s_addr != o.sa.sin_addr.s_addr)
+      return sa.sin_addr.s_addr < o.sa.sin_addr.s_addr;
+    return sa.sin_port < o.sa.sin_port;
+  }
+};
+
+struct Segment {
+  uint32_t seq;
+  uint8_t flags;
+  std::vector<uint8_t> payload;
+  int64_t sent_at = 0;
+  int retries = 0;
+};
+
+struct Conn {
+  uint32_t id;
+  Addr peer;
+  bool established = false;
+  bool closed = false;       // FIN seen or sent
+  bool dead = false;         // retransmit give-up
+  // sender
+  uint32_t next_seq = 0;
+  std::deque<Segment> unacked;
+  // receiver
+  uint32_t recv_next = 0;                       // next in-order seq expected
+  std::map<uint32_t, Segment> ooo;              // out-of-order stash
+  std::vector<uint8_t> frame_accum;             // partial frame bytes
+  std::deque<std::vector<uint8_t>> frames;      // complete frames ready
+};
+
+struct Ctx {
+  int fd = -1;
+  uint16_t port = 0;
+  std::thread loop;
+  std::atomic<bool> stop{false};
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<uint64_t, Conn> conns;               // key: addr-hash<<32 | id
+  std::deque<uint64_t> accept_q;
+  std::mt19937 rng{std::random_device{}()};
+
+  uint64_t key_for(const Addr& a, uint32_t id) {
+    uint64_t h = (uint64_t(a.sa.sin_addr.s_addr) << 16) ^ a.sa.sin_port;
+    return (h << 24) ^ id;  // cheap mix; collisions only break the colliders
+  }
+};
+
+void pack_hdr(uint8_t* b, uint8_t flags, uint32_t conn, uint32_t seq,
+              uint32_t ack, uint16_t len) {
+  b[0] = MAGIC;
+  b[1] = flags;
+  memcpy(b + 2, &conn, 4);
+  memcpy(b + 6, &seq, 4);
+  memcpy(b + 10, &ack, 4);
+  memcpy(b + 14, &len, 2);
+}
+
+void send_pkt(Ctx* c, const Addr& to, uint8_t flags, uint32_t conn,
+              uint32_t seq, uint32_t ack, const uint8_t* data, uint16_t len) {
+  uint8_t buf[HDR + MTU_PAYLOAD];
+  pack_hdr(buf, flags, conn, seq, ack, len);
+  if (len) memcpy(buf + HDR, data, len);
+  sendto(c->fd, buf, HDR + len, 0,
+         reinterpret_cast<const sockaddr*>(&to.sa), sizeof(to.sa));
+}
+
+void deliver_in_order(Conn& cn) {
+  // Pull contiguous segments out of the stash into frames.
+  for (;;) {
+    auto it = cn.ooo.find(cn.recv_next);
+    if (it == cn.ooo.end()) break;
+    Segment& s = it->second;
+    cn.frame_accum.insert(cn.frame_accum.end(), s.payload.begin(),
+                          s.payload.end());
+    if (s.flags & F_EOFR) {
+      cn.frames.push_back(std::move(cn.frame_accum));
+      cn.frame_accum.clear();
+    }
+    cn.ooo.erase(it);
+    cn.recv_next++;
+  }
+}
+
+void handle_packet(Ctx* c, const Addr& from, const uint8_t* b, ssize_t n) {
+  if (n < ssize_t(HDR) || b[0] != MAGIC) return;
+  uint8_t flags = b[1];
+  uint32_t conn_id, seq, ack;
+  uint16_t len;
+  memcpy(&conn_id, b + 2, 4);
+  memcpy(&seq, b + 6, 4);
+  memcpy(&ack, b + 10, 4);
+  memcpy(&len, b + 14, 2);
+  if (ssize_t(HDR) + len > n) return;
+
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint64_t key = c->key_for(from, conn_id);
+  auto it = c->conns.find(key);
+
+  if (flags & F_SYN) {
+    if (flags & F_ACK) {              // dialer side: SYN-ACK completes
+      if (it != c->conns.end()) {
+        it->second.established = true;
+        c->cv.notify_all();
+      }
+    } else {                          // listener side: new connection
+      if (it == c->conns.end()) {
+        Conn cn;
+        cn.id = conn_id;
+        cn.peer = from;
+        cn.established = true;
+        c->conns.emplace(key, std::move(cn));
+        c->accept_q.push_back(key);
+      }
+      send_pkt(c, from, F_SYN | F_ACK, conn_id, 0, 0, nullptr, 0);
+      c->cv.notify_all();
+    }
+    return;
+  }
+  if (it == c->conns.end()) return;
+  Conn& cn = it->second;
+
+  if (flags & F_ACK) {                // cumulative: drop acked segments
+    while (!cn.unacked.empty() && cn.unacked.front().seq < ack)
+      cn.unacked.pop_front();
+    c->cv.notify_all();
+  }
+  if (flags & F_DATA) {
+    if (seq >= cn.recv_next && cn.ooo.size() < 4 * WINDOW) {
+      Segment s;
+      s.seq = seq;
+      s.flags = flags;
+      s.payload.assign(b + HDR, b + HDR + len);
+      cn.ooo.emplace(seq, std::move(s));
+      deliver_in_order(cn);
+    }
+    // Always (re-)ack what we have; lost ACKs are recovered here.
+    send_pkt(c, cn.peer, F_ACK, cn.id, 0, cn.recv_next, nullptr, 0);
+    if (!cn.frames.empty()) c->cv.notify_all();
+  }
+  if (flags & F_FIN) {
+    cn.closed = true;
+    send_pkt(c, cn.peer, F_ACK, cn.id, 0, cn.recv_next, nullptr, 0);
+    c->cv.notify_all();
+  }
+}
+
+void tick_retransmits(Ctx* c) {
+  int64_t now = now_ms();
+  std::lock_guard<std::mutex> lk(c->mu);
+  for (auto& [key, cn] : c->conns) {
+    if (cn.dead) continue;
+    for (auto& s : cn.unacked) {
+      if (now - s.sent_at < RTO_MS) continue;
+      if (++s.retries > MAX_RETRIES) {
+        cn.dead = true;
+        c->cv.notify_all();
+        break;
+      }
+      s.sent_at = now;
+      send_pkt(c, cn.peer, s.flags, cn.id, s.seq, 0, s.payload.data(),
+               uint16_t(s.payload.size()));
+    }
+  }
+}
+
+void loop_fn(Ctx* c) {
+  uint8_t buf[HDR + MTU_PAYLOAD + 64];
+  int64_t last_tick = 0;
+  while (!c->stop.load()) {
+    Addr from;
+    socklen_t sl = sizeof(from.sa);
+    ssize_t n = recvfrom(c->fd, buf, sizeof(buf), 0,
+                         reinterpret_cast<sockaddr*>(&from.sa), &sl);
+    if (n > 0) handle_packet(c, from, buf, n);
+    int64_t now = now_ms();
+    if (now - last_tick >= TICK_MS) {
+      last_tick = now;
+      tick_retransmits(c);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* us_create(const char* bind_ip, int port) {
+  auto* c = new Ctx();
+  c->fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (c->fd < 0) { delete c; return nullptr; }
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, bind_ip, &sa.sin_addr) != 1) {
+    close(c->fd);
+    delete c;
+    return nullptr;
+  }
+  if (bind(c->fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) < 0) {
+    close(c->fd);
+    delete c;
+    return nullptr;
+  }
+  socklen_t sl = sizeof(sa);
+  getsockname(c->fd, reinterpret_cast<sockaddr*>(&sa), &sl);
+  c->port = ntohs(sa.sin_port);
+  timeval tv{0, int(TICK_MS) * 1000};
+  setsockopt(c->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  c->loop = std::thread(loop_fn, c);
+  return c;
+}
+
+int us_port(void* h) { return static_cast<Ctx*>(h)->port; }
+
+// Returns a connection key (>0), or 0 on timeout/failure.
+uint64_t us_dial(void* h, const char* ip, int port, int timeout_ms) {
+  auto* c = static_cast<Ctx*>(h);
+  Addr peer;
+  peer.sa.sin_family = AF_INET;
+  peer.sa.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, ip, &peer.sa.sin_addr) != 1) return 0;
+
+  uint64_t key;
+  uint32_t id;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    id = c->rng();
+    key = c->key_for(peer, id);
+    Conn cn;
+    cn.id = id;
+    cn.peer = peer;
+    c->conns.emplace(key, std::move(cn));
+  }
+  int64_t deadline = now_ms() + timeout_ms;
+  while (now_ms() < deadline) {
+    send_pkt(c, peer, F_SYN, id, 0, 0, nullptr, 0);
+    std::unique_lock<std::mutex> lk(c->mu);
+    c->cv.wait_for(lk, std::chrono::milliseconds(RTO_MS), [&] {
+      auto it = c->conns.find(key);
+      return it != c->conns.end() && it->second.established;
+    });
+    auto it = c->conns.find(key);
+    if (it != c->conns.end() && it->second.established) return key;
+  }
+  std::lock_guard<std::mutex> lk(c->mu);
+  c->conns.erase(key);
+  return 0;
+}
+
+uint64_t us_accept(void* h, int timeout_ms) {
+  auto* c = static_cast<Ctx*>(h);
+  std::unique_lock<std::mutex> lk(c->mu);
+  if (!c->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                      [&] { return !c->accept_q.empty() || c->stop.load(); }))
+    return 0;
+  if (c->accept_q.empty()) return 0;
+  uint64_t key = c->accept_q.front();
+  c->accept_q.pop_front();
+  return key;
+}
+
+// Send one frame (fragmented into MTU segments). Blocks while the window is
+// full. Returns 0 on success, -1 if the connection is closed/dead.
+int us_send(void* h, uint64_t key, const uint8_t* data, int len) {
+  auto* c = static_cast<Ctx*>(h);
+  int off = 0;
+  do {
+    int chunk = len - off > int(MTU_PAYLOAD) ? int(MTU_PAYLOAD) : len - off;
+    bool last = off + chunk >= len;
+    std::unique_lock<std::mutex> lk(c->mu);
+    auto it = c->conns.find(key);
+    if (it == c->conns.end()) return -1;
+    c->cv.wait(lk, [&] {
+      auto i2 = c->conns.find(key);
+      return i2 == c->conns.end() || i2->second.dead || i2->second.closed ||
+             int(i2->second.unacked.size()) < WINDOW;
+    });
+    it = c->conns.find(key);
+    if (it == c->conns.end() || it->second.dead || it->second.closed)
+      return -1;
+    Conn& cn = it->second;
+    Segment s;
+    s.seq = cn.next_seq++;
+    s.flags = uint8_t(F_DATA | (last ? F_EOFR : 0));
+    s.payload.assign(data + off, data + off + chunk);
+    s.sent_at = now_ms();
+    send_pkt(c, cn.peer, s.flags, cn.id, s.seq, 0, s.payload.data(),
+             uint16_t(chunk));
+    cn.unacked.push_back(std::move(s));
+    off += chunk;
+  } while (off < len);
+  return 0;
+}
+
+// Receive one complete frame into buf. Returns its length, 0 on timeout,
+// -1 on clean close, -2 if buf is too small (frame stays queued), -3 dead.
+int us_recv(void* h, uint64_t key, uint8_t* buf, int cap, int timeout_ms) {
+  auto* c = static_cast<Ctx*>(h);
+  std::unique_lock<std::mutex> lk(c->mu);
+  auto ready = [&] {
+    auto it = c->conns.find(key);
+    return it == c->conns.end() || !it->second.frames.empty() ||
+           it->second.closed || it->second.dead || c->stop.load();
+  };
+  if (!c->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), ready))
+    return 0;
+  auto it = c->conns.find(key);
+  if (it == c->conns.end()) return -1;
+  Conn& cn = it->second;
+  if (!cn.frames.empty()) {
+    auto& f = cn.frames.front();
+    if (int(f.size()) > cap) return -2;
+    int n = int(f.size());
+    memcpy(buf, f.data(), f.size());
+    cn.frames.pop_front();
+    return n;
+  }
+  if (cn.dead) return -3;
+  if (cn.closed) return -1;
+  return 0;
+}
+
+void us_close(void* h, uint64_t key) {
+  auto* c = static_cast<Ctx*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto it = c->conns.find(key);
+  if (it == c->conns.end()) return;
+  send_pkt(c, it->second.peer, F_FIN, it->second.id, 0, 0, nullptr, 0);
+  it->second.closed = true;
+  c->cv.notify_all();
+}
+
+void us_destroy(void* h) {
+  auto* c = static_cast<Ctx*>(h);
+  c->stop.store(true);
+  c->cv.notify_all();
+  if (c->loop.joinable()) c->loop.join();
+  close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

@@ -14,7 +14,9 @@ from symmetry_tpu.client.client import SymmetryClient
 
 
 async def run(args: argparse.Namespace) -> None:
-    client = SymmetryClient()
+    from symmetry_tpu.transport import transport_for
+
+    client = SymmetryClient(transport=transport_for(args.server))
     server_key = bytes.fromhex(args.server_key)
     if args.list_models:
         for row in await client.list_models(args.server, server_key):

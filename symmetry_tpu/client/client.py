@@ -120,7 +120,7 @@ class SymmetryClient:
         if transport is None:
             from symmetry_tpu.transport.tcp import TcpTransport
 
-            transport = TcpTransport()
+            transport = TcpTransport()  # CLI passes transport_for(server)
         self._transport = transport
 
     async def request_provider(

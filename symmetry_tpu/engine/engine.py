@@ -254,9 +254,16 @@ class InferenceEngine:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             rep = NamedSharding(self.mesh, P())
+            # Same rules as the decode cache, minus the batch axis (the
+            # prefix has batch 1) — derived from the shared rules table so
+            # the layouts can't silently diverge (parallel/sharding.py).
+            from symmetry_tpu.parallel.sharding import DEFAULT_RULES
+
+            cax = cache_logical_axes()
+            prefix_rules = {**DEFAULT_RULES, "batch": None}
             prefix_shard = KVCache(
-                k=NamedSharding(self.mesh, P(None, None, None, "model", None)),
-                v=NamedSharding(self.mesh, P(None, None, None, "model", None)),
+                k=shardings_for(cax.k, self.mesh, prefix_rules),
+                v=shardings_for(cax.v, self.mesh, prefix_rules),
                 lengths=rep,
             )
             self._prefill = jax.jit(prefill,
@@ -343,7 +350,7 @@ class InferenceEngine:
 
             mh = tpu_cfg.multihost
             init_distributed(mh["coordinator"], mh["num_processes"],
-                             mh["process_id"])
+                             mh.get("process_id", 0))
             mesh = build_multihost_mesh(mesh_spec, mh.get("dcn_data", 1))
         else:
             devices = platform_devices or jax.devices()

@@ -46,7 +46,7 @@ class TpuNativeBackend(InferenceBackend):
             return
         tpu_cfg = self._config.tpu
         mh = tpu_cfg.multihost
-        if mh and mh.get("num_processes", 1) > 1 and mh["process_id"] != 0:
+        if mh and mh.get("num_processes", 1) > 1 and mh.get("process_id", 0) != 0:
             # Refuse BEFORE joining the distributed job / loading weights —
             # a wrong-rank provider would become a dead participant the
             # other ranks hang on.

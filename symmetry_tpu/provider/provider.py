@@ -78,9 +78,13 @@ class SymmetryProvider:
         else:
             self.config = ConfigManager(config_path=config)
         if transport is None:
-            from symmetry_tpu.transport.tcp import TcpTransport
+            from symmetry_tpu.transport import transport_for
 
-            transport = TcpTransport()
+            # Scheme-select from the server address — constructor override
+            # first, then config (udp:// engages the native udpstream
+            # transport; default tcp).
+            transport = transport_for(
+                server_address or self.config.get("serverAddress") or "")
         self._transport = transport
         if identity is None:
             seed_hex = self.config.get("privateSeed")
@@ -127,7 +131,8 @@ class SymmetryProvider:
     async def start(self, listen_address: str | None = None) -> None:
         await self.backend.start()
         listen_address = listen_address or (
-            f"tcp://{self.config.get('listenHost', '0.0.0.0')}"
+            f"{self._transport.scheme}://"
+            f"{self.config.get('listenHost', '0.0.0.0')}"
             f":{self.config.get('listenPort', 0)}"
         )
         self._listener = await self._transport.listen(listen_address, self._on_peer)

@@ -243,20 +243,23 @@ async def main() -> None:
     parser = argparse.ArgumentParser(description="Symmetry routing server")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=4848)
+    parser.add_argument("--scheme", default="tcp", choices=("tcp", "udp"),
+                        help="udp engages the native udpstream transport")
     parser.add_argument("--db", default=os.path.expanduser("~/.config/symmetry/server.db"))
     parser.add_argument("--seed-name", default=None,
                         help="derive a stable identity from this name")
     args = parser.parse_args()
 
-    from symmetry_tpu.transport.tcp import TcpTransport
+    from symmetry_tpu.transport import transport_for
 
     identity = (
         Identity.from_name(args.seed_name) if args.seed_name else Identity.generate()
     )
     if args.db != ":memory:":
         os.makedirs(os.path.dirname(args.db), exist_ok=True)
-    server = SymmetryServer(identity, TcpTransport(), db_path=args.db)
-    await server.start(f"tcp://{args.host}:{args.port}")
+    address = f"{args.scheme}://{args.host}:{args.port}"
+    server = SymmetryServer(identity, transport_for(address), db_path=args.db)
+    await server.start(address)
     print(f"serverKey: {identity.public_hex}", flush=True)
     try:
         await asyncio.Event().wait()
