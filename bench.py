@@ -21,7 +21,8 @@ import time
 
 def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
               max_seq: int, dtype_name: str, mesh_model: int,
-              block: int = 1, quant: str | None = None) -> dict:
+              block: int = 1, quant: str | None = None,
+              kv_quant: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -55,7 +56,7 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
     engine = InferenceEngine(
         config, params, ByteTokenizer(), mesh=mesh, max_slots=slots,
         max_seq_len=max_seq, prefill_buckets=(prompt_len,),
-        cache_dtype=dtype, decode_block=block)
+        cache_dtype=dtype, decode_block=block, kv_quant=kv_quant)
 
     prompt = list(range(1, prompt_len + 1))
     t_prefill0 = time.perf_counter()
@@ -76,6 +77,8 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
     done_steps = n_disp * block
     tok_s = slots * done_steps / dt
     dtype_label = f"{dtype_name}+{quant}" if quant else dtype_name
+    if kv_quant:
+        dtype_label += "+kv8"
     dtype_name = dtype_label
     return {
         "metric": f"aggregate decode tok/s ({preset_name} {dtype_name}, "
@@ -95,7 +98,7 @@ def main() -> None:
     ap.add_argument("--smoke", action="store_true",
                     help="CPU-safe tiny-model run (verification, not perf)")
     ap.add_argument("--preset", default="llama3-8b")
-    ap.add_argument("--slots", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=64)
     ap.add_argument("--steps", type=int, default=192)
     ap.add_argument("--prompt-len", type=int, default=128)
     ap.add_argument("--max-seq", type=int, default=1024)
@@ -107,6 +110,8 @@ def main() -> None:
                     help="decode steps per device dispatch")
     ap.add_argument("--quant", default="int8", choices=("none", "int8"),
                     help="weight quantization")
+    ap.add_argument("--kv-quant", default="int8", choices=("none", "int8"),
+                    help="KV cache quantization")
     args = ap.parse_args()
 
     if args.smoke:
@@ -123,7 +128,8 @@ def main() -> None:
                            prompt_len=args.prompt_len, max_seq=args.max_seq,
                            dtype_name=args.dtype, mesh_model=args.mesh_model,
                            block=args.block,
-                           quant=None if args.quant == "none" else args.quant)
+                           quant=None if args.quant == "none" else args.quant,
+                           kv_quant=args.kv_quant == "int8")
     print(json.dumps(result))
 
 

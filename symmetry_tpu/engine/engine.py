@@ -35,6 +35,7 @@ from symmetry_tpu.models.llama import (
     cache_logical_axes,
     forward,
     forward_hidden,
+    init_cache,
     init_params,
     logits_from_hidden,
     preset,
@@ -96,6 +97,7 @@ class InferenceEngine:
         prefill_buckets: tuple[int, ...] = (128, 512, 2048),
         cache_dtype=jnp.bfloat16,
         decode_block: int = 1,
+        kv_quant: bool = False,
     ) -> None:
         self.config = config
         self.params = params
@@ -108,6 +110,7 @@ class InferenceEngine:
         if not self.prefill_buckets:
             raise EngineError("no prefill bucket fits within max_seq_len")
         self.cache_dtype = cache_dtype
+        self.kv_quant = kv_quant
         if decode_block < 1:
             raise EngineError("decode_block must be >= 1")
         # Prompts that leave less than decode_block headroom finish right
@@ -116,12 +119,11 @@ class InferenceEngine:
         self.decode_block = decode_block
 
         c = config
-        cache_shape = (c.num_layers, max_slots, max_seq_len, c.num_kv_heads,
-                       c.dim_per_head)
 
         if mesh is not None:
-            cax = cache_logical_axes()
+            cax = cache_logical_axes(quantized=kv_quant)
             rep = jax.NamedSharding(mesh, jax.sharding.PartitionSpec())
+            sc = shardings_for(cax.k_scale, mesh) if kv_quant else None
             self._cache_shardings = KVCache(
                 k=shardings_for(cax.k, mesh),
                 v=shardings_for(cax.v, mesh),
@@ -129,6 +131,7 @@ class InferenceEngine:
                 # individual slots, and on a multi-process data axis a
                 # batch-sharded slot may live on another host.
                 lengths=rep,
+                k_scale=sc, v_scale=sc,
             )
             self._state_shardings = DecodeState(
                 cache=self._cache_shardings, last_token=rep, temperature=rep,
@@ -139,11 +142,8 @@ class InferenceEngine:
 
         def _init_state() -> DecodeState:
             return DecodeState(
-                cache=KVCache(
-                    k=jnp.zeros(cache_shape, cache_dtype),
-                    v=jnp.zeros(cache_shape, cache_dtype),
-                    lengths=jnp.zeros((max_slots,), jnp.int32),
-                ),
+                cache=init_cache(c, max_slots, max_seq_len, cache_dtype,
+                                 quantized=kv_quant),
                 last_token=jnp.zeros((max_slots,), jnp.int32),
                 temperature=jnp.zeros((max_slots,), jnp.float32),
                 top_p=jnp.ones((max_slots,), jnp.float32),
@@ -177,13 +177,8 @@ class InferenceEngine:
         def prefill(params, tokens, true_len, temp, top_p, top_k, rng):
             """tokens [1, Sb] padded; returns (first sampled token, prefix KV)."""
             S = tokens.shape[1]
-            cache = KVCache(
-                k=jnp.zeros((cfg.num_layers, 1, S, cfg.num_kv_heads,
-                             cfg.dim_per_head), self.cache_dtype),
-                v=jnp.zeros((cfg.num_layers, 1, S, cfg.num_kv_heads,
-                             cfg.dim_per_head), self.cache_dtype),
-                lengths=jnp.zeros((1,), jnp.int32),
-            )
+            cache = init_cache(cfg, 1, S, self.cache_dtype,
+                               quantized=self.kv_quant)
             h, cache = forward_hidden(params, cfg, tokens, cache,
                                       seq_lens=true_len[None],
                                       prefill_flash=True)
@@ -201,19 +196,23 @@ class InferenceEngine:
         def insert(state: DecodeState, prefix: KVCache, slot, true_len,
                    first_token, temp, top_p, top_k) -> DecodeState:
             """Copy a batch-1 prefilled prefix into decode slot `slot`."""
-            Sb = prefix.k.shape[2]
 
             def place(big, small):
-                # big [L,B,T,K,D] <- small [L,1,Sb,K,D] at [:, slot, 0]
+                # big [L,B,T,...] <- small [L,1,Sb,...] at [:, slot, 0]
+                # (KV payloads are rank 5, scale planes rank 4)
+                start = (0, slot, 0) + (0,) * (big.ndim - 3)
                 return jax.lax.dynamic_update_slice(
-                    big, small.astype(big.dtype), (0, slot, 0, 0, 0))
+                    big, small.astype(big.dtype), start)
 
-            cache = KVCache(
+            cache = state.cache._replace(
                 k=place(state.cache.k, prefix.k),
                 v=place(state.cache.v, prefix.v),
                 # The first sampled token's KV is not here yet: the next
                 # decode step writes it at position true_len.
                 lengths=state.cache.lengths.at[slot].set(true_len),
+                **({"k_scale": place(state.cache.k_scale, prefix.k_scale),
+                    "v_scale": place(state.cache.v_scale, prefix.v_scale)}
+                   if self.kv_quant else {}),
             )
             return DecodeState(
                 cache=cache,
@@ -259,12 +258,15 @@ class InferenceEngine:
             # the layouts can't silently diverge (parallel/sharding.py).
             from symmetry_tpu.parallel.sharding import DEFAULT_RULES
 
-            cax = cache_logical_axes()
+            cax = cache_logical_axes(quantized=self.kv_quant)
             prefix_rules = {**DEFAULT_RULES, "batch": None}
+            psc = (shardings_for(cax.k_scale, self.mesh, prefix_rules)
+                   if self.kv_quant else None)
             prefix_shard = KVCache(
                 k=shardings_for(cax.k, self.mesh, prefix_rules),
                 v=shardings_for(cax.v, self.mesh, prefix_rules),
                 lengths=rep,
+                k_scale=psc, v_scale=psc,
             )
             self._prefill = jax.jit(prefill,
                                     out_shardings=(rep, prefix_shard))
@@ -367,6 +369,9 @@ class InferenceEngine:
         if tpu_cfg.quantization not in (None, "int8"):
             raise EngineError(
                 f"unsupported tpu.quantization {tpu_cfg.quantization!r}")
+        if tpu_cfg.kv_quantization not in (None, "int8"):
+            raise EngineError(
+                f"unsupported tpu.kv_quantization {tpu_cfg.kv_quantization!r}")
         quant = tpu_cfg.quantization == "int8"
 
         if tpu_cfg.checkpoint_path:
@@ -408,4 +413,5 @@ class InferenceEngine:
             prefill_buckets=tpu_cfg.prefill_buckets,
             cache_dtype=dtype,
             decode_block=getattr(tpu_cfg, "decode_block", 1),
+            kv_quant=tpu_cfg.kv_quantization == "int8",
         )

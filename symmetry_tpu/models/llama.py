@@ -103,18 +103,40 @@ def preset(name: str) -> ModelConfig:
 
 
 class KVCache(NamedTuple):
-    """Static-shape KV cache: [layers, batch, capacity, kv_heads, head_dim]."""
+    """Static-shape KV cache: [layers, batch, capacity, kv_heads, head_dim].
+
+    With quantized=True at init, k/v hold int8 payloads and k_scale/v_scale
+    hold the per-(layer, slot, position, kv_head) f32 dequant scales
+    (ops/quant.py quantize_kv) — [layers, batch, capacity, kv_heads]. The
+    scale planes are head_dim× smaller than the payload, so the decode-step
+    cache read drops to ~half of bf16.
+    """
 
     k: jnp.ndarray
     v: jnp.ndarray
     lengths: jnp.ndarray  # [batch] int32: valid entries per slot
+    k_scale: jnp.ndarray | None = None
+    v_scale: jnp.ndarray | None = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
 
 def init_cache(
-    config: ModelConfig, batch: int, capacity: int, dtype=jnp.bfloat16
+    config: ModelConfig, batch: int, capacity: int, dtype=jnp.bfloat16,
+    *, quantized: bool = False,
 ) -> KVCache:
     shape = (config.num_layers, batch, capacity, config.num_kv_heads,
              config.dim_per_head)
+    if quantized:
+        return KVCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            lengths=jnp.zeros((batch,), jnp.int32),
+            k_scale=jnp.zeros(shape[:-1], jnp.float32),
+            v_scale=jnp.zeros(shape[:-1], jnp.float32),
+        )
     return KVCache(
         k=jnp.zeros(shape, dtype),
         v=jnp.zeros(shape, dtype),
@@ -190,12 +212,10 @@ def param_logical_axes(config: ModelConfig) -> dict:
     return axes
 
 
-def cache_logical_axes() -> KVCache:
-    return KVCache(
-        k=("layers", "batch", "cache_seq", "kv_heads", "head_dim"),
-        v=("layers", "batch", "cache_seq", "kv_heads", "head_dim"),
-        lengths=("batch",),
-    )
+def cache_logical_axes(*, quantized: bool = False) -> KVCache:
+    kv = ("layers", "batch", "cache_seq", "kv_heads", "head_dim")
+    sc = ("layers", "batch", "cache_seq", "kv_heads") if quantized else None
+    return KVCache(k=kv, v=kv, lengths=("batch",), k_scale=sc, v_scale=sc)
 
 
 # ---------------------------------------------------------------------------
@@ -205,8 +225,7 @@ def cache_logical_axes() -> KVCache:
 def _layer(
     h: jnp.ndarray,             # [B, S, E]
     lp: dict,                   # one layer's params (leading L dim stripped)
-    all_k: jnp.ndarray,         # [L, B, T, K, D] FULL key cache
-    all_v: jnp.ndarray,
+    cache: KVCache,             # FULL [L, B, T, K, D] cache (lengths unused)
     layer: jnp.ndarray,         # scalar int32 layer index
     positions: jnp.ndarray,     # [B, S]
     kv_valid: jnp.ndarray,      # [B] cache length AFTER this call's writes
@@ -214,7 +233,7 @@ def _layer(
     config: ModelConfig,
     prefill_flash: bool,        # static: flash self-attention (fresh cache)
     ring_mesh=None,             # static: Mesh => ring attention over context
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> tuple[jnp.ndarray, KVCache]:
     B, S, E = h.shape
     D, nq, nkv = config.dim_per_head, config.num_heads, config.num_kv_heads
 
@@ -229,11 +248,25 @@ def _layer(
     # position) — an in-place row write on the scan carry; a per-layer
     # slice-out/slice-in would stream the whole layer slice through HBM.
     # Padded tail tokens write garbage past kv_valid — never read,
-    # overwritten later.
+    # overwritten later. Quantized caches write int8 payload + f32 scales.
     b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
     l_idx = jnp.full((B, S), layer, jnp.int32)
-    all_k = all_k.at[l_idx, b_idx, positions].set(k.astype(all_k.dtype))
-    all_v = all_v.at[l_idx, b_idx, positions].set(v.astype(all_v.dtype))
+    if cache.quantized:
+        from symmetry_tpu.ops.quant import quantize_kv
+
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        cache = cache._replace(
+            k=cache.k.at[l_idx, b_idx, positions].set(kq),
+            v=cache.v.at[l_idx, b_idx, positions].set(vq),
+            k_scale=cache.k_scale.at[l_idx, b_idx, positions].set(ks),
+            v_scale=cache.v_scale.at[l_idx, b_idx, positions].set(vs),
+        )
+    else:
+        cache = cache._replace(
+            k=cache.k.at[l_idx, b_idx, positions].set(k.astype(cache.k.dtype)),
+            v=cache.v.at[l_idx, b_idx, positions].set(v.astype(cache.v.dtype)),
+        )
 
     if ring_mesh is not None:
         # Long-context prefill: sequence sharded over the `context` mesh
@@ -251,16 +284,20 @@ def _layer(
         attn = flash_prefill(q, k, v, seq_lens,
                              interpret=jax.default_backend() != "tpu")
     else:
-        ck = jax.lax.dynamic_index_in_dim(all_k, layer, 0, keepdims=False)
-        cv = jax.lax.dynamic_index_in_dim(all_v, layer, 0, keepdims=False)
-        attn = gqa_attention(q, ck, cv, positions, kv_valid,
-                             sliding_window=config.sliding_window)
+        def at_layer(arr):
+            return jax.lax.dynamic_index_in_dim(arr, layer, 0, keepdims=False)
+
+        attn = gqa_attention(
+            q, at_layer(cache.k), at_layer(cache.v), positions, kv_valid,
+            sliding_window=config.sliding_window,
+            k_scale=at_layer(cache.k_scale) if cache.quantized else None,
+            v_scale=at_layer(cache.v_scale) if cache.quantized else None)
     h = h + qmatmul(attn.reshape(B, S, nq * D), lp["wo"])
 
     x = rms_norm(h, lp["mlp_norm"], config.rms_eps)
     h = h + qmatmul(jax.nn.silu(qmatmul(x, lp["wg"])) * qmatmul(x, lp["wu"]),
                     lp["wd"])
-    return h, all_k, all_v
+    return h, cache
 
 
 def forward_hidden(
@@ -307,21 +344,20 @@ def forward_hidden(
         # The cache rides the CARRY, scatter-updated in place: scan xs/ys
         # would stream the full [L, B, T, K, D] arrays through HBM every
         # forward — at decode that re-writes ~0.5 GB per token.
-        h, all_k, all_v = carry
+        h, c = carry
         lp, l = xs
-        h, all_k, all_v = _layer(h, lp, all_k, all_v, l, positions, kv_valid,
-                                 seq_lens, config, use_flash,
-                                 ring_mesh=use_ring)
-        return (h, all_k, all_v), None
+        h, c = _layer(h, lp, c, l, positions, kv_valid,
+                      seq_lens, config, use_flash, ring_mesh=use_ring)
+        return (h, c), None
 
     h = jnp.take(params["embed"], tokens, axis=0)
 
-    (h, new_k, new_v), _ = jax.lax.scan(
-        body, (h, cache.k, cache.v),
+    (h, new_cache), _ = jax.lax.scan(
+        body, (h, cache),
         (params["layers"], jnp.arange(config.num_layers, dtype=jnp.int32)))
 
     h = rms_norm(h, params["final_norm"], config.rms_eps)
-    return h, KVCache(k=new_k, v=new_v, lengths=kv_valid)
+    return h, new_cache._replace(lengths=kv_valid)
 
 
 def logits_from_hidden(params: dict, config: ModelConfig,

@@ -33,21 +33,36 @@ def gqa_attention(
     q_positions: jnp.ndarray,  # [B, S] absolute position of each query token
     kv_length: jnp.ndarray,    # [B] number of valid cache entries per sample
     sliding_window: int | None = None,  # mistral-style local attention span
+    k_scale: jnp.ndarray | None = None,  # [B, T, n_kv_heads] f32: int8 cache
+    v_scale: jnp.ndarray | None = None,  # per-token-per-head dequant scales
 ) -> jnp.ndarray:
-    """Returns [B, S, n_q_heads, head_dim] in q's dtype. Softmax in f32."""
+    """Returns [B, S, n_q_heads, head_dim] in q's dtype. Softmax in f32.
+
+    With k_scale/v_scale set, k_cache/v_cache hold int8 payloads
+    (ops/quant.py quantize_kv). Dequantization is folded into the existing
+    contractions — k's scale multiplies the scores (k = q·s distributes over
+    the dot product), v's scale multiplies the probabilities — so no bf16
+    copy of the cache is ever materialized and the HBM read stays int8-wide.
+    """
     B, S, n_q, D = q.shape
     T, n_kv = k_cache.shape[1], k_cache.shape[2]
     group = n_q // n_kv
     scale = D ** -0.5
+    # HIGHEST forces multi-pass bf16 matmuls; with an int8 operand the
+    # upcast is exact, so default precision loses nothing.
+    prec = None if k_scale is not None else jax.lax.Precision.HIGHEST
 
     qg = q.reshape(B, S, n_kv, group, D)
     # scores: [B, n_kv, group, S, T]. f32 accumulation: bf16 qk products drift
     # visibly at long T, and the MXU accumulates in f32 natively anyway.
     scores = jnp.einsum(
         "bskgd,btkd->bkgst", qg, k_cache,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=prec,
         preferred_element_type=jnp.float32,
-    ) * scale
+    )
+    if k_scale is not None:
+        scores = scores * jnp.moveaxis(k_scale, -1, 1)[:, :, None, None, :]
+    scores = scores * scale
 
     kv_pos = jnp.arange(T, dtype=jnp.int32)
     # key valid iff written (pos < kv_length) and causal (pos <= query pos)
@@ -60,9 +75,13 @@ def gqa_attention(
 
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
+    if v_scale is not None:
+        # Fold v's dequant scale into the probabilities (per key position) —
+        # masked positions contribute 0 regardless of their garbage scale.
+        probs = probs * jnp.moveaxis(v_scale, -1, 1)[:, :, None, None, :]
     probs = probs.astype(q.dtype)
 
     out = jnp.einsum("bkgst,btkd->bskgd", probs, v_cache,
-                     precision=jax.lax.Precision.HIGHEST,
+                     precision=prec,
                      preferred_element_type=jnp.float32).astype(q.dtype)
     return out.reshape(B, S, n_q, D)

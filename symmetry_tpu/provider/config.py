@@ -42,7 +42,8 @@ class TpuConfig:
 
     mesh: dict[str, int] = field(default_factory=lambda: {"data": 1, "model": 1})
     dtype: str = "bfloat16"            # parameter/compute dtype
-    quantization: str | None = None    # None | "int8"
+    quantization: str | None = None    # None | "int8" (weights)
+    kv_quantization: str | None = None  # None | "int8" (KV cache)
     max_batch_size: int = 8            # decode slots (continuous batching)
     max_seq_len: int = 2048            # KV capacity per slot
     prefill_buckets: tuple[int, ...] = (128, 512, 2048)
