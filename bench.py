@@ -58,6 +58,10 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
         max_seq_len=max_seq, prefill_buckets=(prompt_len,),
         cache_dtype=dtype, decode_block=block, kv_quant=kv_quant)
 
+    # Compile the decode program BEFORE inserting real requests (warmup's
+    # garbage device writes are only harmless pre-insert).
+    engine.warmup()
+
     prompt = list(range(1, prompt_len + 1))
     t_prefill0 = time.perf_counter()
     for slot in range(slots):
@@ -65,8 +69,8 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
                                   SamplingParams(temperature=0.7, seed=slot))
     prefill_s = time.perf_counter() - t_prefill0
 
-    # Warmup decode (compile) then measure. `steps` counts decode steps;
-    # each dispatch advances `block` of them.
+    # One warm dispatch, then measure. `steps` counts decode steps; each
+    # dispatch advances `block` of them.
     engine.decode_steps()
     n_disp = max(1, steps // block)
     t0 = time.perf_counter()

@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Installer parity with the reference's install.sh (scaffolds
+# ~/.config/symmetry/provider.yaml and installs the CLI; reference
+# install.sh:1-62). The TPU build installs from this checkout with pip and
+# writes a tpu_native default config instead of an Ollama proxy one.
+set -euo pipefail
+
+CONFIG_DIR="${SYMMETRY_CONFIG_DIR:-$HOME/.config/symmetry}"
+CONFIG_PATH="$CONFIG_DIR/provider.yaml"
+REPO_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+echo "Installing symmetry-tpu from $REPO_DIR ..."
+python3 -m pip install --user "$REPO_DIR"
+
+mkdir -p "$CONFIG_DIR"
+if [ -f "$CONFIG_PATH" ]; then
+    echo "Config already exists at $CONFIG_PATH — leaving it untouched."
+else
+    DEFAULT_NAME="$(id -un)-tpu"
+    NAME="" MODEL="" SERVER_KEY=""
+    if [ -t 0 ]; then  # non-interactive (CI, curl|bash): take the defaults
+        read -r -p "Provider name [$DEFAULT_NAME]: " NAME || true
+        read -r -p "Model preset [llama3-8b]: " MODEL || true
+        read -r -p "Server key (hex, empty for private provider): " SERVER_KEY || true
+    fi
+    NAME="${NAME:-$DEFAULT_NAME}"
+    MODEL="${MODEL:-llama3-8b}"
+
+    PUBLIC=true
+    if [ -z "$SERVER_KEY" ]; then
+        PUBLIC=false
+        SERVER_KEY="0000000000000000000000000000000000000000000000000000000000000000"
+    fi
+
+    cat > "$CONFIG_PATH" <<EOF
+# symmetry-tpu provider config (see README.md; field parity with the
+# reference provider.yaml plus the tpu: engine section)
+name: $NAME
+public: $PUBLIC
+serverKey: "$SERVER_KEY"
+modelName: "$MODEL"
+apiProvider: tpu_native
+dataCollectionEnabled: false
+maxConnections: 16
+path: $CONFIG_DIR
+tpu:
+  model_preset: $MODEL
+  dtype: bfloat16
+  quantization: int8
+  kv_quantization: int8
+  max_batch_size: 16
+  max_seq_len: 2048
+  prefill_buckets: [128, 512, 2048]
+  decode_block: 8
+  # checkpoint_path: /path/to/hf/safetensors/dir
+  # tokenizer_path: /path/to/tokenizer.json
+EOF
+    echo "Wrote default config to $CONFIG_PATH"
+fi
+
+echo
+echo "Run the provider with:  symmetry-tpu-provider -c $CONFIG_PATH"
+echo "Run a server with:      symmetry-tpu-server"

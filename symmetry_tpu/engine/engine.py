@@ -317,6 +317,18 @@ class InferenceEngine:
             jnp.int32(sampling.top_k))
         return int(tok)
 
+    def release_slot(self, slot: int) -> None:
+        """A finished slot's cache lane is garbage until reuse (insert
+        resets it); nothing to do device-side — the hook exists so the
+        scheduler's slot lifecycle has a single engine-visible seam."""
+
+    def warmup(self) -> None:
+        """Compile the decode program before traffic: serving must never
+        stall every active stream on a fresh XLA compile (~30 s on a real
+        chip). Call before the first insert — warmup advances device state
+        with garbage that is only harmless on an empty cache."""
+        self.state, _ = self._decode(self.params, self.state)
+
     def decode_steps(self) -> np.ndarray:
         """decode_block tokens for every slot; host gets [K, B] int32."""
         self.state, toks = self._decode(self.params, self.state)

@@ -276,6 +276,7 @@ class Scheduler:
             ttft_s=ttft, tokens_generated=active.generated))
         del self._slots[slot]
         self._free.append(slot)
+        self.engine.release_slot(slot)
         self.metrics["evictions"] += 1
 
     def _emit(self, active: _ActiveSlot, ev: TokenEvent) -> None:

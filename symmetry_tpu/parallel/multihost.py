@@ -41,6 +41,7 @@ CMD_IDLE = 0      # no-op heartbeat (keeps workers in lockstep while empty)
 CMD_PREFILL = 1   # prefill + insert one request
 CMD_DECODE = 2    # advance all slots one decode block
 CMD_STOP = 3      # shut down the loop
+CMD_WARMUP = 4    # precompile the decode program (pre-traffic)
 
 # Vector layout: [kind, slot, true_len, bucket, temp_milli, top_p_milli,
 #                 top_k, seed_or_-1, tokens...(max_bucket)]
@@ -173,6 +174,8 @@ class CommandLoop:
                 cmd.slot, list(map(int, cmd.tokens)), sampling)
         if cmd.kind == CMD_DECODE:
             return self.engine.decode_steps()
+        if cmd.kind == CMD_WARMUP:
+            return self.engine.warmup()
         return None
 
     def _broadcast(self, vec: np.ndarray) -> np.ndarray:
@@ -258,6 +261,13 @@ class MultihostEngine:
 
     def decode_steps(self) -> np.ndarray:
         return self._loop.lead(Command(kind=CMD_DECODE))
+
+    def release_slot(self, slot: int) -> None:
+        """Host-side no-op (engine.release_slot); nothing to broadcast."""
+        self._loop.engine.release_slot(slot)
+
+    def warmup(self) -> None:
+        self._loop.lead(Command(kind=CMD_WARMUP))
 
     def idle_tick(self) -> None:
         self._loop.idle_tick()

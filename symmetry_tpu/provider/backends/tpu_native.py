@@ -68,6 +68,9 @@ class TpuNativeBackend(InferenceBackend):
             self._command_loop = CommandLoop(self._engine,
                                              is_coordinator=True)
             sched_engine = MultihostEngine(self._command_loop)
+        # Compile the decode program before taking traffic: the first
+        # request must never stall every stream on a fresh XLA compile.
+        await asyncio.to_thread(sched_engine.warmup)
         self._scheduler = Scheduler(sched_engine)
         self._scheduler.start()
         log.info(

@@ -39,6 +39,7 @@ from symmetry_tpu.provider.config import ConfigManager
 from symmetry_tpu.server import tokens as session_tokens
 from symmetry_tpu.transport.base import Connection, Listener, Transport
 from symmetry_tpu.utils.logging import logger
+from symmetry_tpu.utils.trace import Tracer
 
 RECONNECT_BASE_S = 1.0
 RECONNECT_MAX_S = 60.0
@@ -115,11 +116,16 @@ class SymmetryProvider:
         self._in_flight = 0
         self._stopped = asyncio.Event()
         self._server_ready = asyncio.Event()
-        # Metrics (SURVEY §5.5: tok/s, queue depth first-class).
+        # Metrics (SURVEY §5.5: tok/s, queue depth first-class). Latency
+        # distributions live in this provider's Tracer (utils/trace.py):
+        # spans feed the same log-bucketed histograms stats() reads, so
+        # there is exactly one aggregation path — p50/p99 TTFT is the
+        # BASELINE.json headline metric.
+        self.tracer = Tracer()
         self.metrics: dict[str, Any] = {
             "requests": 0, "tokens_out": 0, "errors": 0,
-            "ttft_ms": [], "e2e_ms": [],
         }
+        self._started_at = time.monotonic()
 
     # ----- lifecycle (reference: init(), src/provider.ts:37-81) -----
 
@@ -240,19 +246,40 @@ class SymmetryProvider:
                     MessageKey.CONNECTION_SIZE, len(self._client_peers)
                 )
 
+    def stats(self) -> dict[str, Any]:
+        """Serving metrics snapshot: counters, tok/s, TTFT/e2e percentiles."""
+        uptime = max(time.monotonic() - self._started_at, 1e-9)
+        return {
+            "requests": self.metrics["requests"],
+            "tokens_out": self.metrics["tokens_out"],
+            "errors": self.metrics["errors"],
+            "in_flight": self._in_flight,
+            "connections": len(self._client_peers),
+            "uptime_s": round(uptime, 1),
+            "tok_s": round(self.metrics["tokens_out"] / uptime, 2),
+            "ttft_s": self.tracer.histogram("ttft_s").to_dict(),
+            "e2e_s": self.tracer.histogram("inference_s").to_dict(),
+        }
+
     async def _health_loop(self) -> None:
         """Backend health → presence (SURVEY §5.3: engine wedge must
-        unregister the provider)."""
+        unregister the provider); piggybacks the load-metrics report the
+        protocol reserves the `metrics` key for."""
         while not self._stopped.is_set():
             await asyncio.sleep(HEALTH_INTERVAL_S)
             try:
                 ok = await self.backend.healthy()
             except Exception:
                 ok = False
-            if not ok and self._server_peer is not None and not self._server_peer.closed:
-                logger.error("backend unhealthy; leaving server")
-                with contextlib.suppress(ConnectionError, OSError):
-                    await self._server_peer.send(MessageKey.LEAVE)
+            if self._server_peer is not None and not self._server_peer.closed:
+                if not ok:
+                    logger.error("backend unhealthy; leaving server")
+                    with contextlib.suppress(ConnectionError, OSError):
+                        await self._server_peer.send(MessageKey.LEAVE)
+                else:
+                    with contextlib.suppress(ConnectionError, OSError):
+                        await self._server_peer.send(MessageKey.METRICS,
+                                                     self.stats())
 
     # ----- client peers (reference: listeners(), src/provider.ts:173-193) -----
 
@@ -322,8 +349,9 @@ class SymmetryProvider:
         )
         self._in_flight += 1
         self.metrics["requests"] += 1
+        request_id = f"{peer.remote_public_hex[:12]}:{self.metrics['requests']}"
         completion_parts: list[str] = []
-        first_token_ms: float | None = None
+        first_token_s: float | None = None
         try:
             # Stream-start marker (reference src/provider.ts:234-238).
             await peer.send(
@@ -339,8 +367,10 @@ class SymmetryProvider:
                     break
                 if chunk.text:
                     completion_parts.append(chunk.text)
-                    if first_token_ms is None:
-                        first_token_ms = (time.monotonic() - start) * 1e3
+                    if first_token_s is None:
+                        first_token_s = time.monotonic() - start
+                        self.tracer.record("ttft", start, first_token_s,
+                                           request_id=request_id)
                 # Raw passthrough; Connection.send awaits drain = backpressure
                 # (reference's write/drain discipline, src/provider.ts:248-252).
                 await peer.send(MessageKey.TOKEN_CHUNK, {"raw": chunk.raw})
@@ -352,9 +382,9 @@ class SymmetryProvider:
                     {"chunks": n_chunks, "tokens": len(completion_parts)},
                 )
             self.metrics["tokens_out"] += len(completion_parts)
-            if first_token_ms is not None:
-                self.metrics["ttft_ms"].append(first_token_ms)
-            self.metrics["e2e_ms"].append((time.monotonic() - start) * 1e3)
+            self.tracer.record("inference", start, time.monotonic() - start,
+                               request_id=request_id,
+                               tokens=len(completion_parts), chunks=n_chunks)
             # Data collection (reference: saveCompletion, src/provider.ts:277-297).
             peer_key = peer.remote_public_hex
             await self.collector.save(

@@ -131,7 +131,10 @@ class SymmetryServer:
         elif key in (MessageKey.PONG, MessageKey.HEARTBEAT):
             self.registry.touch(peer_key)
         elif key == MessageKey.METRICS:
-            self.registry.touch(peer_key)
+            if isinstance(data, dict):
+                self.registry.set_metrics(peer_key, data)
+            else:
+                self.registry.touch(peer_key)
         elif key == MessageKey.REPORT_COMPLETION:
             d = data or {}
             self.registry.report_completion(

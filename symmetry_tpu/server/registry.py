@@ -33,6 +33,7 @@ CREATE TABLE IF NOT EXISTS peers (
     max_connections INTEGER NOT NULL DEFAULT 10,
     data_collection INTEGER NOT NULL DEFAULT 0,
     config          TEXT,               -- sanitized config JSON (no secrets)
+    metrics         TEXT,               -- latest load/latency report JSON
     joined_at       REAL NOT NULL,
     last_seen       REAL NOT NULL
 );
@@ -70,6 +71,7 @@ class ProviderRow:
     max_connections: int
     data_collection: bool
     config: dict[str, Any] | None
+    metrics: dict[str, Any] | None      # latest METRICS report (tok/s, TTFT)
     joined_at: float
     last_seen: float
 
@@ -87,6 +89,7 @@ def _row_to_provider(row: sqlite3.Row) -> ProviderRow:
         max_connections=row["max_connections"],
         data_collection=bool(row["data_collection"]),
         config=json.loads(row["config"]) if row["config"] else None,
+        metrics=json.loads(row["metrics"]) if row["metrics"] else None,
         joined_at=row["joined_at"],
         last_seen=row["last_seen"],
     )
@@ -140,6 +143,16 @@ class Registry:
         self._db.execute(
             "UPDATE peers SET last_seen = ? WHERE peer_key = ?",
             (time.time(), peer_key),
+        )
+        self._db.commit()
+
+    def set_metrics(self, peer_key: str, metrics: dict[str, Any]) -> None:
+        """Latest provider load/latency report (`metrics` key): tok/s,
+        in-flight, TTFT percentiles — the server-side view of provider
+        health beyond liveness."""
+        self._db.execute(
+            "UPDATE peers SET metrics = ?, last_seen = ? WHERE peer_key = ?",
+            (json.dumps(metrics), time.time(), peer_key),
         )
         self._db.commit()
 

@@ -340,3 +340,19 @@ class TestTpuNativeBackend:
             return True
 
         assert asyncio.run(asyncio.wait_for(drive(), 180))
+
+
+class TestWarmup:
+    def test_warmup_then_serve_matches_reference(self, setup):
+        """warmup() (pre-traffic decode compile) must not perturb later
+        requests: its garbage device writes land beyond every slot's valid
+        length and insert resets the lanes it uses."""
+        cfg, params = setup
+        engine = make_engine(cfg, params)
+        engine.warmup()
+        prompt = list(b"hello world")
+        want = reference_greedy(cfg, params, prompt, 8)
+        got = [engine.prefill_and_insert(0, prompt, SamplingParams())]
+        for _ in range(7):
+            got.append(int(engine.decode_step()[0]))
+        assert got == want

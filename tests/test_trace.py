@@ -1,0 +1,73 @@
+"""Tracing + metrics (utils/trace.py): histograms, spans, provider stats."""
+
+import time
+
+from symmetry_tpu.utils.trace import Histogram, Tracer
+
+
+class TestHistogram:
+    def test_percentiles_ordered(self):
+        h = Histogram()
+        for ms in range(1, 1001):
+            h.observe(ms / 1000.0)
+        assert h.count == 1000
+        p50, p90, p99 = h.percentile(50), h.percentile(90), h.percentile(99)
+        assert p50 is not None and p90 is not None and p99 is not None
+        assert p50 <= p90 <= p99
+        # log buckets at 5/decade: estimates within a bucket ratio (~1.58x)
+        assert 0.3 <= p50 <= 0.8
+        assert 0.55 <= p90 <= 1.0
+
+    def test_empty(self):
+        h = Histogram()
+        assert h.percentile(50) is None
+        assert h.mean is None
+        d = h.to_dict()
+        assert d["count"] == 0 and d["p99"] is None
+
+    def test_extremes_clamped(self):
+        h = Histogram()
+        h.observe(1e-9)   # below lowest edge
+        h.observe(1e6)    # above highest edge
+        assert h.count == 2
+        assert h.min == 1e-9 and h.max == 1e6
+        assert h.percentile(100) == 1e6
+
+
+class TestTracer:
+    def test_span_records_and_aggregates(self):
+        tr = Tracer()
+        with tr.span("prefill", request_id="r1", bucket=128):
+            time.sleep(0.01)
+        with tr.span("prefill", request_id="r2", bucket=512):
+            pass
+        spans = tr.export()
+        assert len(spans) == 2
+        assert spans[0]["name"] == "prefill"
+        assert spans[0]["bucket"] == 128
+        assert spans[0]["duration_s"] >= 0.01
+        assert tr.export(request_id="r2")[0]["bucket"] == 512
+        assert tr.stats()["prefill_s"]["count"] == 2
+
+    def test_disabled_is_noop(self):
+        tr = Tracer()
+        tr.enabled = False
+        with tr.span("x"):
+            pass
+        tr.record("y", 0.0, 1.0)
+        assert tr.export() == []
+        assert tr.stats() == {}
+
+    def test_ring_bounded(self):
+        tr = Tracer(capacity=8)
+        for i in range(20):
+            tr.record("s", 0.0, 0.001, request_id=str(i))
+        spans = tr.export()
+        assert len(spans) == 8
+        assert spans[0]["request_id"] == "12"  # oldest retained
+
+    def test_annotate_inside_span(self):
+        tr = Tracer()
+        with tr.span("gen") as attrs:
+            attrs["tokens"] = 42
+        assert tr.export()[0]["tokens"] == 42
