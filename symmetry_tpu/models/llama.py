@@ -106,10 +106,12 @@ class KVCache(NamedTuple):
     """Static-shape KV cache: [layers, batch, capacity, kv_heads, head_dim].
 
     With quantized=True at init, k/v hold int8 payloads and k_scale/v_scale
-    hold the per-(layer, slot, position, kv_head) f32 dequant scales
-    (ops/quant.py quantize_kv) — [layers, batch, capacity, kv_heads]. The
-    scale planes are head_dim× smaller than the payload, so the decode-step
-    cache read drops to ~half of bf16.
+    hold the per-(layer, slot, kv_head, position) f32 dequant scales
+    (ops/quant.py quantize_kv) — [layers, batch, kv_heads, capacity].
+    Position is the MINOR scale dim on purpose: with kv_heads (8) minor the
+    arrays would tile-pad 16x in HBM the moment a Pallas kernel takes them
+    as operands. The scale planes are head_dim× smaller than the payload,
+    so the decode-step cache read drops to ~half of bf16.
     """
 
     k: jnp.ndarray
@@ -130,12 +132,14 @@ def init_cache(
     shape = (config.num_layers, batch, capacity, config.num_kv_heads,
              config.dim_per_head)
     if quantized:
+        scale_shape = (config.num_layers, batch, config.num_kv_heads,
+                       capacity)
         return KVCache(
             k=jnp.zeros(shape, jnp.int8),
             v=jnp.zeros(shape, jnp.int8),
             lengths=jnp.zeros((batch,), jnp.int32),
-            k_scale=jnp.zeros(shape[:-1], jnp.float32),
-            v_scale=jnp.zeros(shape[:-1], jnp.float32),
+            k_scale=jnp.zeros(scale_shape, jnp.float32),
+            v_scale=jnp.zeros(scale_shape, jnp.float32),
         )
     return KVCache(
         k=jnp.zeros(shape, dtype),
@@ -214,7 +218,7 @@ def param_logical_axes(config: ModelConfig) -> dict:
 
 def cache_logical_axes(*, quantized: bool = False) -> KVCache:
     kv = ("layers", "batch", "cache_seq", "kv_heads", "head_dim")
-    sc = ("layers", "batch", "cache_seq", "kv_heads") if quantized else None
+    sc = ("layers", "batch", "kv_heads", "cache_seq") if quantized else None
     return KVCache(k=kv, v=kv, lengths=("batch",), k_scale=sc, v_scale=sc)
 
 
@@ -254,13 +258,16 @@ def _layer(
     if cache.quantized:
         from symmetry_tpu.ops.quant import quantize_kv
 
-        kq, ks = quantize_kv(k)
+        kq, ks = quantize_kv(k)  # ks [B, S, K]
         vq, vs = quantize_kv(v)
+        # Scale planes are [L, B, K, T] (position minor, see KVCache): the
+        # mixed advanced/slice index puts the advanced dims (B, S) in
+        # front, matching the [B, S, K] scale values.
         cache = cache._replace(
             k=cache.k.at[l_idx, b_idx, positions].set(kq),
             v=cache.v.at[l_idx, b_idx, positions].set(vq),
-            k_scale=cache.k_scale.at[l_idx, b_idx, positions].set(ks),
-            v_scale=cache.v_scale.at[l_idx, b_idx, positions].set(vs),
+            k_scale=cache.k_scale.at[l_idx, b_idx, :, positions].set(ks),
+            v_scale=cache.v_scale.at[l_idx, b_idx, :, positions].set(vs),
         )
     else:
         cache = cache._replace(
@@ -284,14 +291,29 @@ def _layer(
         attn = flash_prefill(q, k, v, seq_lens,
                              interpret=jax.default_backend() != "tpu")
     else:
-        def at_layer(arr):
-            return jax.lax.dynamic_index_in_dim(arr, layer, 0, keepdims=False)
+        from symmetry_tpu.ops import decode_attention as da
 
-        attn = gqa_attention(
-            q, at_layer(cache.k), at_layer(cache.v), positions, kv_valid,
-            sliding_window=config.sliding_window,
-            k_scale=at_layer(cache.k_scale) if cache.quantized else None,
-            v_scale=at_layer(cache.v_scale) if cache.quantized else None)
+        if S == 1 and da.supports(config, cache.k.shape[2],
+                                  jax.default_backend()):
+            # Single-position decode on TPU: the Pallas kernel reads only
+            # each slot's occupied KV prefix (per-slot block skipping); the
+            # full cache is its operand, layer selection happens in the
+            # kernel's block addressing (ops/decode_attention.py).
+            attn = da.decode_attention(
+                q[:, 0], cache.k, cache.v, layer, kv_valid,
+                k_scale=cache.k_scale if cache.quantized else None,
+                v_scale=cache.v_scale if cache.quantized else None,
+                interpret=jax.default_backend() != "tpu")[:, None]
+        else:
+            def at_layer(arr):
+                return jax.lax.dynamic_index_in_dim(arr, layer, 0,
+                                                    keepdims=False)
+
+            attn = gqa_attention(
+                q, at_layer(cache.k), at_layer(cache.v), positions, kv_valid,
+                sliding_window=config.sliding_window,
+                k_scale=at_layer(cache.k_scale) if cache.quantized else None,
+                v_scale=at_layer(cache.v_scale) if cache.quantized else None)
     h = h + qmatmul(attn.reshape(B, S, nq * D), lp["wo"])
 
     x = rms_norm(h, lp["mlp_norm"], config.rms_eps)

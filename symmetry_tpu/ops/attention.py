@@ -33,7 +33,7 @@ def gqa_attention(
     q_positions: jnp.ndarray,  # [B, S] absolute position of each query token
     kv_length: jnp.ndarray,    # [B] number of valid cache entries per sample
     sliding_window: int | None = None,  # mistral-style local attention span
-    k_scale: jnp.ndarray | None = None,  # [B, T, n_kv_heads] f32: int8 cache
+    k_scale: jnp.ndarray | None = None,  # [B, n_kv_heads, T] f32: int8 cache
     v_scale: jnp.ndarray | None = None,  # per-token-per-head dequant scales
 ) -> jnp.ndarray:
     """Returns [B, S, n_q_heads, head_dim] in q's dtype. Softmax in f32.
@@ -61,7 +61,7 @@ def gqa_attention(
         preferred_element_type=jnp.float32,
     )
     if k_scale is not None:
-        scores = scores * jnp.moveaxis(k_scale, -1, 1)[:, :, None, None, :]
+        scores = scores * k_scale[:, :, None, None, :]
     scores = scores * scale
 
     kv_pos = jnp.arange(T, dtype=jnp.int32)
@@ -78,7 +78,7 @@ def gqa_attention(
     if v_scale is not None:
         # Fold v's dequant scale into the probabilities (per key position) —
         # masked positions contribute 0 regardless of their garbage scale.
-        probs = probs * jnp.moveaxis(v_scale, -1, 1)[:, :, None, None, :]
+        probs = probs * v_scale[:, :, None, None, :]
     probs = probs.astype(q.dtype)
 
     out = jnp.einsum("bkgst,btkd->bskgd", probs, v_cache,

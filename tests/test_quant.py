@@ -118,7 +118,7 @@ class TestKVQuant:
         q = jax.random.normal(ks[0], (B, S, nq, D), jnp.float32)
         k = jax.random.normal(ks[1], (B, T, nkv, D), jnp.float32)
         v = jax.random.normal(ks[2], (B, T, nkv, D), jnp.float32)
-        kq, k_sc = quantize_kv(k)
+        kq, k_sc = quantize_kv(k)   # scales [B, T, K]
         vq, v_sc = quantize_kv(v)
         k_deq = kq.astype(jnp.float32) * k_sc[..., None]
         v_deq = vq.astype(jnp.float32) * v_sc[..., None]
@@ -127,8 +127,10 @@ class TestKVQuant:
             jnp.arange(8, 8 + S, dtype=jnp.int32)[None], (B, S))
         kv_len = jnp.full((B,), 8 + S, jnp.int32)
 
+        # attention takes scales position-minor: [B, K, T]
         folded = gqa_attention(q, kq, vq, positions, kv_len,
-                               k_scale=k_sc, v_scale=v_sc)
+                               k_scale=jnp.moveaxis(k_sc, 1, 2),
+                               v_scale=jnp.moveaxis(v_sc, 1, 2))
         explicit = gqa_attention(q, k_deq, v_deq, positions, kv_len)
         np.testing.assert_allclose(np.asarray(folded), np.asarray(explicit),
                                    rtol=1e-5, atol=1e-5)
