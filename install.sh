@@ -18,7 +18,7 @@ if [ -f "$CONFIG_PATH" ]; then
 else
     DEFAULT_NAME="$(id -un)-tpu"
     NAME="" MODEL="" SERVER_KEY=""
-    if [ -t 0 ]; then  # non-interactive (CI, curl|bash): take the defaults
+    if [ -t 0 ]; then  # prompt only when stdin is a tty; CI/curl|bash take defaults
         read -r -p "Provider name [$DEFAULT_NAME]: " NAME || true
         read -r -p "Model preset [llama3-8b]: " MODEL || true
         read -r -p "Server key (hex, empty for private provider): " SERVER_KEY || true

@@ -187,3 +187,34 @@ class SymmetryClient:
             model_name=model_name,
         )
         return await self.connect(details)
+
+    async def discover(self, provider_key: bytes,
+                       bootstrap: list[str]) -> ProviderDetails:
+        """Decentralized discovery: resolve a provider by public key over
+        the Kademlia DHT (network/dht.py) — no central server involved.
+        Topic = discovery_key(provider_key), the reference's hyperswarm
+        topic semantics. Raises ClientError when nobody has announced."""
+        from symmetry_tpu.identity import discovery_key
+        from symmetry_tpu.network.dht import DHTNode, parse_host_port
+
+        try:
+            boot = [parse_host_port(e) for e in bootstrap]
+        except ValueError as exc:
+            raise ClientError(str(exc)) from None
+        node = DHTNode()
+        await node.start("0.0.0.0", 0, bootstrap=boot)
+        try:
+            peers = await node.lookup(discovery_key(provider_key))
+        finally:
+            await node.stop()
+        want = provider_key.hex()
+        for peer in peers:
+            if peer.get("publicKey") == want and peer.get("address"):
+                return ProviderDetails(
+                    peer_key=want,
+                    address=peer["address"],
+                    model_name=peer.get("modelName", ""),
+                    raw=peer,
+                )
+        raise ClientError(
+            f"provider {want[:12]}… not found on the DHT")

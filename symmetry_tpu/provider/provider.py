@@ -109,6 +109,7 @@ class SymmetryProvider:
         self._server_address = server_address or self.config.get("serverAddress")
         self._listener: Listener | None = None
         self._server_peer: Peer | None = None
+        self._dht: Any = None  # network/dht.py DHTNode when dht: configured
         self._client_peers: set[Peer] = set()
         self._conversation_index: dict[str, int] = {}
         self._tasks: set[asyncio.Task] = set()
@@ -149,6 +150,36 @@ class SymmetryProvider:
         if self.config.public:
             self._spawn(self._server_loop())
         self._spawn(self._health_loop())
+        await self._join_dht()
+
+    async def _join_dht(self) -> None:
+        """Announce on the Kademlia DHT (network/dht.py) so clients can
+        discover this provider WITHOUT the central server — the reference's
+        hyperswarm topic-announce (src/provider.ts:44-48), decentralized
+        leg. Topic = discovery_key(our public key)."""
+        dht_cfg = self.config.get("dht")
+        if not dht_cfg:
+            return
+        from symmetry_tpu.network.dht import DHTNode, parse_host_port
+
+        try:
+            bootstrap = [parse_host_port(e)
+                         for e in dht_cfg.get("bootstrap", [])]
+        except ValueError as exc:
+            # Discovery is an add-on: a malformed bootstrap list must not
+            # take down an otherwise healthy provider.
+            logger.error(f"dht disabled: {exc}")
+            return
+        self._dht = DHTNode()
+        await self._dht.start(dht_cfg.get("host", "0.0.0.0"),
+                              int(dht_cfg.get("port", 0)), bootstrap=bootstrap)
+        stored = await self._dht.announce(self.identity.discovery_key, {
+            "address": self.address,
+            "publicKey": self.identity.public_hex,
+            "modelName": self.config.model_name,
+        })
+        logger.info(f"dht: announced on {stored} node(s) "
+                    f"(topic {self.identity.discovery_key.hex()[:12]}…)")
 
     async def wait_registered(self, timeout: float = 10.0) -> None:
         await asyncio.wait_for(self._server_ready.wait(), timeout)
@@ -156,6 +187,10 @@ class SymmetryProvider:
     async def stop(self, drain_timeout_s: float = 30.0) -> None:
         """Graceful drain: stop accepting, finish in-flight, leave, close."""
         self._draining = True
+        if self._dht is not None:
+            self._dht.unannounce(self.identity.discovery_key)
+            await self._dht.stop()
+            self._dht = None
         deadline = time.monotonic() + drain_timeout_s
         while self._in_flight > 0 and time.monotonic() < deadline:
             await asyncio.sleep(0.05)

@@ -102,8 +102,18 @@ class Registry:
         self._db = sqlite3.connect(db_path)
         self._db.row_factory = sqlite3.Row
         self._db.executescript(_SCHEMA)
+        self._migrate()
         # Restart recovery: anything marked online in a previous run is stale.
         self._db.execute("UPDATE peers SET online = 0, connections = 0")
+        self._db.commit()
+
+    def _migrate(self) -> None:
+        """Columns added after a release: CREATE TABLE IF NOT EXISTS is a
+        no-op on a pre-existing file DB, so bring it up to schema here."""
+        have = {row["name"] for row in
+                self._db.execute("PRAGMA table_info(peers)")}
+        if "metrics" not in have:
+            self._db.execute("ALTER TABLE peers ADD COLUMN metrics TEXT")
         self._db.commit()
 
     # --- providers (PeerUpsert semantics, reference src/types.ts:203-208) ---

@@ -4,9 +4,10 @@ The reference ships no tracing of its own — its dependency stack embeds
 hypertrace hooks it never enables, and the only counters are wire-level
 byte counts on the peer object. Here the equivalents are first-class:
 
-  - Span/Tracer: per-request spans (receive → prefill → first-token → end)
-    with a bounded in-memory ring, cheap enough to leave on. Hook points
-    live in the provider's inference path and the engine scheduler.
+  - Span/Tracer: per-request spans (receive → first-token → end) with a
+    bounded in-memory ring, cheap enough to leave on. Each provider owns
+    one Tracer instance (provider/provider.py) whose histograms back its
+    stats() snapshot.
   - Histogram: log-bucketed latency/throughput distributions with
     percentile estimates — p50/p99 TTFT is the BASELINE.json north-star
     metric, so it must be computable from a running provider, not from
@@ -112,8 +113,9 @@ class Span:
 class Tracer:
     """Bounded ring of completed spans + named histograms.
 
-    One process-global instance (`tracer`) serves the whole node; hot-path
-    cost when disabled is a single attribute check.
+    Instantiate one per component that needs isolated metrics (the
+    provider owns one); hot-path cost when disabled is a single attribute
+    check.
     """
 
     def __init__(self, capacity: int = 4096) -> None:
@@ -169,9 +171,6 @@ class Tracer:
         with self._lock:
             self._spans.clear()
             self._hists.clear()
-
-
-tracer = Tracer()
 
 
 @contextlib.contextmanager

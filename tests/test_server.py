@@ -105,3 +105,33 @@ def test_session_tokens_offline_verification():
     for garbage in (None, "x", {}, {"payload": 1, "signature": "zz"},
                     {"payload": {}, "signature": "not-hex"}):
         assert tokens.verify(garbage, server.public_key) is None
+
+
+def test_registry_migrates_pre_metrics_db(tmp_path):
+    """A server DB created before the `metrics` column existed must be
+    migrated in place (CREATE TABLE IF NOT EXISTS alone would leave it
+    stale and break every provider row read)."""
+    import sqlite3
+
+    from symmetry_tpu.server.registry import Registry
+
+    path = str(tmp_path / "old.db")
+    db = sqlite3.connect(path)
+    db.execute("""CREATE TABLE peers (
+        peer_key TEXT PRIMARY KEY, discovery_key TEXT NOT NULL, name TEXT,
+        model_name TEXT NOT NULL, address TEXT,
+        public INTEGER NOT NULL DEFAULT 1, online INTEGER NOT NULL DEFAULT 1,
+        connections INTEGER NOT NULL DEFAULT 0,
+        max_connections INTEGER NOT NULL DEFAULT 10,
+        data_collection INTEGER NOT NULL DEFAULT 0, config TEXT,
+        joined_at REAL NOT NULL, last_seen REAL NOT NULL)""")
+    db.execute("INSERT INTO peers VALUES "
+               "('pk','dk','n','m','a',1,1,0,10,0,NULL,1.0,1.0)")
+    db.commit()
+    db.close()
+
+    reg = Registry(path)
+    reg.set_metrics("pk", {"tok_s": 5})
+    row = reg.get_provider("pk")
+    assert row is not None and row.metrics == {"tok_s": 5}
+    reg.close()
