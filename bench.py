@@ -102,10 +102,10 @@ def main() -> None:
     ap.add_argument("--smoke", action="store_true",
                     help="CPU-safe tiny-model run (verification, not perf)")
     ap.add_argument("--preset", default="llama3-8b")
-    ap.add_argument("--slots", type=int, default=64)
+    ap.add_argument("--slots", type=int, default=128)
     ap.add_argument("--steps", type=int, default=192)
     ap.add_argument("--prompt-len", type=int, default=128)
-    ap.add_argument("--max-seq", type=int, default=1024)
+    ap.add_argument("--max-seq", type=int, default=640)
     ap.add_argument("--dtype", default="bfloat16",
                     choices=("bfloat16", "float32"))
     ap.add_argument("--mesh-model", type=int, default=1,

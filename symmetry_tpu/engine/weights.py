@@ -29,10 +29,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from symmetry_tpu.models.llama import (
+    HF_EXPERT_MAP,
     HF_LAYER_MAP,
+    HF_MOE_ROUTER,
     HF_TOP_MAP,
     ModelConfig,
     config_from_hf,
+    hf_expert_name,
     init_params,
     param_logical_axes,
 )
@@ -50,9 +53,17 @@ class CheckpointError(RuntimeError):
 def convert_hf_state_dict(
     tensors: dict[str, np.ndarray], config: ModelConfig
 ) -> dict:
-    """Convert a full in-memory HF llama state dict to our param pytree."""
+    """Convert a full in-memory HF llama/mixtral state dict to our pytree."""
+    n_exp = getattr(config, "num_experts", 0)
     per_layer: dict[str, list] = {ours: [None] * config.num_layers
                                   for ours, _ in HF_LAYER_MAP.values()}
+    if n_exp:
+        # MoE FFN params come per (layer, expert); stack experts inside
+        # each layer. The dense FFN names are absent in mixtral files.
+        for ours in ("wg", "wu", "wd"):
+            per_layer[ours] = [[None] * n_exp
+                               for _ in range(config.num_layers)]
+        per_layer["router"] = [None] * config.num_layers
     top: dict[str, np.ndarray] = {}
     for name, arr in tensors.items():
         if name in HF_TOP_MAP:
@@ -61,13 +72,28 @@ def convert_hf_state_dict(
         elif name.startswith("model.layers."):
             rest = name[len("model.layers."):]
             idx_str, _, sub = rest.partition(".")
-            if sub not in HF_LAYER_MAP:
+            layer = int(idx_str)
+            if n_exp and sub == HF_MOE_ROUTER:
+                per_layer["router"][layer] = arr.T
+            elif n_exp and sub.startswith("block_sparse_moe.experts."):
+                parts = sub.split(".")       # experts . <e> . w1 . weight
+                expert, w = int(parts[2]), parts[3]
+                if w not in HF_EXPERT_MAP:
+                    raise CheckpointError(f"unmapped HF tensor {name!r}")
+                per_layer[HF_EXPERT_MAP[w]][layer][expert] = arr.T
+            elif sub in HF_LAYER_MAP:
+                ours, transpose = HF_LAYER_MAP[sub]
+                per_layer[ours][layer] = arr.T if transpose else arr
+            else:
                 raise CheckpointError(f"unmapped HF tensor {name!r}")
-            ours, transpose = HF_LAYER_MAP[sub]
-            per_layer[ours][int(idx_str)] = arr.T if transpose else arr
         else:
             raise CheckpointError(f"unmapped HF tensor {name!r}")
 
+    if n_exp:
+        for ours in ("wg", "wu", "wd"):
+            per_layer[ours] = [np.stack(experts) if all(
+                e is not None for e in experts) else None
+                for experts in per_layer[ours]]
     for ours, lst in per_layer.items():
         missing = [i for i, a in enumerate(lst) if a is None]
         if missing:
@@ -203,7 +229,31 @@ def load_checkpoint(
 
         return read
 
+    n_exp = getattr(config, "num_experts", 0)
+
     def layer_reader(ours: str) -> Callable:
+        if n_exp and ours == "router":
+            def read(index):
+                l_sl, *rest = _norm_index(index, 3)
+                layers = range(*l_sl.indices(config.num_layers))
+                per = [store.read_slice(
+                    f"model.layers.{l}.{HF_MOE_ROUTER}", tuple(rest), True)
+                    for l in layers]
+                return np.stack(per).astype(dtype)
+
+            return read
+        if n_exp and ours in ("wg", "wu", "wd"):
+            def read(index):
+                # stacked [L, X, in, out]: one HF tensor per (layer, expert)
+                l_sl, x_sl, *rest = _norm_index(index, 4)
+                layers = range(*l_sl.indices(config.num_layers))
+                experts = range(*x_sl.indices(n_exp))
+                per = [np.stack([store.read_slice(
+                    hf_expert_name(l, e, ours), tuple(rest), True)
+                    for e in experts]) for l in layers]
+                return np.stack(per).astype(dtype)
+
+            return read
         hf_sub, transpose = inv_layer[ours]
 
         def read(index):
@@ -255,16 +305,29 @@ def save_checkpoint(path: str, params: dict, config: ModelConfig) -> None:
         hf_name, transpose = inv_top[ours]
         arr = np.asarray(jax.device_get(params[ours]), dtype=np.float32)
         tensors[hf_name] = np.ascontiguousarray(arr.T) if transpose else arr
+    n_exp = getattr(config, "num_experts", 0)
     for ours, stacked in params["layers"].items():
-        hf_sub, transpose = {v[0]: (k, v[1]) for k, v in HF_LAYER_MAP.items()}[ours]
         host = np.asarray(jax.device_get(stacked), dtype=np.float32)
+        if n_exp and ours == "router":
+            for l in range(host.shape[0]):
+                tensors[f"model.layers.{l}.{HF_MOE_ROUTER}"] = (
+                    np.ascontiguousarray(host[l].T))
+            continue
+        if n_exp and ours in ("wg", "wu", "wd"):
+            for l in range(host.shape[0]):
+                for e in range(host.shape[1]):
+                    tensors[hf_expert_name(l, e, ours)] = (
+                        np.ascontiguousarray(host[l, e].T))
+            continue
+        hf_sub, transpose = {v[0]: (k, v[1]) for k, v in HF_LAYER_MAP.items()}[ours]
         for l in range(host.shape[0]):
             arr = host[l]
             tensors[f"model.layers.{l}.{hf_sub}"] = (
                 np.ascontiguousarray(arr.T) if transpose else np.ascontiguousarray(arr))
     save_file(tensors, os.path.join(path, "model.safetensors"))
     hf_cfg = {
-        "architectures": ["LlamaForCausalLM"],
+        "architectures": ["MixtralForCausalLM" if n_exp
+                          else "LlamaForCausalLM"],
         "vocab_size": config.vocab_size,
         "hidden_size": config.hidden_size,
         "num_hidden_layers": config.num_layers,
@@ -278,5 +341,8 @@ def save_checkpoint(path: str, params: dict, config: ModelConfig) -> None:
         "sliding_window": config.sliding_window,
         "head_dim": config.head_dim,
     }
+    if n_exp:
+        hf_cfg["num_local_experts"] = n_exp
+        hf_cfg["num_experts_per_tok"] = config.num_experts_per_tok
     with open(os.path.join(path, "config.json"), "w", encoding="utf-8") as fh:
         json.dump(hf_cfg, fh, indent=2)

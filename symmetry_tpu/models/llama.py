@@ -62,6 +62,15 @@ class ModelConfig:
         return self.num_kv_heads * self.dim_per_head
 
 
+@dataclass(frozen=True)
+class MoEConfig(ModelConfig):
+    """Mixture-of-experts variant (mixtral family): the MLP becomes
+    num_experts parallel FFNs with top-k routing (models/moe.py)."""
+
+    num_experts: int = 8
+    num_experts_per_tok: int = 2
+
+
 # Named presets; sizes from the public HF configs of each model family.
 PRESETS: dict[str, ModelConfig] = {
     # test-scale models (CPU-fast, exercised by the suite)
@@ -92,6 +101,16 @@ PRESETS: dict[str, ModelConfig] = {
     "mistral-7b": ModelConfig(
         vocab_size=32768, hidden_size=4096, num_layers=32, num_heads=32,
         num_kv_heads=8, intermediate_size=14336, rope_theta=1000000.0,
+    ),
+    "tiny-moe": MoEConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=128, rope_theta=10000.0,
+        max_position=512, num_experts=4, num_experts_per_tok=2,
+    ),
+    "mixtral-8x7b": MoEConfig(
+        vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, intermediate_size=14336, rope_theta=1000000.0,
+        num_experts=8, num_experts_per_tok=2,
     ),
 }
 
@@ -173,6 +192,11 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16,
                          quantized=quantize and name in QUANT_KEYS)
 
     L, E, F = c.num_layers, c.hidden_size, c.intermediate_size
+    n_exp = getattr(c, "num_experts", 0)
+    # MoE: FFN weights gain a leading experts dim; the router stays dense
+    # (it is contracted per token, tiny, and its logits feed a top-k).
+    ffn = (L, n_exp, E, F) if n_exp else (L, E, F)
+    ffn_d = (L, n_exp, F, E) if n_exp else (L, F, E)
     params = {
         "embed": dense(next(keys), (c.vocab_size, E), scale=0.02),
         "layers": {
@@ -182,12 +206,14 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16,
             "wk": dense(next(keys), (L, E, c.kv_dim), name="wk"),
             "wv": dense(next(keys), (L, E, c.kv_dim), name="wv"),
             "wo": dense(next(keys), (L, c.q_dim, E), name="wo"),
-            "wg": dense(next(keys), (L, E, F), name="wg"),
-            "wu": dense(next(keys), (L, E, F), name="wu"),
-            "wd": dense(next(keys), (L, F, E), name="wd"),
+            "wg": dense(next(keys), ffn, name="wg"),
+            "wu": dense(next(keys), ffn, name="wu"),
+            "wd": dense(next(keys), ffn_d, name="wd"),
         },
         "final_norm": jnp.ones((E,), dtype),
     }
+    if n_exp:
+        params["layers"]["router"] = dense(next(keys), (L, E, n_exp))
     if not c.tie_embeddings:
         params["lm_head"] = dense(next(keys), (E, c.vocab_size), scale=0.02,
                                   name="lm_head")
@@ -196,6 +222,11 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16,
 
 def param_logical_axes(config: ModelConfig) -> dict:
     """Pytree of logical-axis tuples, same structure as init_params output."""
+    moe = bool(getattr(config, "num_experts", 0))
+    ffn = (("layers", "experts", "embed", "mlp") if moe
+           else ("layers", "embed", "mlp"))
+    ffn_d = (("layers", "experts", "mlp", "embed") if moe
+             else ("layers", "mlp", "embed"))
     axes = {
         "embed": ("vocab", "embed"),
         "layers": {
@@ -205,12 +236,14 @@ def param_logical_axes(config: ModelConfig) -> dict:
             "wk": ("layers", "embed", "kv_heads"),
             "wv": ("layers", "embed", "kv_heads"),
             "wo": ("layers", "heads", "embed"),
-            "wg": ("layers", "embed", "mlp"),
-            "wu": ("layers", "embed", "mlp"),
-            "wd": ("layers", "mlp", "embed"),
+            "wg": ffn,
+            "wu": ffn,
+            "wd": ffn_d,
         },
         "final_norm": ("embed",),
     }
+    if moe:
+        axes["layers"]["router"] = ("layers", "embed", None)
     if not config.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
     return axes
@@ -317,8 +350,13 @@ def _layer(
     h = h + qmatmul(attn.reshape(B, S, nq * D), lp["wo"])
 
     x = rms_norm(h, lp["mlp_norm"], config.rms_eps)
-    h = h + qmatmul(jax.nn.silu(qmatmul(x, lp["wg"])) * qmatmul(x, lp["wu"]),
-                    lp["wd"])
+    if "router" in lp:
+        from symmetry_tpu.models.moe import moe_mlp
+
+        h = h + moe_mlp(x, lp, config)
+    else:
+        h = h + qmatmul(jax.nn.silu(qmatmul(x, lp["wg"]))
+                        * qmatmul(x, lp["wu"]), lp["wd"])
     return h, cache
 
 
@@ -456,10 +494,39 @@ HF_LAYER_MAP = {
     "mlp.up_proj.weight": ("wu", True),
     "mlp.down_proj.weight": ("wd", True),
 }
+# Mixtral: the MLP block is `block_sparse_moe` — a router (`gate`) plus
+# per-expert w1/w2/w3 Linears (w1=gate_proj, w2=down_proj, w3=up_proj).
+# All are HF [out, in] → transposed; experts stack on our leading dim.
+HF_MOE_ROUTER = "block_sparse_moe.gate.weight"            # → router (T)
+HF_EXPERT_MAP = {"w1": "wg", "w3": "wu", "w2": "wd"}      # all transposed
+
+
+def hf_expert_name(layer: int, expert: int, ours: str) -> str:
+    w = {v: k for k, v in HF_EXPERT_MAP.items()}[ours]
+    return f"model.layers.{layer}.block_sparse_moe.experts.{expert}.{w}.weight"
 
 
 def config_from_hf(hf: dict[str, Any]) -> ModelConfig:
-    """Build a ModelConfig from an HF config.json dict (llama/mistral shape)."""
+    """Build a ModelConfig from an HF config.json dict (llama/mistral/
+    mixtral shapes; mixtral's num_local_experts selects MoEConfig)."""
+    if hf.get("num_local_experts"):
+        return MoEConfig(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads",
+                                hf["num_attention_heads"]),
+            intermediate_size=hf["intermediate_size"],
+            head_dim=hf.get("head_dim"),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rms_eps=hf.get("rms_norm_eps", 1e-5),
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            sliding_window=hf.get("sliding_window"),
+            max_position=hf.get("max_position_embeddings", 8192),
+            num_experts=hf["num_local_experts"],
+            num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        )
     return ModelConfig(
         vocab_size=hf["vocab_size"],
         hidden_size=hf["hidden_size"],

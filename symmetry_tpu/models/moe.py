@@ -1,0 +1,82 @@
+"""Mixture-of-experts MLP (mixtral family) — dense-mixture, TPU-first.
+
+The reference has no model code at all (SURVEY §0); MoE enters through the
+framework's model-family coverage (mixtral-8x7b preset, llama.py) and the
+`expert` mesh axis (SURVEY §2.3: expert parallelism "only if MoE models
+are added" — they are).
+
+Design: DENSE mixture. Every expert processes every token; the top-k
+router gates (zeros outside the selected experts) weight the combine. Why
+this is the TPU-right shape for serving:
+
+  - A serving batch of B slots × top-2 routing touches essentially every
+    expert every step, so all expert weights stream from HBM regardless —
+    the decode step stays bandwidth-bound and skipping compute for
+    unselected (token, expert) pairs saves no HBM traffic.
+  - The expert dim becomes a leading batch dim of ONE big dot_general per
+    projection — the MXU sees [experts] × [tokens, embed] @ [embed, ffn]
+    batched matmuls, no gathers, no ragged dispatch, no recompiles.
+  - Sharding: experts map to the `expert` mesh axis and each expert's ffn
+    dim to `model` (parallel/sharding.py rules); XLA derives the combine
+    all-reduce from the shardings, exactly like the dense-MLP TP path.
+
+Capacity-factor dispatch (real token→expert all-to-all) becomes worthwhile
+at prefill scale on big meshes; the routing math here (softmax-over-top-k,
+renormalized) matches mixtral so that upgrade is drop-in.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from symmetry_tpu.ops.quant import QuantizedTensor
+
+
+def qmatmul_experts(x: jnp.ndarray, w) -> jnp.ndarray:
+    """[B, S, D] @ per-expert [X, D, F] -> [B, S, X, F].
+
+    QuantizedTensor experts keep the int8 payload as the dot operand (no
+    bf16 materialization — same rule as ops/quant.py qmatmul); per-column
+    scales [X, F] apply to the f32 accumulator."""
+    if isinstance(w, QuantizedTensor):
+        y = jax.lax.dot_general(
+            x, w.q,
+            dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [B, S, X, F]
+        return (y * w.scale).astype(x.dtype)
+    return jnp.einsum("bsd,xdf->bsxf", x, w)
+
+
+def route_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Router logits [B, S, X] -> dense gates [B, S, X]: softmax over the
+    top-k logits (mixtral semantics: normalize AFTER selection), zeros
+    elsewhere. Static-shape: one_hot scatter, no gathers."""
+    top_vals, top_idx = jax.lax.top_k(logits, k)          # [B, S, k]
+    probs = jax.nn.softmax(top_vals, axis=-1)
+    onehot = jax.nn.one_hot(top_idx, logits.shape[-1],
+                            dtype=probs.dtype)            # [B, S, k, X]
+    return jnp.einsum("bsk,bskx->bsx", probs, onehot)
+
+
+def moe_mlp(x: jnp.ndarray, lp: dict, config) -> jnp.ndarray:
+    """Dense-mixture MoE FFN: [B, S, E] -> [B, S, E]."""
+    gates = route_top_k(
+        jnp.asarray(x @ lp["router"], jnp.float32),
+        config.num_experts_per_tok).astype(x.dtype)       # [B, S, X]
+    h = jax.nn.silu(qmatmul_experts(x, lp["wg"])) * qmatmul_experts(
+        x, lp["wu"])                                      # [B, S, X, F]
+    # Per-expert down-projection then gated combine over experts.
+    wd = lp["wd"]
+    if isinstance(wd, QuantizedTensor):
+        y = jax.lax.dot_general(
+            h, wd.q,
+            dimension_numbers=(((3,), (1,)), ((2,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # batch over experts: [X, B, S, E]
+        y = (y * wd.scale[:, None, None, :]).astype(x.dtype)
+        y = jnp.moveaxis(y, 0, 2)                         # [B, S, X, E]
+    else:
+        y = jnp.einsum("bsxf,xfe->bsxe", h, wd)
+    return jnp.einsum("bsxe,bsx->bse", y, gates)

@@ -164,13 +164,17 @@ class DHTNode:
         loop = asyncio.get_running_loop()
         self._transport, _ = await loop.create_datagram_endpoint(
             lambda: _Protocol(self), local_addr=(host, port))
+        reached = False
         for addr in bootstrap or []:
             try:
                 await self._rpc(addr, {"type": "ping"})
-                # populate the table around our own id
-                await self._iterative_find(self.node_id)
+                reached = True
             except asyncio.TimeoutError:
                 logger.warning(f"dht bootstrap node {addr} unreachable")
+        if reached:
+            # one table-population lookup around our own id, after all
+            # bootstrap pings (not one full lookup per bootstrap node)
+            await self._iterative_find(self.node_id)
         task = asyncio.get_running_loop().create_task(self._maintenance())
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
@@ -191,12 +195,29 @@ class DHTNode:
     async def announce(self, topic: bytes, payload: dict) -> int:
         """Store (self, payload) under topic on the closest nodes; returns
         the number of nodes that accepted. Re-announced periodically until
-        unannounce()."""
+        unannounce(). Records are keyed by the payload's publicKey when
+        present, so a restarted announcer OVERWRITES its old record rather
+        than leaving a stale twin under a fresh DHT node id."""
         self._announcing[topic.hex()] = payload
         return await self._announce_once(topic, payload)
 
-    def unannounce(self, topic: bytes) -> None:
-        self._announcing.pop(topic.hex(), None)
+    async def unannounce(self, topic: bytes) -> None:
+        """Stop re-announcing AND delete the record from the remote nodes
+        holding it (hyperdht semantics) — without the RPC, a drained
+        provider would stay resolvable until TTL expiry (~10 min)."""
+        payload = self._announcing.pop(topic.hex(), None)
+        key = self._record_key(payload or {})
+        self._store.get(topic.hex(), {}).pop(key, None)
+        for node in self.table.closest(topic, K_BUCKET):
+            try:
+                await self._rpc(node.addr, {"type": "unannounce",
+                                            "topic": topic.hex(),
+                                            "key": key})
+            except asyncio.TimeoutError:
+                continue
+
+    def _record_key(self, payload: dict) -> str:
+        return str(payload.get("publicKey") or self.node_id.hex())
 
     async def lookup(self, topic: bytes) -> list[dict]:
         """Find peers announced under topic anywhere in the DHT."""
@@ -222,7 +243,7 @@ class DHTNode:
             except asyncio.TimeoutError:
                 self.table.remove(node.node_id)
         # Always store locally too: a 1-node network must still resolve.
-        self._store_value(topic.hex(), self.node_id.hex(), payload)
+        self._store_value(topic.hex(), self._record_key(payload), payload)
         return ok
 
     async def _iterative_find(self, target: bytes,
@@ -341,7 +362,19 @@ class DHTNode:
             sender = msg.get("from")
             if (isinstance(payload, dict) and isinstance(sender, list)
                     and len(topic_hex) == 64):
-                self._store_value(topic_hex, str(sender[0]), payload)
+                # Key by the announced publicKey (falling back to the DHT
+                # node id): a restarted announcer overwrites its old
+                # record instead of accumulating stale twins.
+                key = str(payload.get("publicKey") or sender[0])
+                self._store_value(topic_hex, key, payload)
                 return {"type": "stored"}
             return None
+        if mtype == "unannounce":
+            # Unauthenticated, like the rest of this control plane — the
+            # data plane authenticates end-to-end (Noise + provider key
+            # pinning), so a malicious unannounce can deny discovery but
+            # never impersonate a provider.
+            entries = self._store.get(msg.get("topic", ""), {})
+            entries.pop(str(msg.get("key", "")), None)
+            return {"type": "removed"}
         return None

@@ -6,6 +6,7 @@ per the two-tier design in SURVEY §5.8):
 
     data    — batch replication/sharding; DCN-safe (no per-layer collectives)
     context — sequence/ring-attention axis (long context, SURVEY §5.7)
+    expert  — MoE expert parallelism (models/moe.py); ICI collectives
     model   — tensor parallelism; all-reduce per layer, must stay on ICI
 
 A provider.yaml `tpu.mesh` mapping like {"data": 2, "model": 4} becomes a
@@ -21,7 +22,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("data", "context", "model")
+AXIS_ORDER = ("data", "context", "expert", "model")
 
 
 @dataclass(frozen=True)
@@ -30,6 +31,7 @@ class MeshSpec:
 
     data: int = 1
     context: int = 1
+    expert: int = 1
     model: int = 1
 
     @classmethod
@@ -41,7 +43,10 @@ class MeshSpec:
 
     @property
     def size(self) -> int:
-        return self.data * self.context * self.model
+        size = 1
+        for axis in AXIS_ORDER:
+            size *= getattr(self, axis)
+        return size
 
     def shape(self) -> dict[str, int]:
         return {a: getattr(self, a) for a in AXIS_ORDER}

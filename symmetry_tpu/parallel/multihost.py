@@ -90,7 +90,8 @@ def build_multihost_mesh(ici: MeshSpec | dict, dcn_data: int = 1):
             f"{total} devices but the job has {jax.device_count()} — every "
             f"global device must be in the mesh")
     ici_shape = tuple(getattr(ici, a) for a in AXIS_ORDER)
-    dcn_shape = (dcn_data, 1, 1)  # data axis is the only DCN-crossing axis
+    # data axis is the only DCN-crossing axis
+    dcn_shape = (dcn_data,) + (1,) * (len(AXIS_ORDER) - 1)
     if dcn_data > 1:
         try:
             # TPU pods: DCN granule = slice (device.slice_index).

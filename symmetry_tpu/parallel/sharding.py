@@ -34,6 +34,7 @@ DEFAULT_RULES: dict[str, str | None] = {
     "vocab": "model",
     "cache_seq": None,
     "context": "context",  # sequence-parallel activations (ring attention)
+    "experts": "expert",   # MoE expert parallelism (models/moe.py)
 }
 
 

@@ -162,22 +162,27 @@ class SymmetryProvider:
             return
         from symmetry_tpu.network.dht import DHTNode, parse_host_port
 
+        # Discovery is an add-on: NO failure here (bad config, occupied
+        # UDP port, unreachable bootstrap) may take down an otherwise
+        # healthy provider.
         try:
             bootstrap = [parse_host_port(e)
                          for e in dht_cfg.get("bootstrap", [])]
-        except ValueError as exc:
-            # Discovery is an add-on: a malformed bootstrap list must not
-            # take down an otherwise healthy provider.
+            self._dht = DHTNode()
+            await self._dht.start(dht_cfg.get("host", "0.0.0.0"),
+                                  int(dht_cfg.get("port", 0)),
+                                  bootstrap=bootstrap)
+            stored = await self._dht.announce(self.identity.discovery_key, {
+                "address": self.address,
+                "publicKey": self.identity.public_hex,
+                "modelName": self.config.model_name,
+            })
+        except (ValueError, TypeError, OSError) as exc:
             logger.error(f"dht disabled: {exc}")
+            if self._dht is not None:
+                await self._dht.stop()
+                self._dht = None
             return
-        self._dht = DHTNode()
-        await self._dht.start(dht_cfg.get("host", "0.0.0.0"),
-                              int(dht_cfg.get("port", 0)), bootstrap=bootstrap)
-        stored = await self._dht.announce(self.identity.discovery_key, {
-            "address": self.address,
-            "publicKey": self.identity.public_hex,
-            "modelName": self.config.model_name,
-        })
         logger.info(f"dht: announced on {stored} node(s) "
                     f"(topic {self.identity.discovery_key.hex()[:12]}…)")
 
@@ -188,7 +193,8 @@ class SymmetryProvider:
         """Graceful drain: stop accepting, finish in-flight, leave, close."""
         self._draining = True
         if self._dht is not None:
-            self._dht.unannounce(self.identity.discovery_key)
+            with contextlib.suppress(Exception):
+                await self._dht.unannounce(self.identity.discovery_key)
             await self._dht.stop()
             self._dht = None
         deadline = time.monotonic() + drain_timeout_s

@@ -201,3 +201,45 @@ class TestServerlessDiscovery:
                 await boot.stop()
 
         run(main())
+
+
+class TestUnannounce:
+    def test_unannounce_removes_remote_records(self):
+        async def main():
+            nodes = await make_network(4)
+            try:
+                topic = b"\x09" * 32
+                await nodes[1].announce(topic, {"address": "a",
+                                                "publicKey": "gone"})
+                assert any(p["publicKey"] == "gone"
+                           for p in await nodes[3].lookup(topic))
+                await nodes[1].unannounce(topic)
+                assert await nodes[3].lookup(topic) == []
+            finally:
+                await stop_all(nodes)
+
+        run(main())
+
+    def test_restart_overwrites_stale_record(self):
+        """Same publicKey re-announced from a NEW DHT node (provider
+        restart) must replace the old record, not accumulate beside it."""
+        async def main():
+            nodes = await make_network(4)
+            try:
+                topic = b"\x0a" * 32
+                await nodes[1].announce(topic, {"address": "old:1",
+                                                "publicKey": "pk-same"})
+                fresh = DHTNode()  # restarted provider: new random node id
+                await fresh.start("127.0.0.1", 0,
+                                  bootstrap=[("127.0.0.1", nodes[0].port)])
+                await fresh.announce(topic, {"address": "new:2",
+                                             "publicKey": "pk-same"})
+                peers = await nodes[3].lookup(topic)
+                mine = [p for p in peers if p["publicKey"] == "pk-same"]
+                assert len(mine) == 1, peers
+                assert mine[0]["address"] == "new:2"
+                await fresh.stop()
+            finally:
+                await stop_all(nodes)
+
+        run(main())
