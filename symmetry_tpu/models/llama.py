@@ -269,7 +269,8 @@ def _layer(
     seq_lens: jnp.ndarray,      # [B] valid tokens in this call's input
     config: ModelConfig,
     prefill_flash: bool,        # static: flash self-attention (fresh cache)
-    ring_mesh=None,             # static: Mesh => ring attention over context
+    ring_mesh=None,             # static: Mesh => sequence-parallel prefill
+    sp_mode: str = "ring",      # static: "ring" | "ulysses" (SURVEY §5.7)
 ) -> tuple[jnp.ndarray, KVCache]:
     B, S, E = h.shape
     D, nq, nkv = config.dim_per_head, config.num_heads, config.num_kv_heads
@@ -310,10 +311,17 @@ def _layer(
 
     if ring_mesh is not None:
         # Long-context prefill: sequence sharded over the `context` mesh
-        # axis, K/V blocks rotating on ICI (parallel/ring.py).
-        from symmetry_tpu.parallel.ring import ring_attention
+        # axis — K/V blocks rotating on ICI (parallel/ring.py), or one
+        # all-to-all head scatter when heads divide the shard count
+        # (parallel/ulysses.py).
+        if sp_mode == "ulysses":
+            from symmetry_tpu.parallel.ulysses import ulysses_attention
 
-        attn = ring_attention(q, k, v, seq_lens, ring_mesh)
+            attn = ulysses_attention(q, k, v, seq_lens, ring_mesh)
+        else:
+            from symmetry_tpu.parallel.ring import ring_attention
+
+            attn = ring_attention(q, k, v, seq_lens, ring_mesh)
     elif prefill_flash:
         # Prefill-from-empty: attention is over this call's own K/V — the
         # Pallas kernel streams K/V blocks through VMEM instead of
@@ -369,6 +377,7 @@ def forward_hidden(
     *,
     prefill_flash: bool = False,  # static: caller guarantees cache is empty
     ring_mesh=None,               # static: context-parallel prefill mesh
+    sp_mode: str = "ring",        # static: "ring" | "ulysses"
 ) -> tuple[jnp.ndarray, KVCache]:
     """Decoder trunk: returns (final-norm hidden states [B, S, E], cache).
 
@@ -380,9 +389,12 @@ def forward_hidden(
     VALID ONLY when cache.lengths are all zero (engine prefill's case) —
     both fast paths attend to this call's own K/V, not the cache.
     ring_mesh additionally shards the sequence over the mesh's `context`
-    axis (ring attention, parallel/ring.py); it requires prefill_flash's
-    empty-cache contract and S divisible by the ring size. Sliding-window
-    models (mistral-v0.1) fall back to the masked path in all cases.
+    axis; it requires prefill_flash's empty-cache contract and S divisible
+    by the shard count. sp_mode picks the scheme: "ring" rotates K/V
+    blocks (parallel/ring.py, any head count), "ulysses" head-scatters via
+    one all-to-all (parallel/ulysses.py, needs kv_heads % shards == 0).
+    Sliding-window models (mistral-v0.1) fall back to the masked path in
+    all cases.
     """
     B, S = tokens.shape
     if seq_lens is None:
@@ -400,6 +412,41 @@ def forward_hidden(
     use_flash = (prefill_flash and use_ring is None and S > 1
                  and config.sliding_window is None)
 
+    n_stacked = jax.tree.leaves(params["layers"])[0].shape[0]
+    if n_stacked != config.num_layers:
+        # A config/checkpoint depth mismatch must fail loudly: the cache is
+        # sized by config, and out-of-bounds scatter/gather on the extra
+        # layers would be silently dropped/clamped instead of erroring.
+        raise ValueError(f"params carry {n_stacked} stacked layers but "
+                         f"config.num_layers = {config.num_layers}")
+    h = jnp.take(params["embed"], tokens, axis=0)
+    h, new_cache = run_layers(params["layers"], h, cache, positions,
+                              kv_valid, seq_lens, config,
+                              use_flash=use_flash, use_ring=use_ring,
+                              sp_mode=sp_mode)
+    h = rms_norm(h, params["final_norm"], config.rms_eps)
+    return h, new_cache._replace(lengths=kv_valid)
+
+
+def run_layers(
+    layers_params: dict,
+    h: jnp.ndarray,
+    cache: KVCache,            # leading layer dim == layers_params' leading dim
+    positions: jnp.ndarray,
+    kv_valid: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    config: ModelConfig,
+    *,
+    use_flash: bool = False,
+    use_ring=None,
+    sp_mode: str = "ring",
+) -> tuple[jnp.ndarray, KVCache]:
+    """Scan a stack of decoder layers over `h`. Factored out of
+    forward_hidden so pipeline parallelism (parallel/pipeline.py) can run a
+    STAGE'S local slice of layers against its local cache shard — layer
+    indices inside are local to the stack passed in, which is exactly what
+    the per-stage cache expects."""
+
     def body(carry, xs):
         # The cache rides the CARRY, scatter-updated in place: scan xs/ys
         # would stream the full [L, B, T, K, D] arrays through HBM every
@@ -407,17 +454,15 @@ def forward_hidden(
         h, c = carry
         lp, l = xs
         h, c = _layer(h, lp, c, l, positions, kv_valid,
-                      seq_lens, config, use_flash, ring_mesh=use_ring)
+                      seq_lens, config, use_flash, ring_mesh=use_ring,
+                      sp_mode=sp_mode)
         return (h, c), None
 
-    h = jnp.take(params["embed"], tokens, axis=0)
-
+    n_layers = jax.tree.leaves(layers_params)[0].shape[0]
     (h, new_cache), _ = jax.lax.scan(
         body, (h, cache),
-        (params["layers"], jnp.arange(config.num_layers, dtype=jnp.int32)))
-
-    h = rms_norm(h, params["final_norm"], config.rms_eps)
-    return h, new_cache._replace(lengths=kv_valid)
+        (layers_params, jnp.arange(n_layers, dtype=jnp.int32)))
+    return h, new_cache
 
 
 def logits_from_hidden(params: dict, config: ModelConfig,

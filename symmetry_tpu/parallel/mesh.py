@@ -4,6 +4,8 @@ Axis convention (ordered outer→inner so the innermost axis maps to the
 fastest interconnect — `model` collectives ride ICI, `data` may span DCN,
 per the two-tier design in SURVEY §5.8):
 
+    stage   — pipeline parallelism (parallel/pipeline.py): layer stages,
+              point-to-point activation transfers only; DCN-safe
     data    — batch replication/sharding; DCN-safe (no per-layer collectives)
     context — sequence/ring-attention axis (long context, SURVEY §5.7)
     expert  — MoE expert parallelism (models/moe.py); ICI collectives
@@ -22,13 +24,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("data", "context", "expert", "model")
+AXIS_ORDER = ("stage", "data", "context", "expert", "model")
 
 
 @dataclass(frozen=True)
 class MeshSpec:
     """Declarative mesh shape, e.g. MeshSpec(data=1, model=8)."""
 
+    stage: int = 1
     data: int = 1
     context: int = 1
     expert: int = 1

@@ -90,8 +90,9 @@ def build_multihost_mesh(ici: MeshSpec | dict, dcn_data: int = 1):
             f"{total} devices but the job has {jax.device_count()} — every "
             f"global device must be in the mesh")
     ici_shape = tuple(getattr(ici, a) for a in AXIS_ORDER)
-    # data axis is the only DCN-crossing axis
-    dcn_shape = (dcn_data,) + (1,) * (len(AXIS_ORDER) - 1)
+    # data is the DCN-crossing axis (stage PP over DCN would be the other
+    # legal choice; this helper builds data-over-DCN meshes)
+    dcn_shape = tuple(dcn_data if a == "data" else 1 for a in AXIS_ORDER)
     if dcn_data > 1:
         try:
             # TPU pods: DCN granule = slice (device.slice_index).

@@ -24,9 +24,10 @@ def mesh():
 
 class TestMesh:
     def test_axis_order_and_sizes(self, mesh):
-        assert mesh.axis_names == ("data", "context", "expert", "model")
+        assert mesh.axis_names == ("stage", "data", "context", "expert",
+                                   "model")
         assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
-            "data": 2, "context": 1, "expert": 1, "model": 4}
+            "stage": 1, "data": 2, "context": 1, "expert": 1, "model": 4}
 
     def test_rejects_oversized(self):
         with pytest.raises(ValueError, match="devices"):
