@@ -1,0 +1,54 @@
+"""Serverless discovery demo: provider announces on the Kademlia DHT, a
+client resolves it by public key and chats — no central server involved.
+
+    PYTHONPATH=. python examples/dht_discovery.py
+"""
+
+import asyncio
+
+from symmetry_tpu.client.client import SymmetryClient
+from symmetry_tpu.identity import Identity
+from symmetry_tpu.network.dht import DHTNode
+from symmetry_tpu.provider.config import ConfigManager
+from symmetry_tpu.provider.provider import SymmetryProvider
+from symmetry_tpu.transport.tcp import TcpTransport
+
+
+async def main() -> None:
+    # A bootstrap node — in production any long-lived peer serves this role.
+    bootstrap = DHTNode()
+    await bootstrap.start("127.0.0.1", 0)
+    boot_addr = f"127.0.0.1:{bootstrap.port}"
+
+    ident = Identity.generate()
+    config = ConfigManager(config={
+        "name": "dht-demo-provider",
+        "public": False,                  # no central server at all
+        "serverKey": "00" * 32,
+        "modelName": "tiny:dht-demo",
+        "apiProvider": "echo",
+        "dataCollectionEnabled": False,
+        "dht": {"host": "127.0.0.1", "bootstrap": [boot_addr]},
+    })
+    provider = SymmetryProvider(config, transport=TcpTransport(),
+                                identity=ident)
+    await provider.start("127.0.0.1:0")
+    print(f"provider announced; share its public key: {ident.public_hex}")
+
+    client = SymmetryClient(Identity.generate(), TcpTransport())
+    details = await client.discover(ident.public_key, [boot_addr])
+    print(f"resolved via DHT: model={details.model_name!r} "
+          f"address={details.address}")
+
+    session = await client.connect(details)
+    text = await session.chat_text(
+        [{"role": "user", "content": "discovered you on the DHT"}])
+    print(f"assistant> {text}")
+
+    await session.close()
+    await provider.stop(drain_timeout_s=3)
+    await bootstrap.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

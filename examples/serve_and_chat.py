@@ -1,0 +1,71 @@
+"""End-to-end demo: server + TPU-native provider + client in one process.
+
+Runs the full three-role network (broker, provider with the in-process JAX
+engine, client) over real TCP loopback and streams a chat completion.
+Works on CPU (tiny random-weight model) — on a TPU host, point
+`model_preset` at llama3-8b and `checkpoint_path` at an HF safetensors dir.
+
+    PYTHONPATH=. python examples/serve_and_chat.py
+"""
+
+import asyncio
+
+from symmetry_tpu.client.client import SymmetryClient
+from symmetry_tpu.identity import Identity
+from symmetry_tpu.provider.config import ConfigManager
+from symmetry_tpu.provider.provider import SymmetryProvider
+from symmetry_tpu.server.broker import SymmetryServer
+from symmetry_tpu.transport.tcp import TcpTransport
+
+
+async def main() -> None:
+    transport = TcpTransport()
+
+    server_ident = Identity.generate()
+    server = SymmetryServer(server_ident, transport)
+    await server.start("127.0.0.1:4848")
+
+    config = ConfigManager(config={
+        "name": "demo-provider",
+        "public": True,
+        "serverKey": server_ident.public_hex,
+        "modelName": "tiny:demo",
+        "apiProvider": "tpu_native",
+        "dataCollectionEnabled": False,
+        "tpu": {
+            "model_preset": "tiny",        # llama3-8b on a real TPU host
+            "dtype": "float32",            # bfloat16 on TPU
+            "quantization": "int8",
+            "kv_quantization": "int8",
+            "max_batch_size": 4,
+            "max_seq_len": 256,
+            "prefill_buckets": [64, 128],
+            "decode_block": 8,
+        },
+    })
+    provider = SymmetryProvider(config, transport=transport,
+                                server_address="127.0.0.1:4848")
+    await provider.start("127.0.0.1:0")
+    await provider.wait_registered()
+
+    client = SymmetryClient(Identity.generate(), transport)
+    details = await client.request_provider(
+        "127.0.0.1:4848", server_ident.public_key, "tiny:demo")
+    print(f"assigned provider {details.peer_key[:12]}… at {details.address}")
+
+    session = await client.connect(details)
+    print("assistant> ", end="", flush=True)
+    async for delta in session.chat(
+            [{"role": "user", "content": "hello from the demo"}],
+            max_tokens=32, temperature=0.7):
+        print(delta, end="", flush=True)
+    print()
+    print("provider stats:", provider.stats())
+
+    await session.close()
+    await provider.stop(drain_timeout_s=5)
+    await server.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -50,6 +50,7 @@ class ProviderSession:
     def __init__(self, peer: Peer, details: ProviderDetails) -> None:
         self._peer = peer
         self._details = details
+        self._streaming = False  # single-reader guard (chat vs stats)
 
     async def __aenter__(self) -> "ProviderSession":
         return self
@@ -67,6 +68,7 @@ class ProviderSession:
         max_tokens: int | None = None,
         temperature: float | None = None,
         top_p: float | None = None,
+        top_k: int | None = None,
         seed: int | None = None,
     ) -> AsyncIterator[str]:
         """Send one inference request; yield text deltas as they stream."""
@@ -74,35 +76,61 @@ class ProviderSession:
         if self._details.session_token is not None:
             payload["sessionToken"] = self._details.session_token
         for k, v in (("max_tokens", max_tokens), ("temperature", temperature),
-                     ("top_p", top_p), ("seed", seed)):
+                     ("top_p", top_p), ("top_k", top_k), ("seed", seed)):
             if v is not None:
                 payload[k] = v
+        if self._streaming:
+            raise ClientError("session is single-reader: a stream is "
+                              "already in flight on this connection")
         await self._peer.send(MessageKey.INFERENCE, payload)
         dialect = self._details.provider_dialect
-        while True:
-            msg = await self._peer.recv()
-            if msg is None:
-                raise ClientError("provider closed connection mid-stream")
-            if msg.key == MessageKey.INFERENCE:
-                # stream-start marker; carries the backend dialect
-                dialect = (msg.data or {}).get("provider", dialect)
-            elif msg.key == MessageKey.TOKEN_CHUNK:
-                raw = (msg.data or {}).get("raw", "")
-                parsed = safe_parse_stream_response(raw)
-                if parsed is None:
-                    continue
-                delta = get_chat_data_from_provider(dialect, parsed)
-                if delta:
-                    yield delta
-            elif msg.key == MessageKey.INFERENCE_ENDED:
-                return
-            elif msg.key == MessageKey.INFERENCE_ERROR:
-                raise ClientError((msg.data or {}).get("error", "inference failed"))
-            else:
-                logger.debug(f"client: ignoring key {msg.key!r}")
+        self._streaming = True
+        try:
+            while True:
+                msg = await self._peer.recv()
+                if msg is None:
+                    raise ClientError("provider closed connection mid-stream")
+                if msg.key == MessageKey.INFERENCE:
+                    # stream-start marker; carries the backend dialect
+                    dialect = (msg.data or {}).get("provider", dialect)
+                elif msg.key == MessageKey.TOKEN_CHUNK:
+                    raw = (msg.data or {}).get("raw", "")
+                    parsed = safe_parse_stream_response(raw)
+                    if parsed is None:
+                        continue
+                    delta = get_chat_data_from_provider(dialect, parsed)
+                    if delta:
+                        yield delta
+                elif msg.key == MessageKey.INFERENCE_ENDED:
+                    return
+                elif msg.key == MessageKey.INFERENCE_ERROR:
+                    raise ClientError(
+                        (msg.data or {}).get("error", "inference failed"))
+                else:
+                    logger.debug(f"client: ignoring key {msg.key!r}")
+        finally:
+            self._streaming = False
 
     async def chat_text(self, messages: list[dict[str, str]], **kw) -> str:
         return "".join([d async for d in self.chat(messages, **kw)])
+
+    async def stats(self) -> dict:
+        """Query the provider's serving metrics snapshot (tok/s, TTFT/e2e
+        percentiles, occupancy).
+
+        The session is single-reader: calling this while a chat() stream is
+        in flight would swallow that stream's chunks, so it is refused."""
+        if self._streaming:
+            raise ClientError("cannot query stats while a chat stream is "
+                              "in flight on this session")
+        await self._peer.send(MessageKey.METRICS)
+        while True:
+            msg = await self._peer.recv()
+            if msg is None:
+                raise ClientError("provider closed during stats query")
+            if msg.key == MessageKey.METRICS:
+                return msg.data or {}
+            logger.debug(f"client: ignoring key {msg.key!r} awaiting stats")
 
     async def close(self) -> None:
         if not self._peer.closed:

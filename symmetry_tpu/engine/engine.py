@@ -33,13 +33,23 @@ from symmetry_tpu.models.llama import (
     KVCache,
     ModelConfig,
     cache_logical_axes,
-    forward,
     forward_hidden,
     init_cache,
     init_params,
     logits_from_hidden,
     preset,
 )
+
+
+def _stage_rules(mesh):
+    """PIPELINE_RULES when the mesh has an active stage axis, else None —
+    the ONE place pipeline-mode detection lives (constructor, jit builder,
+    and from_tpu_config all route through it)."""
+    if mesh is not None and dict(mesh.shape).get("stage", 1) > 1:
+        from symmetry_tpu.parallel.pipeline import PIPELINE_RULES
+
+        return PIPELINE_RULES
+    return None
 from symmetry_tpu.ops.sampling import sample_tokens
 from symmetry_tpu.parallel.mesh import MeshSpec, build_mesh
 from symmetry_tpu.parallel.sharding import shardings_for
@@ -58,7 +68,10 @@ class DecodeState(NamedTuple):
     temperature: jnp.ndarray  # [B] float32
     top_p: jnp.ndarray        # [B] float32
     top_k: jnp.ndarray        # [B] int32
-    rng: jax.Array            # PRNG key
+    rng: jax.Array            # [B] PRNG keys — one stream PER SLOT, seeded
+                              # at insert: a seeded request reproduces its
+                              # whole completion and no slot's sampling is
+                              # perturbed by other traffic
 
 
 @dataclass(frozen=True)
@@ -73,7 +86,7 @@ class SamplingParams:
         return cls(
             temperature=req.temperature if req.temperature is not None else 0.0,
             top_p=req.top_p if req.top_p is not None else 1.0,
-            top_k=0,
+            top_k=getattr(req, "top_k", None) or 0,
             seed=req.seed,
         )
 
@@ -98,11 +111,26 @@ class InferenceEngine:
         cache_dtype=jnp.bfloat16,
         decode_block: int = 1,
         kv_quant: bool = False,
+        pipeline_microbatches: int = 1,
     ) -> None:
         self.config = config
         self.params = params
         self.tokenizer = tokenizer
         self.mesh = mesh
+        # Pipeline-parallel serving (parallel/pipeline.py): a stage axis of
+        # size > 1 routes prefill AND decode through the staged microbatch
+        # schedule; params/cache must be stage-sharded (PIPELINE_RULES).
+        self._rules = _stage_rules(mesh)
+        self.pipeline = self._rules is not None
+        if self.pipeline and max_slots % pipeline_microbatches:
+            raise EngineError(
+                f"max_slots {max_slots} must divide into "
+                f"{pipeline_microbatches} pipeline microbatches")
+        if pipeline_microbatches > 1 and not self.pipeline:
+            raise EngineError(
+                "pipeline_microbatches > 1 requires a mesh with a stage "
+                "axis > 1 — the setting would otherwise be silently inert")
+        self.pipeline_microbatches = pipeline_microbatches
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len
         self.prefill_buckets = tuple(sorted(b for b in prefill_buckets
@@ -121,12 +149,14 @@ class InferenceEngine:
         c = config
 
         if mesh is not None:
+            rules = self._rules
             cax = cache_logical_axes(quantized=kv_quant)
             rep = jax.NamedSharding(mesh, jax.sharding.PartitionSpec())
-            sc = shardings_for(cax.k_scale, mesh) if kv_quant else None
+            sc = (shardings_for(cax.k_scale, mesh, rules)
+                  if kv_quant else None)
             self._cache_shardings = KVCache(
-                k=shardings_for(cax.k, mesh),
-                v=shardings_for(cax.v, mesh),
+                k=shardings_for(cax.k, mesh, rules),
+                v=shardings_for(cax.v, mesh, rules),
                 # lengths stays REPLICATED (O(slots) int32): the host reads
                 # individual slots, and on a multi-process data axis a
                 # batch-sharded slot may live on another host.
@@ -148,7 +178,7 @@ class InferenceEngine:
                 temperature=jnp.zeros((max_slots,), jnp.float32),
                 top_p=jnp.ones((max_slots,), jnp.float32),
                 top_k=jnp.zeros((max_slots,), jnp.int32),
-                rng=jax.random.key(0),
+                rng=jax.random.split(jax.random.key(0), max_slots),
             )
 
         if self._state_shardings is not None:
@@ -174,14 +204,30 @@ class InferenceEngine:
     def _build_jits(self) -> None:
         cfg = self.config
 
+        def trunk(params, tokens, cache, seq_lens=None, prefill_flash=False):
+            """forward_hidden, routed through the pipeline schedule when a
+            stage axis is active (params/cache are stage-sharded then)."""
+            if self.pipeline:
+                from symmetry_tpu.parallel.pipeline import (
+                    pipeline_forward_hidden)
+
+                n_micro = (self.pipeline_microbatches
+                           if tokens.shape[0] == self.max_slots else 1)
+                return pipeline_forward_hidden(
+                    params, cfg, tokens, cache, self.mesh,
+                    seq_lens=seq_lens, n_microbatches=n_micro,
+                    prefill_flash=prefill_flash)
+            return forward_hidden(params, cfg, tokens, cache,
+                                  seq_lens=seq_lens,
+                                  prefill_flash=prefill_flash)
+
         def prefill(params, tokens, true_len, temp, top_p, top_k, rng):
             """tokens [1, Sb] padded; returns (first sampled token, prefix KV)."""
             S = tokens.shape[1]
             cache = init_cache(cfg, 1, S, self.cache_dtype,
                                quantized=self.kv_quant)
-            h, cache = forward_hidden(params, cfg, tokens, cache,
-                                      seq_lens=true_len[None],
-                                      prefill_flash=True)
+            h, cache = trunk(params, tokens, cache,
+                             seq_lens=true_len[None], prefill_flash=True)
             # Project ONLY the last valid position through the LM head —
             # head cost is per-position × vocab, and padded positions are
             # garbage anyway.
@@ -194,7 +240,7 @@ class InferenceEngine:
             return tok[0], cache
 
         def insert(state: DecodeState, prefix: KVCache, slot, true_len,
-                   first_token, temp, top_p, top_k) -> DecodeState:
+                   first_token, temp, top_p, top_k, rng) -> DecodeState:
             """Copy a batch-1 prefilled prefix into decode slot `slot`."""
 
             def place(big, small):
@@ -220,14 +266,17 @@ class InferenceEngine:
                 temperature=state.temperature.at[slot].set(temp),
                 top_p=state.top_p.at[slot].set(top_p),
                 top_k=state.top_k.at[slot].set(top_k),
-                rng=state.rng,
+                # The request's own PRNG stream continues into decode: a
+                # seeded request reproduces its whole completion.
+                rng=state.rng.at[slot].set(rng),
             )
 
         def decode_one(state: DecodeState, params):
             """Advance every slot one token."""
-            logits, cache = forward(params, cfg, state.last_token[:, None],
-                                    state.cache)
-            rng, step_key = jax.random.split(state.rng)
+            h, cache = trunk(params, state.last_token[:, None], state.cache)
+            logits = logits_from_hidden(params, cfg, h)
+            split = jax.vmap(lambda k: jax.random.split(k, 2))(state.rng)
+            rng, step_key = split[:, 0], split[:, 1]
             toks = sample_tokens(logits[:, 0], step_key, state.temperature,
                                  state.top_p, state.top_k)
             return DecodeState(
@@ -258,8 +307,9 @@ class InferenceEngine:
             # the layouts can't silently diverge (parallel/sharding.py).
             from symmetry_tpu.parallel.sharding import DEFAULT_RULES
 
+            base_rules = self._rules or DEFAULT_RULES
             cax = cache_logical_axes(quantized=self.kv_quant)
-            prefix_rules = {**DEFAULT_RULES, "batch": None}
+            prefix_rules = {**base_rules, "batch": None}
             psc = (shardings_for(cax.k_scale, self.mesh, prefix_rules)
                    if self.kv_quant else None)
             prefix_shard = KVCache(
@@ -307,14 +357,15 @@ class InferenceEngine:
             # unseeded prompt sample the same first token on every request.
             self._requests_served += 1
             key = jax.random.fold_in(self._base_key, self._requests_served)
+        prefill_key, decode_key = jax.random.split(key)
         tok, prefix = self._prefill(
             self.params, jnp.asarray(padded), jnp.int32(n),
             jnp.float32(sampling.temperature), jnp.float32(sampling.top_p),
-            jnp.int32(sampling.top_k), key)
+            jnp.int32(sampling.top_k), prefill_key)
         self.state = self._insert(
             self.state, prefix, jnp.int32(slot), jnp.int32(n), tok,
             jnp.float32(sampling.temperature), jnp.float32(sampling.top_p),
-            jnp.int32(sampling.top_k))
+            jnp.int32(sampling.top_k), decode_key)
         return int(tok)
 
     def release_slot(self, slot: int) -> None:
@@ -386,11 +437,15 @@ class InferenceEngine:
                 f"unsupported tpu.kv_quantization {tpu_cfg.kv_quantization!r}")
         quant = tpu_cfg.quantization == "int8"
 
+        # Pipeline mode (mesh stage > 1): params shard their layer dim over
+        # the stage axis instead of replicating it.
+        rules = _stage_rules(mesh)
+
         if tpu_cfg.checkpoint_path:
             from symmetry_tpu.engine.weights import load_checkpoint
 
             params, config = load_checkpoint(
-                tpu_cfg.checkpoint_path, mesh=mesh, dtype=dtype)
+                tpu_cfg.checkpoint_path, mesh=mesh, rules=rules, dtype=dtype)
             if quant:
                 from symmetry_tpu.models.llama import quantize_params
 
@@ -403,14 +458,13 @@ class InferenceEngine:
                 # Initialize directly as global sharded arrays (works when
                 # the mesh spans processes; device_put of host values
                 # cannot). Quantized leaves init int8 in the same program.
-                shardings = shardings_for(param_logical_axes(config), mesh)
+                axes = param_logical_axes(config)
                 if quant:
                     from symmetry_tpu.models.llama import (
                         quantized_logical_axes)
 
-                    shardings = shardings_for(
-                        quantized_logical_axes(param_logical_axes(config)),
-                        mesh)
+                    axes = quantized_logical_axes(axes)
+                shardings = shardings_for(axes, mesh, rules)
                 params = jax.jit(
                     lambda: init_params(config, jax.random.key(0), dtype,
                                         quantize=quant),
@@ -426,4 +480,5 @@ class InferenceEngine:
             cache_dtype=dtype,
             decode_block=getattr(tpu_cfg, "decode_block", 1),
             kv_quant=tpu_cfg.kv_quantization == "int8",
+            pipeline_microbatches=tpu_cfg.pipeline_microbatches,
         )

@@ -25,7 +25,7 @@ SAMPLING_TOP_CAP = 64
 
 def sample_tokens(
     logits: jnp.ndarray,        # [B, V] float
-    key: jax.Array,             # PRNG key
+    key: jax.Array,             # PRNG key — scalar, or [B] per-slot keys
     temperature: jnp.ndarray,   # [B] float; 0 => greedy
     top_p: jnp.ndarray,         # [B] float in (0, 1]; 1 => disabled
     top_k: jnp.ndarray,         # [B] int32; 0 => disabled
@@ -62,6 +62,10 @@ def sample_tokens(
     keep &= mass_before < top_p[:, None]
 
     masked = jnp.where(keep, top_logits, NEG_INF)
-    choice_rank = jax.random.categorical(key, masked, axis=-1)  # [B]
+    if key.ndim:  # [B] per-slot keys: each row draws from its own stream
+        choice_rank = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row))(key, masked)
+    else:
+        choice_rank = jax.random.categorical(key, masked, axis=-1)  # [B]
     sampled = jnp.take_along_axis(top_idx, choice_rank[:, None], axis=-1)[:, 0]
     return sampled.astype(jnp.int32)

@@ -48,6 +48,7 @@ class TpuConfig:
     max_seq_len: int = 2048            # KV capacity per slot
     prefill_buckets: tuple[int, ...] = (128, 512, 2048)
     decode_block: int = 8              # decode steps per device dispatch
+    pipeline_microbatches: int = 1     # GPipe microbatches (mesh stage > 1)
     checkpoint_path: str | None = None  # HF safetensors dir; None → random init
     tokenizer_path: str | None = None   # tokenizer.json; None → byte tokenizer
     model_family: str = "llama"         # models/registry key

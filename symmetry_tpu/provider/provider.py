@@ -344,6 +344,10 @@ class SymmetryProvider:
                     await self._handle_inference(peer, msg.data or {})
                 elif msg.key == MessageKey.PING:
                     await peer.send(MessageKey.PONG)
+                elif msg.key == MessageKey.METRICS:
+                    # Clients may query the serving snapshot (tok/s, TTFT
+                    # percentiles) — same payload the server receives.
+                    await peer.send(MessageKey.METRICS, self.stats())
                 elif msg.key == MessageKey.LEAVE:
                     break
         finally:
@@ -386,6 +390,7 @@ class SymmetryProvider:
             max_tokens=data.get("max_tokens"),
             temperature=data.get("temperature"),
             top_p=data.get("top_p"),
+            top_k=data.get("top_k"),
             seed=data.get("seed"),
         )
         self._in_flight += 1

@@ -71,6 +71,10 @@ def test_full_flow_stream():
         # Second request over the same session works.
         text = await session.chat_text([{"role": "user", "content": "again"}])
         assert text == "again"
+        # Clients can query the provider's serving snapshot in-session.
+        stats = await session.stats()
+        assert stats["requests"] == 2
+        assert stats["ttft_s"]["count"] == 2
         await session.close()
         for p in provs:
             await p.stop()

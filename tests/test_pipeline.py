@@ -60,6 +60,9 @@ class TestPipelineForward:
             np.testing.assert_allclose(
                 np.asarray(got_cache.k)[:, b, :n],
                 np.asarray(want_cache.k)[:, b, :n], rtol=2e-4, atol=2e-4)
+            np.testing.assert_allclose(
+                np.asarray(got_cache.v)[:, b, :n],
+                np.asarray(want_cache.v)[:, b, :n], rtol=2e-4, atol=2e-4)
 
     def test_decode_continues_from_pipeline_prefill(self, pp_mesh, setup):
         """Prefill through the pipeline, then decode steps through the
@@ -167,3 +170,34 @@ class TestPipelineForward:
         np.testing.assert_allclose(np.asarray(got_h), np.asarray(want_h),
                                    rtol=2e-4, atol=2e-4)
         assert got_cache.k.dtype == jnp.int8
+
+
+class TestPipelineEngine:
+    def test_engine_pipeline_greedy_matches_plain(self, pp_mesh, setup):
+        """The full serving engine in pipeline mode (stage-sharded params
+        and cache, staged prefill + decode) reproduces the plain engine's
+        greedy tokens."""
+        from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
+        from symmetry_tpu.engine.tokenizer import ByteTokenizer
+        from symmetry_tpu.models.llama import param_logical_axes
+        from symmetry_tpu.parallel import MeshSpec, build_mesh, shardings_for
+
+        params, _ = setup
+        mesh2 = build_mesh(MeshSpec(stage=2))
+
+        def run(mesh, p, n_micro):
+            eng = InferenceEngine(
+                CFG, p, ByteTokenizer(), mesh=mesh, max_slots=2,
+                max_seq_len=64, prefill_buckets=(16,),
+                cache_dtype=jnp.float32, pipeline_microbatches=n_micro)
+            toks = [eng.prefill_and_insert(0, list(b"pipeline serve"),
+                                           SamplingParams())]
+            eng.prefill_and_insert(1, list(b"other"), SamplingParams())
+            for _ in range(6):
+                toks.append(int(eng.decode_step()[0]))
+            return toks
+
+        sharded = jax.device_put(
+            params, shardings_for(param_logical_axes(CFG), mesh2,
+                                  PIPELINE_RULES))
+        assert run(mesh2, sharded, 2) == run(None, params, 1)
