@@ -64,9 +64,12 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
 
     prompt = list(range(1, prompt_len + 1))
     t_prefill0 = time.perf_counter()
-    for slot in range(slots):
-        engine.prefill_and_insert(slot, [p % 200 for p in prompt],
-                                  SamplingParams(temperature=0.7, seed=slot))
+    group = max(engine.PREFILL_BATCHES)
+    for start in range(0, slots, group):
+        engine.prefill_and_insert_many(
+            [(slot, [p % 200 for p in prompt],
+              SamplingParams(temperature=0.7, seed=slot))
+             for slot in range(start, min(start + group, slots))])
     prefill_s = time.perf_counter() - t_prefill0
 
     # One warm dispatch, then measure. `steps` counts decode steps; each

@@ -50,7 +50,16 @@ class ProviderSession:
     def __init__(self, peer: Peer, details: ProviderDetails) -> None:
         self._peer = peer
         self._details = details
-        self._streaming = False  # single-reader guard (chat vs stats)
+        # The wire protocol carries no request ids (reference parity:
+        # one in-flight inference per peer, src/provider.ts:195), so the
+        # session SERIALIZES its requests — concurrent chat()/stats()
+        # calls queue instead of racing the single reader and misrouting
+        # chunks. True concurrency = multiple sessions.
+        self._lock = asyncio.Lock()
+        # An abandoned chat() generator (break before the stream ended)
+        # leaves the old completion's chunks in the socket; the session is
+        # then desynced and must be replaced, never silently reused.
+        self._desynced = False
 
     async def __aenter__(self) -> "ProviderSession":
         return self
@@ -79,37 +88,51 @@ class ProviderSession:
                      ("top_p", top_p), ("top_k", top_k), ("seed", seed)):
             if v is not None:
                 payload[k] = v
-        if self._streaming:
-            raise ClientError("session is single-reader: a stream is "
-                              "already in flight on this connection")
-        await self._peer.send(MessageKey.INFERENCE, payload)
-        dialect = self._details.provider_dialect
-        self._streaming = True
-        try:
-            while True:
-                msg = await self._peer.recv()
-                if msg is None:
-                    raise ClientError("provider closed connection mid-stream")
-                if msg.key == MessageKey.INFERENCE:
-                    # stream-start marker; carries the backend dialect
-                    dialect = (msg.data or {}).get("provider", dialect)
-                elif msg.key == MessageKey.TOKEN_CHUNK:
-                    raw = (msg.data or {}).get("raw", "")
-                    parsed = safe_parse_stream_response(raw)
-                    if parsed is None:
-                        continue
-                    delta = get_chat_data_from_provider(dialect, parsed)
-                    if delta:
-                        yield delta
-                elif msg.key == MessageKey.INFERENCE_ENDED:
-                    return
-                elif msg.key == MessageKey.INFERENCE_ERROR:
-                    raise ClientError(
-                        (msg.data or {}).get("error", "inference failed"))
-                else:
-                    logger.debug(f"client: ignoring key {msg.key!r}")
-        finally:
-            self._streaming = False
+        self._check_usable()
+        async with self._lock:
+            await self._peer.send(MessageKey.INFERENCE, payload)
+            dialect = self._details.provider_dialect
+            ended = False
+            try:
+                while True:
+                    msg = await self._peer.recv()
+                    if msg is None:
+                        ended = True  # wire gone; nothing left to misroute
+                        raise ClientError(
+                            "provider closed connection mid-stream")
+                    if msg.key == MessageKey.INFERENCE:
+                        # stream-start marker; carries the backend dialect
+                        dialect = (msg.data or {}).get("provider", dialect)
+                    elif msg.key == MessageKey.TOKEN_CHUNK:
+                        raw = (msg.data or {}).get("raw", "")
+                        parsed = safe_parse_stream_response(raw)
+                        if parsed is None:
+                            continue
+                        delta = get_chat_data_from_provider(dialect, parsed)
+                        if delta:
+                            yield delta
+                    elif msg.key == MessageKey.INFERENCE_ENDED:
+                        ended = True
+                        return
+                    elif msg.key == MessageKey.INFERENCE_ERROR:
+                        ended = True
+                        raise ClientError(
+                            (msg.data or {}).get("error", "inference failed"))
+                    else:
+                        logger.debug(f"client: ignoring key {msg.key!r}")
+            finally:
+                if not ended:
+                    # Abandoned mid-stream: remaining chunks sit in the
+                    # socket, so any later request would read the OLD
+                    # completion. Poison the session instead.
+                    self._desynced = True
+
+    def _check_usable(self) -> None:
+        if self._desynced:
+            raise ClientError(
+                "session desynced: a previous chat stream was abandoned "
+                "before it finished — close this session and open a new "
+                "one (or consume streams fully)")
 
     async def chat_text(self, messages: list[dict[str, str]], **kw) -> str:
         return "".join([d async for d in self.chat(messages, **kw)])
@@ -118,19 +141,20 @@ class ProviderSession:
         """Query the provider's serving metrics snapshot (tok/s, TTFT/e2e
         percentiles, occupancy).
 
-        The session is single-reader: calling this while a chat() stream is
-        in flight would swallow that stream's chunks, so it is refused."""
-        if self._streaming:
-            raise ClientError("cannot query stats while a chat stream is "
-                              "in flight on this session")
-        await self._peer.send(MessageKey.METRICS)
-        while True:
-            msg = await self._peer.recv()
-            if msg is None:
-                raise ClientError("provider closed during stats query")
-            if msg.key == MessageKey.METRICS:
-                return msg.data or {}
-            logger.debug(f"client: ignoring key {msg.key!r} awaiting stats")
+        Serialized with chat() on the session lock — the wire has no
+        request multiplexing, so a concurrent reader would swallow an
+        in-flight stream's chunks."""
+        self._check_usable()
+        async with self._lock:
+            await self._peer.send(MessageKey.METRICS)
+            while True:
+                msg = await self._peer.recv()
+                if msg is None:
+                    raise ClientError("provider closed during stats query")
+                if msg.key == MessageKey.METRICS:
+                    return msg.data or {}
+                logger.debug(
+                    f"client: ignoring key {msg.key!r} awaiting stats")
 
     async def close(self) -> None:
         if not self._peer.closed:

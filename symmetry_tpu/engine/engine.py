@@ -41,6 +41,12 @@ from symmetry_tpu.models.llama import (
 )
 
 
+from symmetry_tpu.ops.sampling import sample_tokens
+from symmetry_tpu.parallel.mesh import MeshSpec, build_mesh
+from symmetry_tpu.parallel.sharding import shardings_for
+from symmetry_tpu.engine.tokenizer import Tokenizer, get_tokenizer
+
+
 def _stage_rules(mesh):
     """PIPELINE_RULES when the mesh has an active stage axis, else None —
     the ONE place pipeline-mode detection lives (constructor, jit builder,
@@ -50,10 +56,6 @@ def _stage_rules(mesh):
 
         return PIPELINE_RULES
     return None
-from symmetry_tpu.ops.sampling import sample_tokens
-from symmetry_tpu.parallel.mesh import MeshSpec, build_mesh
-from symmetry_tpu.parallel.sharding import shardings_for
-from symmetry_tpu.engine.tokenizer import Tokenizer, get_tokenizer
 
 
 class EngineError(RuntimeError):
@@ -222,30 +224,38 @@ class InferenceEngine:
                                   prefill_flash=prefill_flash)
 
         def prefill(params, tokens, true_len, temp, top_p, top_k, rng):
-            """tokens [1, Sb] padded; returns (first sampled token, prefix KV)."""
-            S = tokens.shape[1]
-            cache = init_cache(cfg, 1, S, self.cache_dtype,
+            """tokens [N, Sb] padded; returns (first tokens [N], prefix KV).
+
+            N > 1 is COALESCED prefill (scheduler batches concurrent
+            arrivals into one dispatch — each dispatch costs a full
+            host↔device round-trip, so admission bursts would otherwise
+            serialize into p99 TTFT)."""
+            N, S = tokens.shape
+            cache = init_cache(cfg, N, S, self.cache_dtype,
                                quantized=self.kv_quant)
             h, cache = trunk(params, tokens, cache,
-                             seq_lens=true_len[None], prefill_flash=True)
+                             seq_lens=true_len, prefill_flash=True)
             # Project ONLY the last valid position through the LM head —
             # head cost is per-position × vocab, and padded positions are
             # garbage anyway.
             h_last = jnp.take_along_axis(
-                h, (true_len - 1)[None, None, None].astype(jnp.int32),
-                axis=1)  # [1, 1, E]
-            last = logits_from_hidden(params, cfg, h_last)[:, 0]  # [1, V]
-            tok = sample_tokens(last, rng, temp[None], top_p[None],
-                                top_k[None])  # [1]
-            return tok[0], cache
+                h, (true_len - 1)[:, None, None].astype(jnp.int32),
+                axis=1)  # [N, 1, E]
+            last = logits_from_hidden(params, cfg, h_last)[:, 0]  # [N, V]
+            toks = sample_tokens(last, rng, temp, top_p, top_k)  # [N] keys
+            return toks, cache
 
-        def insert(state: DecodeState, prefix: KVCache, slot, true_len,
+        def insert(state: DecodeState, prefix: KVCache, row, slot, true_len,
                    first_token, temp, top_p, top_k, rng) -> DecodeState:
-            """Copy a batch-1 prefilled prefix into decode slot `slot`."""
+            """Copy row `row` of a batch-N prefilled prefix into decode
+            slot `slot` (scalars arrive as [N] arrays, indexed by row)."""
 
-            def place(big, small):
-                # big [L,B,T,...] <- small [L,1,Sb,...] at [:, slot, 0]
+            def place(big, small_batch):
+                # big [L,B,T,...] <- small_batch[:, row] at [:, slot, 0]
                 # (KV payloads are rank 5, scale planes rank 4)
+                sizes = (small_batch.shape[0], 1) + small_batch.shape[2:]
+                src = (0, row) + (0,) * (small_batch.ndim - 2)
+                small = jax.lax.dynamic_slice(small_batch, src, sizes)
                 start = (0, slot, 0) + (0,) * (big.ndim - 3)
                 return jax.lax.dynamic_update_slice(
                     big, small.astype(big.dtype), start)
@@ -255,20 +265,20 @@ class InferenceEngine:
                 v=place(state.cache.v, prefix.v),
                 # The first sampled token's KV is not here yet: the next
                 # decode step writes it at position true_len.
-                lengths=state.cache.lengths.at[slot].set(true_len),
+                lengths=state.cache.lengths.at[slot].set(true_len[row]),
                 **({"k_scale": place(state.cache.k_scale, prefix.k_scale),
                     "v_scale": place(state.cache.v_scale, prefix.v_scale)}
                    if self.kv_quant else {}),
             )
             return DecodeState(
                 cache=cache,
-                last_token=state.last_token.at[slot].set(first_token),
-                temperature=state.temperature.at[slot].set(temp),
-                top_p=state.top_p.at[slot].set(top_p),
-                top_k=state.top_k.at[slot].set(top_k),
+                last_token=state.last_token.at[slot].set(first_token[row]),
+                temperature=state.temperature.at[slot].set(temp[row]),
+                top_p=state.top_p.at[slot].set(top_p[row]),
+                top_k=state.top_k.at[slot].set(top_k[row]),
                 # The request's own PRNG stream continues into decode: a
                 # seeded request reproduces its whole completion.
-                rng=state.rng.at[slot].set(rng),
+                rng=state.rng.at[slot].set(rng[row]),
             )
 
         def decode_one(state: DecodeState, params):
@@ -340,33 +350,77 @@ class InferenceEngine:
             f"prompt of {prompt_len} tokens exceeds the largest prefill "
             f"bucket ({self.prefill_buckets[-1]})")
 
+    # Coalesced-prefill batch sizes: one compiled prefill program per
+    # (batch, bucket) pair, so batch is bucketed too.
+    PREFILL_BATCHES = (1, 2, 4)
+
     def prefill_and_insert(self, slot: int, prompt_ids: list[int],
                            sampling: SamplingParams) -> int:
         """Prefill a prompt and install it in `slot`; returns first token."""
-        n = len(prompt_ids)
-        if n == 0:
-            raise EngineError("empty prompt")
-        bucket = self.bucket_for(n)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = prompt_ids
+        return self.prefill_and_insert_many(
+            [(slot, prompt_ids, sampling)])[0]
 
-        if sampling.seed is not None:
-            key = jax.random.key(sampling.seed)
-        else:
-            # Per-request entropy: a fixed per-slot key would make the same
-            # unseeded prompt sample the same first token on every request.
-            self._requests_served += 1
-            key = jax.random.fold_in(self._base_key, self._requests_served)
-        prefill_key, decode_key = jax.random.split(key)
-        tok, prefix = self._prefill(
-            self.params, jnp.asarray(padded), jnp.int32(n),
-            jnp.float32(sampling.temperature), jnp.float32(sampling.top_p),
-            jnp.int32(sampling.top_k), prefill_key)
-        self.state = self._insert(
-            self.state, prefix, jnp.int32(slot), jnp.int32(n), tok,
-            jnp.float32(sampling.temperature), jnp.float32(sampling.top_p),
-            jnp.int32(sampling.top_k), decode_key)
-        return int(tok)
+    def prefill_and_insert_many(
+        self, assignments: list[tuple[int, list[int], SamplingParams]],
+    ) -> list[int]:
+        """Prefill several prompts in ONE device dispatch and install each
+        in its slot; returns their first tokens. Coalescing matters because
+        each dispatch pays a host↔device round-trip: admitting a burst of
+        arrivals one-by-one serializes that cost into the last request's
+        TTFT (SURVEY §7 hard-part 3)."""
+        if not assignments:
+            return []
+        if any(len(ids) == 0 for _, ids, _ in assignments):
+            raise EngineError("empty prompt")
+        n_req = len(assignments)
+        if n_req > self.PREFILL_BATCHES[-1]:
+            raise EngineError(
+                f"at most {self.PREFILL_BATCHES[-1]} prompts per coalesced "
+                f"prefill")
+        batch = next(b for b in self.PREFILL_BATCHES if b >= n_req)
+        bucket = max(self.bucket_for(len(ids)) for _, ids, _ in assignments)
+
+        padded = np.zeros((batch, bucket), np.int32)
+        lens = np.zeros((batch,), np.int32)
+        temps = np.zeros((batch,), np.float32)
+        top_ps = np.ones((batch,), np.float32)
+        top_ks = np.zeros((batch,), np.int32)
+        prefill_keys, decode_keys = [], []
+        for i in range(batch):
+            # Pad rows replay the last request — harmless compute, never
+            # inserted.
+            _, ids, sampling = assignments[min(i, n_req - 1)]
+            padded[i, :len(ids)] = ids
+            lens[i] = len(ids)
+            temps[i] = sampling.temperature
+            top_ps[i] = sampling.top_p
+            top_ks[i] = sampling.top_k
+            if sampling.seed is not None:
+                key = jax.random.key(sampling.seed)
+            else:
+                # Per-request entropy: a fixed per-slot key would make the
+                # same unseeded prompt sample identically every time.
+                self._requests_served += 1
+                key = jax.random.fold_in(self._base_key,
+                                         self._requests_served)
+            pk, dk = jax.random.split(key)
+            prefill_keys.append(pk)
+            decode_keys.append(dk)
+
+        lens_arr = jnp.asarray(lens)
+        temps_arr = jnp.asarray(temps)
+        top_ps_arr = jnp.asarray(top_ps)
+        top_ks_arr = jnp.asarray(top_ks)
+        decode_keys_arr = jnp.stack(decode_keys)
+        toks, prefix = self._prefill(
+            self.params, jnp.asarray(padded), lens_arr, temps_arr,
+            top_ps_arr, top_ks_arr, jnp.stack(prefill_keys))
+        for i, (slot, _, _) in enumerate(assignments):
+            self.state = self._insert(
+                self.state, prefix, jnp.int32(i), jnp.int32(slot), lens_arr,
+                toks, temps_arr, top_ps_arr, top_ks_arr, decode_keys_arr)
+        host_toks = np.asarray(toks)
+        return [int(host_toks[i]) for i in range(n_req)]
 
     def release_slot(self, slot: int) -> None:
         """A finished slot's cache lane is garbage until reuse (insert
@@ -374,11 +428,33 @@ class InferenceEngine:
         scheduler's slot lifecycle has a single engine-visible seam."""
 
     def warmup(self) -> None:
-        """Compile the decode program before traffic: serving must never
-        stall every active stream on a fresh XLA compile (~30 s on a real
-        chip). Call before the first insert — warmup advances device state
-        with garbage that is only harmless on an empty cache."""
+        """Compile every serving program before traffic: decode, and the
+        full (PREFILL_BATCHES × prefill_buckets) prefill/insert grid. A
+        fresh XLA compile mid-traffic (~30 s on a real chip) would stall
+        every active stream — the first coalesced burst must not pay it.
+        Call before the first insert — warmup advances device state with
+        garbage that is only harmless on an empty cache."""
         self.state, _ = self._decode(self.params, self.state)
+        for batch in self.PREFILL_BATCHES:
+            if batch > self.max_slots:
+                continue
+            for bucket in self.prefill_buckets:
+                toks, prefix = self._prefill(
+                    self.params, jnp.zeros((batch, bucket), jnp.int32),
+                    jnp.ones((batch,), jnp.int32),
+                    jnp.zeros((batch,), jnp.float32),
+                    jnp.ones((batch,), jnp.float32),
+                    jnp.zeros((batch,), jnp.int32),
+                    jax.random.split(jax.random.key(0), batch))
+                # Insert compiles per (batch, bucket) too; slot 0 with
+                # true_len 0 leaves the state semantically untouched.
+                self.state = self._insert(
+                    self.state, prefix, jnp.int32(0), jnp.int32(0),
+                    jnp.zeros((batch,), jnp.int32), toks,
+                    jnp.zeros((batch,), jnp.float32),
+                    jnp.ones((batch,), jnp.float32),
+                    jnp.zeros((batch,), jnp.int32),
+                    jax.random.split(jax.random.key(0), batch))
 
     def decode_steps(self) -> np.ndarray:
         """decode_block tokens for every slot; host gets [K, B] int32."""

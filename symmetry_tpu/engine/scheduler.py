@@ -159,7 +159,10 @@ class Scheduler:
                     if self._stopping.is_set():
                         return
                     continue
-                self._place(item)
+                # Hand the popped item straight to admission (re-putting it
+                # would reorder it BEHIND arrivals that raced in while we
+                # were blocked — inverted FIFO for the earliest request).
+                self._admit_new(carry=item)
                 continue
 
             # One dispatch yields a [K, B] block of tokens (K = decode_block);
@@ -205,40 +208,83 @@ class Scheduler:
             if self._debug:
                 self._check_invariants()
 
-    def _admit_new(self) -> bool:
-        """Place queued requests into free slots. Returns True if inbox empty."""
+    def _admit_new(self, carry: GenRequest | None = None) -> bool:
+        """Place queued requests into free slots. Returns True if inbox
+        empty. Concurrent arrivals coalesce into ONE prefill dispatch when
+        the engine supports it (prefill_and_insert_many) — per-dispatch
+        round-trips would otherwise serialize into the tail TTFT. `carry`
+        is an already-popped request admitted ahead of the queue."""
+        many = getattr(self.engine, "prefill_and_insert_many", None)
+        batch_cap = (max(getattr(self.engine, "PREFILL_BATCHES", (1,)))
+                     if many is not None else 1)
         while self._free:
-            try:
-                item = self._inbox.get_nowait()
-            except queue.Empty:
-                return True
-            if item is None:
-                continue
-            self._place(item)
+            group: list[tuple[int, GenRequest]] = []
+            while self._free and len(group) < batch_cap:
+                if carry is not None:
+                    item, carry = carry, None
+                else:
+                    try:
+                        item = self._inbox.get_nowait()
+                    except queue.Empty:
+                        break
+                if item is None:
+                    continue
+                if item.cancelled():
+                    # Cancelled while queued still gets its terminal event —
+                    # the consumer is awaiting it.
+                    self._emit_cb(item, TokenEvent(
+                        text="", token_id=None, done=True,
+                        finish_reason="cancelled"))
+                    continue
+                group.append((self._free.pop(), item))
+            if not group:
+                return self._inbox.empty()
+            self._place_group(group)
+        if carry is not None:
+            # No free slot took it (all busy): back to the queue rather
+            # than dropping the request.
+            self._inbox.put(carry)
         return self._inbox.empty()
 
-    def _place(self, req: GenRequest) -> None:
-        if req.cancelled():
-            # Cancelled while queued still gets its terminal event — the
-            # consumer is awaiting it (same contract as active cancellation).
-            self._emit_cb(req, TokenEvent(
-                text="", token_id=None, done=True, finish_reason="cancelled"))
+    def _place_group(self, group: list[tuple[int, GenRequest]]) -> None:
+        # Requests the engine would reject (e.g. prompt beyond the largest
+        # bucket) must fail individually, not poison the whole batch.
+        ready: list[tuple[int, GenRequest]] = []
+        for slot, req in group:
+            try:
+                if not req.prompt_ids:
+                    raise ValueError("empty prompt")
+                self.engine.bucket_for(len(req.prompt_ids))
+            except Exception as exc:  # noqa: BLE001
+                self._free.append(slot)
+                self._emit_cb(req, TokenEvent(
+                    text="", token_id=None, done=True, finish_reason="error",
+                    error=str(exc)))
+                continue
+            ready.append((slot, req))
+        if not ready:
             return
         try:
-            slot = self._free.pop()
-        except IndexError:  # raced: requeue
-            self._inbox.put(req)
-            return
-        try:
-            first = self.engine.prefill_and_insert(slot, req.prompt_ids,
-                                                   req.sampling)
+            if len(ready) > 1:
+                firsts = self.engine.prefill_and_insert_many(
+                    [(slot, req.prompt_ids, req.sampling)
+                     for slot, req in ready])
+            else:
+                slot0, req0 = ready[0]
+                firsts = [self.engine.prefill_and_insert(
+                    slot0, req0.prompt_ids, req0.sampling)]
         except Exception as exc:  # noqa: BLE001 — engine errors → stream error
-            self._free.append(slot)
-            log.error(f"prefill failed for request {req.id}: {exc}")
-            self._emit_cb(req, TokenEvent(
-                text="", token_id=None, done=True, finish_reason="error",
-                error=str(exc)))
+            for slot, req in ready:
+                self._free.append(slot)
+                log.error(f"prefill failed for request {req.id}: {exc}")
+                self._emit_cb(req, TokenEvent(
+                    text="", token_id=None, done=True, finish_reason="error",
+                    error=str(exc)))
             return
+        for (slot, req), first in zip(ready, firsts):
+            self._activate(slot, req, first)
+
+    def _activate(self, slot: int, req: GenRequest, first: int) -> None:
         active = _ActiveSlot(req=req, decoder=self.engine.tokenizer.stream_decoder(),
                              prompt_len=len(req.prompt_ids))
         active.first_token_at = time.monotonic()

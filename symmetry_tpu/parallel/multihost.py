@@ -276,3 +276,7 @@ class MultihostEngine:
 
     def slot_length(self, slot: int) -> int:
         return self._loop.engine.slot_length(slot)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """Host-side validation only — no broadcast needed."""
+        return self._loop.engine.bucket_for(prompt_len)

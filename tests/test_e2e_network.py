@@ -252,3 +252,30 @@ def test_concurrent_clients_one_provider():
         await server.stop()
 
     run(main())
+
+
+def test_abandoned_stream_poisons_session():
+    """Breaking out of a chat stream leaves undrained chunks on the wire;
+    the session must refuse further use instead of serving stale tokens."""
+    async def main():
+        hub = MemoryTransport()
+        server, provs, server_ident = await start_system(hub)
+        client = SymmetryClient(Identity.from_name("cli-a"), hub)
+        details = await client.request_provider(
+            "mem://server", server_ident.public_key, "echo-model")
+        session = await client.connect(details)
+        agen = session.chat(
+            [{"role": "user", "content": "one two three four"}])
+        first = await agen.__anext__()
+        assert first
+        await agen.aclose()  # abandon mid-stream
+        import pytest as _pytest
+
+        with _pytest.raises(Exception, match="desynced"):
+            await session.chat_text([{"role": "user", "content": "again"}])
+        await session.close()
+        for p in provs:
+            await p.stop()
+        await server.stop()
+
+    run(main())
