@@ -55,8 +55,11 @@ def convert_hf_state_dict(
 ) -> dict:
     """Convert a full in-memory HF llama/mixtral state dict to our pytree."""
     n_exp = getattr(config, "num_experts", 0)
-    per_layer: dict[str, list] = {ours: [None] * config.num_layers
-                                  for ours, _ in HF_LAYER_MAP.values()}
+    per_layer: dict[str, list] = {
+        ours: [None] * config.num_layers
+        for ours, _ in HF_LAYER_MAP.values()
+        # bias params exist only for attention_bias (qwen2) configs
+        if config.attention_bias or ours not in ("bq", "bk", "bv")}
     if n_exp:
         # MoE FFN params come per (layer, expert); stack experts inside
         # each layer. The dense FFN names are absent in mixtral files.
@@ -83,6 +86,10 @@ def convert_hf_state_dict(
                 per_layer[HF_EXPERT_MAP[w]][layer][expert] = arr.T
             elif sub in HF_LAYER_MAP:
                 ours, transpose = HF_LAYER_MAP[sub]
+                if ours not in per_layer:
+                    raise CheckpointError(
+                        f"checkpoint has {name!r} but the config does not "
+                        f"enable attention_bias")
                 per_layer[ours][layer] = arr.T if transpose else arr
             else:
                 raise CheckpointError(f"unmapped HF tensor {name!r}")
@@ -327,7 +334,9 @@ def save_checkpoint(path: str, params: dict, config: ModelConfig) -> None:
     save_file(tensors, os.path.join(path, "model.safetensors"))
     hf_cfg = {
         "architectures": ["MixtralForCausalLM" if n_exp
-                          else "LlamaForCausalLM"],
+                          else ("Qwen2ForCausalLM" if config.attention_bias
+                                else "LlamaForCausalLM")],
+        "attention_bias": config.attention_bias,
         "vocab_size": config.vocab_size,
         "hidden_size": config.hidden_size,
         "num_hidden_layers": config.num_layers,

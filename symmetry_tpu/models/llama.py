@@ -47,6 +47,7 @@ class ModelConfig:
     rms_eps: float = 1e-5
     tie_embeddings: bool = False
     sliding_window: int | None = None    # mistral-v0.1 style local attention
+    attention_bias: bool = False         # qwen2-style QKV projection biases
     max_position: int = 8192
 
     @property
@@ -111,6 +112,16 @@ PRESETS: dict[str, ModelConfig] = {
         vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
         num_kv_heads=8, intermediate_size=14336, rope_theta=1000000.0,
         num_experts=8, num_experts_per_tok=2,
+    ),
+    "qwen2-7b": ModelConfig(
+        vocab_size=152064, hidden_size=3584, num_layers=28, num_heads=28,
+        num_kv_heads=4, intermediate_size=18944, rope_theta=1000000.0,
+        rms_eps=1e-6, attention_bias=True,
+    ),
+    "tiny-qwen": ModelConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=128, rope_theta=10000.0,
+        max_position=512, attention_bias=True,
     ),
 }
 
@@ -214,6 +225,11 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16,
     }
     if n_exp:
         params["layers"]["router"] = dense(next(keys), (L, E, n_exp))
+    if c.attention_bias:
+        # qwen2: biases on q/k/v projections only (not o/mlp)
+        params["layers"]["bq"] = jnp.zeros((L, c.q_dim), dtype)
+        params["layers"]["bk"] = jnp.zeros((L, c.kv_dim), dtype)
+        params["layers"]["bv"] = jnp.zeros((L, c.kv_dim), dtype)
     if not c.tie_embeddings:
         params["lm_head"] = dense(next(keys), (E, c.vocab_size), scale=0.02,
                                   name="lm_head")
@@ -244,6 +260,10 @@ def param_logical_axes(config: ModelConfig) -> dict:
     }
     if moe:
         axes["layers"]["router"] = ("layers", "embed", None)
+    if config.attention_bias:
+        axes["layers"]["bq"] = ("layers", "heads")
+        axes["layers"]["bk"] = ("layers", "kv_heads")
+        axes["layers"]["bv"] = ("layers", "kv_heads")
     if not config.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
     return axes
@@ -276,9 +296,16 @@ def _layer(
     D, nq, nkv = config.dim_per_head, config.num_heads, config.num_kv_heads
 
     x = rms_norm(h, lp["attn_norm"], config.rms_eps)
-    q = qmatmul(x, lp["wq"]).reshape(B, S, nq, D)
-    k = qmatmul(x, lp["wk"]).reshape(B, S, nkv, D)
-    v = qmatmul(x, lp["wv"]).reshape(B, S, nkv, D)
+    q = qmatmul(x, lp["wq"])
+    k = qmatmul(x, lp["wk"])
+    v = qmatmul(x, lp["wv"])
+    if config.attention_bias:  # qwen2 family
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(B, S, nq, D)
+    k = k.reshape(B, S, nkv, D)
+    v = v.reshape(B, S, nkv, D)
     q = apply_rope(q, positions, config.rope_theta)
     k = apply_rope(k, positions, config.rope_theta)
 
@@ -534,6 +561,10 @@ HF_LAYER_MAP = {
     "self_attn.q_proj.weight": ("wq", True),
     "self_attn.k_proj.weight": ("wk", True),
     "self_attn.v_proj.weight": ("wv", True),
+    # qwen2: QKV projection biases (absent in llama/mistral checkpoints)
+    "self_attn.q_proj.bias": ("bq", False),
+    "self_attn.k_proj.bias": ("bk", False),
+    "self_attn.v_proj.bias": ("bv", False),
     "self_attn.o_proj.weight": ("wo", True),
     "mlp.gate_proj.weight": ("wg", True),
     "mlp.up_proj.weight": ("wu", True),
@@ -553,7 +584,14 @@ def hf_expert_name(layer: int, expert: int, ours: str) -> str:
 
 def config_from_hf(hf: dict[str, Any]) -> ModelConfig:
     """Build a ModelConfig from an HF config.json dict (llama/mistral/
-    mixtral shapes; mixtral's num_local_experts selects MoEConfig)."""
+    qwen2/mixtral shapes; mixtral's num_local_experts selects MoEConfig)."""
+    arch = (hf.get("architectures") or [""])[0]
+    # qwen2 configs carry a vestigial sliding_window alongside
+    # use_sliding_window: false — honoring it would silently disable every
+    # fast attention path (flash prefill, ring, the Pallas decode kernel).
+    sliding = hf.get("sliding_window")
+    if hf.get("use_sliding_window") is False:
+        sliding = None
     if hf.get("num_local_experts"):
         return MoEConfig(
             vocab_size=hf["vocab_size"],
@@ -567,7 +605,8 @@ def config_from_hf(hf: dict[str, Any]) -> ModelConfig:
             rope_theta=hf.get("rope_theta", 10000.0),
             rms_eps=hf.get("rms_norm_eps", 1e-5),
             tie_embeddings=hf.get("tie_word_embeddings", False),
-            sliding_window=hf.get("sliding_window"),
+            sliding_window=sliding,
+            attention_bias=hf.get("attention_bias", "Qwen2" in arch),
             max_position=hf.get("max_position_embeddings", 8192),
             num_experts=hf["num_local_experts"],
             num_experts_per_tok=hf.get("num_experts_per_tok", 2),
@@ -583,6 +622,9 @@ def config_from_hf(hf: dict[str, Any]) -> ModelConfig:
         rope_theta=hf.get("rope_theta", 10000.0),
         rms_eps=hf.get("rms_norm_eps", 1e-5),
         tie_embeddings=hf.get("tie_word_embeddings", False),
-        sliding_window=hf.get("sliding_window"),
+        sliding_window=sliding,
+        # older qwen2 configs carry no attention_bias key; the architecture
+        # implies it (HF modeling_qwen2 hardcodes bias=True on q/k/v).
+        attention_bias=hf.get("attention_bias", "Qwen2" in arch),
         max_position=hf.get("max_position_embeddings", 8192),
     )

@@ -1,0 +1,133 @@
+"""Qwen2 family: QKV attention biases through model, engine, checkpoint."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
+from symmetry_tpu.engine.tokenizer import ByteTokenizer
+from symmetry_tpu.models import forward, init_cache, init_params, preset
+from symmetry_tpu.models.llama import config_from_hf, param_logical_axes
+
+
+class TestQwenModel:
+    def test_params_carry_biases(self):
+        cfg = preset("tiny-qwen")
+        params = init_params(cfg, jax.random.key(0), jnp.float32)
+        assert params["layers"]["bq"].shape == (2, 64)
+        assert params["layers"]["bk"].shape == (2, 32)
+        axes = param_logical_axes(cfg)
+        assert axes["layers"]["bq"] == ("layers", "heads")
+
+    def test_bias_changes_output(self):
+        """Nonzero biases must flow into the logits (guards against the
+        bias add being silently dropped)."""
+        cfg = preset("tiny-qwen")
+        params = init_params(cfg, jax.random.key(0), jnp.float32)
+        tokens = jnp.asarray([[7, 3, 9]], jnp.int32)
+        base, _ = forward(params, cfg, tokens,
+                          init_cache(cfg, 1, 8, jnp.float32))
+        params["layers"]["bq"] = params["layers"]["bq"] + 0.5
+        moved, _ = forward(params, cfg, tokens,
+                           init_cache(cfg, 1, 8, jnp.float32))
+        assert np.abs(np.asarray(base) - np.asarray(moved)).max() > 1e-4
+
+    def test_engine_greedy_matches_reference(self):
+        cfg = preset("tiny-qwen")
+        params = init_params(cfg, jax.random.key(1), jnp.float32)
+        # give biases real values so the path is actually exercised
+        for b in ("bq", "bk", "bv"):
+            params["layers"][b] = jax.random.normal(
+                jax.random.key(hash(b) % 1000),
+                params["layers"][b].shape) * 0.1
+
+        cache = init_cache(cfg, 1, 64, jnp.float32)
+        prompt = list(b"qwen bias test")
+        logits, cache = forward(params, cfg,
+                                jnp.asarray([prompt], jnp.int32), cache)
+        want = [int(jnp.argmax(logits[0, -1]))]
+        last = jnp.asarray([want[-1]], jnp.int32)
+        for _ in range(5):
+            logits, cache = forward(params, cfg, last[:, None], cache)
+            want.append(int(jnp.argmax(logits[0, 0])))
+            last = jnp.asarray([want[-1]], jnp.int32)
+
+        eng = InferenceEngine(cfg, params, ByteTokenizer(), max_slots=2,
+                              max_seq_len=64, prefill_buckets=(16,),
+                              cache_dtype=jnp.float32)
+        got = [eng.prefill_and_insert(0, prompt, SamplingParams())]
+        for _ in range(5):
+            got.append(int(eng.decode_step()[0]))
+        assert got == want
+
+    def test_config_from_hf_qwen(self):
+        cfg = config_from_hf({
+            "architectures": ["Qwen2ForCausalLM"],
+            "vocab_size": 152064, "hidden_size": 3584,
+            "num_hidden_layers": 28, "num_attention_heads": 28,
+            "num_key_value_heads": 4, "intermediate_size": 18944,
+            "rope_theta": 1000000.0, "rms_norm_eps": 1e-6,
+        })
+        assert cfg.attention_bias
+        # llama config stays bias-free
+        cfg2 = config_from_hf({
+            "architectures": ["LlamaForCausalLM"],
+            "vocab_size": 128256, "hidden_size": 4096,
+            "num_hidden_layers": 32, "num_attention_heads": 32,
+            "intermediate_size": 14336,
+        })
+        assert not cfg2.attention_bias
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        pytest.importorskip("safetensors")
+        from symmetry_tpu.engine.weights import load_checkpoint, save_checkpoint
+
+        cfg = preset("tiny-qwen")
+        params = init_params(cfg, jax.random.key(2), jnp.float32)
+        for b in ("bq", "bk", "bv"):
+            params["layers"][b] = jax.random.normal(
+                jax.random.key(1), params["layers"][b].shape) * 0.1
+        path = str(tmp_path / "qwen-ckpt")
+        save_checkpoint(path, params, cfg)
+        loaded, loaded_cfg = load_checkpoint(path, dtype=jnp.float32)
+        assert loaded_cfg.attention_bias
+        tokens = jnp.asarray([[5, 1, 8, 2]], jnp.int32)
+        want, _ = forward(params, cfg, tokens,
+                          init_cache(cfg, 1, 8, jnp.float32))
+        got, _ = forward(loaded, loaded_cfg, tokens,
+                         init_cache(cfg, 1, 8, jnp.float32))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_vestigial_sliding_window_ignored(self):
+        """Real qwen2 configs ship sliding_window alongside
+        use_sliding_window: false — honoring it would silently disable
+        every fast attention path."""
+        cfg = config_from_hf({
+            "architectures": ["Qwen2ForCausalLM"],
+            "vocab_size": 152064, "hidden_size": 3584,
+            "num_hidden_layers": 28, "num_attention_heads": 28,
+            "num_key_value_heads": 4, "intermediate_size": 18944,
+            "sliding_window": 131072, "use_sliding_window": False,
+        })
+        assert cfg.sliding_window is None
+        # an actually-enabled window is preserved (mistral v0.1 shape)
+        cfg2 = config_from_hf({
+            "architectures": ["MistralForCausalLM"],
+            "vocab_size": 32000, "hidden_size": 4096,
+            "num_hidden_layers": 32, "num_attention_heads": 32,
+            "num_key_value_heads": 8, "intermediate_size": 14336,
+            "sliding_window": 4096,
+        })
+        assert cfg2.sliding_window == 4096
+
+    def test_moe_config_keeps_attention_bias(self):
+        cfg = config_from_hf({
+            "architectures": ["MixtralForCausalLM"],
+            "vocab_size": 32000, "hidden_size": 4096,
+            "num_hidden_layers": 32, "num_attention_heads": 32,
+            "num_key_value_heads": 8, "intermediate_size": 14336,
+            "num_local_experts": 8, "attention_bias": True,
+        })
+        assert cfg.attention_bias and cfg.num_experts == 8
