@@ -353,10 +353,12 @@ def _layer(
         # Prefill-from-empty: attention is over this call's own K/V — the
         # Pallas kernel streams K/V blocks through VMEM instead of
         # materializing [H, S, S] scores (ops/flash.py); the cache slice is
-        # never read back.
+        # never read back. Sliding-window models restrict the kernel's
+        # block range to the window.
         from symmetry_tpu.ops.flash import flash_prefill
 
         attn = flash_prefill(q, k, v, seq_lens,
+                             window=config.sliding_window,
                              interpret=jax.default_backend() != "tpu")
     else:
         from symmetry_tpu.ops import decode_attention as da
@@ -420,8 +422,11 @@ def forward_hidden(
     by the shard count. sp_mode picks the scheme: "ring" rotates K/V
     blocks (parallel/ring.py, any head count), "ulysses" head-scatters via
     one all-to-all (parallel/ulysses.py, needs kv_heads % shards == 0).
-    Sliding-window models (mistral-v0.1) fall back to the masked path in
-    all cases.
+    Sliding-window models (mistral-v0.1) use the window-bounded flash
+    kernel for prefill. The ring/ulysses schemes do not support windows:
+    with ring_mesh set, a sliding-window model runs the (non-sequence-
+    parallel) flash kernel instead — callers needing SP for windowed
+    models must shard some other way.
     """
     B, S = tokens.shape
     if seq_lens is None:
@@ -436,8 +441,9 @@ def forward_hidden(
                          "(prefill-from-empty contract)")
     use_ring = ring_mesh if (ring_mesh is not None and S > 1
                              and config.sliding_window is None) else None
-    use_flash = (prefill_flash and use_ring is None and S > 1
-                 and config.sliding_window is None)
+    # Flash prefill handles sliding windows natively (window-bounded block
+    # range); only the ring path still requires global attention.
+    use_flash = prefill_flash and use_ring is None and S > 1
 
     n_stacked = jax.tree.leaves(params["layers"])[0].shape[0]
     if n_stacked != config.num_layers:

@@ -31,7 +31,7 @@ NEG_INF = -2.0**30
 
 
 def _flash_kernel(seqlen_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float,
-                  block_q: int, block_k: int):
+                  block_q: int, block_k: int, window: int | None):
     qi = pl.program_id(2)
     seq_len = seqlen_ref[pl.program_id(0)]  # this batch row's true length
 
@@ -57,6 +57,9 @@ def _flash_kernel(seqlen_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float,
         kv_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = (kv_pos <= q_pos) & (kv_pos < seq_len)
+        if window is not None:
+            # mistral-style local attention: key within `window` of query
+            mask &= kv_pos > q_pos - window
         s = jnp.where(mask, s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -70,8 +73,13 @@ def _flash_kernel(seqlen_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float,
         )
         return m_new, l_new, acc_new
 
-    # Causal block skip: query block qi only sees kv blocks 0..qi.
-    m, l, acc = jax.lax.fori_loop(0, qi + 1, body, (m0, l0, acc0))
+    # Causal block skip: query block qi only sees kv blocks 0..qi; with a
+    # sliding window, also skip blocks wholly OLDER than the window (the
+    # oldest key any query in this block can see is qi*block_q - window+1).
+    lo = 0
+    if window is not None:
+        lo = jnp.maximum(0, (qi * block_q - window + 1) // block_k)
+    m, l, acc = jax.lax.fori_loop(lo, qi + 1, body, (m0, l0, acc0))
     # Padded rows (q_pos >= seq_len) are fully masked: l == 0. Guard the
     # division; their output is garbage by contract, but must not be NaN.
     l = jnp.maximum(l, 1e-30)
@@ -79,7 +87,7 @@ def _flash_kernel(seqlen_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+    jax.jit, static_argnames=("block_q", "block_k", "window", "interpret"))
 def flash_prefill(
     q: jnp.ndarray,         # [B, S, H, D]
     k: jnp.ndarray,         # [B, S, K, D]
@@ -88,13 +96,16 @@ def flash_prefill(
     *,
     block_q: int = 128,
     block_k: int = 128,
+    window: int | None = None,  # mistral-style sliding-window span
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Causal self-attention over a fresh (cache-empty) padded prompt.
 
     Returns [B, S, H, D] in q's dtype. Requires S % block == 0 (buckets are
     chosen that way); positions are 0..S-1 (prefill-from-empty contract of
-    engine prefill, engine.py).
+    engine prefill, engine.py). `window` restricts attention to the last
+    `window` keys (sliding-window models); blocks wholly outside the
+    window are skipped, making long-prompt prefill O(S·window).
     """
     B, S, H, D = q.shape
     K = k.shape[2]
@@ -113,7 +124,8 @@ def flash_prefill(
 
     grid = (B, H, S // block_q)
     kernel = functools.partial(_flash_kernel, scale=scale,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               window=window)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(

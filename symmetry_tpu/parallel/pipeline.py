@@ -182,7 +182,9 @@ def pipeline_forward_hidden(
             f"non-trivial axes {other} — use a stage-only (sub)mesh")
     if seq_lens is None:
         seq_lens = jnp.full((B,), S, jnp.int32)
-    use_flash = (prefill_flash and S > 1 and config.sliding_window is None)
+    # Same predicate as forward_hidden: the flash kernel handles sliding
+    # windows natively (window-bounded block range).
+    use_flash = prefill_flash and S > 1
 
     layer_spec = P("stage")
     param_specs = {
