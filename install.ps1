@@ -1,0 +1,62 @@
+# Installer parity with the reference's install.ps1 (installs the CLI and
+# scaffolds a default provider config; reference install.ps1:1-58). Windows
+# counterpart of install.sh: pip-installs this checkout and writes a
+# tpu_native default provider.yaml under the user's config directory.
+
+$ErrorActionPreference = "Stop"
+
+$ConfigDir = if ($env:SYMMETRY_CONFIG_DIR) { $env:SYMMETRY_CONFIG_DIR }
+             else { Join-Path $env:USERPROFILE ".config\symmetry" }
+$ConfigPath = Join-Path $ConfigDir "provider.yaml"
+$RepoDir = Split-Path -Parent $MyInvocation.MyCommand.Path
+
+Write-Host "Installing symmetry-tpu from $RepoDir ..."
+python -m pip install --user $RepoDir
+
+New-Item -ItemType Directory -Force -Path $ConfigDir | Out-Null
+
+if (Test-Path $ConfigPath) {
+    Write-Host "Config already exists at $ConfigPath - leaving it untouched."
+} else {
+    $DefaultName = "$env:USERNAME-tpu"
+    $Name = Read-Host "Provider name [$DefaultName]"
+    if (-not $Name) { $Name = $DefaultName }
+    $Model = Read-Host "Model preset [llama3-8b]"
+    if (-not $Model) { $Model = "llama3-8b" }
+    $ServerKey = Read-Host "Server key (hex, empty for private provider)"
+
+    $Public = "true"
+    if (-not $ServerKey) {
+        $Public = "false"
+        $ServerKey = "0" * 64
+    }
+
+    @"
+# symmetry-tpu provider config (see README.md; field parity with the
+# reference provider.yaml plus the tpu: engine section)
+name: $Name
+public: $Public
+serverKey: "$ServerKey"
+modelName: "$Model"
+apiProvider: tpu_native
+dataCollectionEnabled: false
+maxConnections: 16
+path: $($ConfigDir -replace '\\', '/')
+tpu:
+  model_preset: $Model
+  dtype: bfloat16
+  quantization: int8
+  kv_quantization: int8
+  max_batch_size: 16
+  max_seq_len: 2048
+  prefill_buckets: [128, 512, 2048]
+  decode_block: 8
+  # checkpoint_path: /path/to/hf/safetensors/dir
+  # tokenizer_path: /path/to/tokenizer.json
+"@ | Set-Content -Path $ConfigPath -Encoding UTF8
+    Write-Host "Wrote default config to $ConfigPath"
+}
+
+Write-Host ""
+Write-Host "Run the provider with:  symmetry-tpu-provider -c $ConfigPath"
+Write-Host "Run a server with:      symmetry-tpu-server"

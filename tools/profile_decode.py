@@ -1,0 +1,132 @@
+"""Piecewise timing of the decode step on the real chip.
+
+Times each stage of the serving decode step in isolation (trunk, attention,
+LM head, sampling, cache scatter) to locate the gap between the measured
+step time and the HBM-bandwidth floor. Not part of the test suite; run
+manually: `python tools/profile_decode.py [--preset llama3-8b ...]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, n=20, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n * 1e3  # ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="llama3-8b")
+    ap.add_argument("--slots", type=int, default=128)
+    ap.add_argument("--max-seq", type=int, default=640)
+    args = ap.parse_args()
+
+    from symmetry_tpu.models.llama import (
+        forward_hidden, init_cache, init_params, logits_from_hidden, preset)
+    from symmetry_tpu.ops.attention import gqa_attention
+    from symmetry_tpu.ops.sampling import sample_tokens
+
+    cfg = preset(args.preset)
+    B, T = args.slots, args.max_seq
+    params = init_params(cfg, jax.random.key(0), jnp.bfloat16, quantize=True)
+    cache = init_cache(cfg, B, T, jnp.bfloat16, quantized=True)
+    cache = cache._replace(lengths=jnp.full((B,), T - 8, jnp.int32))
+    tok = jnp.ones((B, 1), jnp.int32)
+
+    # Full trunk (all layers incl. attention + cache writes)
+    trunk = jax.jit(lambda p, t, c: forward_hidden(p, cfg, t, c),
+                donate_argnums=(2,))
+    def trunk_once(p, t, c):
+        out = trunk(p, t, c)
+        return out  # new cache replaces donated one
+    for _ in range(3):
+        _, cache = trunk(params, tok, cache)
+    import time as _t
+    t0 = _t.perf_counter()
+    for _ in range(20):
+        h, cache = trunk(params, tok, cache)
+    jax.block_until_ready(h)
+    ms_trunk = (_t.perf_counter() - t0) / 20 * 1e3
+
+    # LM head
+    h = jnp.ones((B, 1, cfg.hidden_size), jnp.bfloat16)
+    head = jax.jit(lambda p, h: logits_from_hidden(p, cfg, h))
+    ms_head = timeit(head, params, h)
+
+    # Sampling
+    logits = jnp.ones((B, cfg.vocab_size), jnp.float32)
+    keys = jax.random.split(jax.random.key(0), B)
+    temp = jnp.full((B,), 0.7, jnp.float32)
+    top_p = jnp.ones((B,), jnp.float32)
+    top_k = jnp.zeros((B,), jnp.int32)
+    samp = jax.jit(sample_tokens)
+    ms_samp = timeit(samp, logits, keys, temp, top_p, top_k)
+
+    # Attention alone, one layer, einsum path (what the trunk uses at T<4096)
+    D, nq, nkv = cfg.dim_per_head, cfg.num_heads, cfg.num_kv_heads
+    q = jnp.ones((B, 1, nq, D), jnp.bfloat16)
+    k1 = cache.k[0]
+    v1 = cache.v[0]
+    ks = cache.k_scale[0]
+    pos = jnp.full((B, 1), T - 8, jnp.int32)
+    kl = jnp.full((B,), T - 7, jnp.int32)
+    attn = jax.jit(lambda q, k, v, ks, vs: gqa_attention(
+        q, k, v, pos, kl, k_scale=ks, v_scale=vs))
+    ms_attn1 = timeit(attn, q, k1, v1, ks, ks)
+    del k1, v1, ks
+
+    # Cache scatter write, one layer-equivalent (full-cache .at[].set)
+    kq = jnp.ones((B, 1, nkv, D), jnp.int8)
+    lidx = jnp.zeros((B, 1), jnp.int32)
+    bidx = jnp.arange(B, dtype=jnp.int32)[:, None]
+
+    def scatter(c, kq):
+        return c.k.at[lidx, bidx, pos].set(kq)
+
+    ms_scat1 = timeit(jax.jit(scatter), cache, kq)
+
+    # Pallas ragged decode kernel at this capacity (if divisible)
+    ms_pallas1 = float("nan")
+    from symmetry_tpu.ops import decode_attention as da
+    for bt in (512, 256, 128):
+        if T % bt == 0 and bt <= T:
+            q3 = jnp.ones((B, nq, D), jnp.bfloat16)
+            pal = jax.jit(lambda q3, k, v, ks, vs: da.decode_attention(
+                q3, cache.k, cache.v, jnp.int32(0), kl,
+                k_scale=ks, v_scale=vs, block_t=bt))
+            ms_pallas1 = timeit(pal, q3, cache.k, cache.v,
+                                cache.k_scale, cache.v_scale)
+            break
+
+    L = cfg.num_layers
+    print(f"trunk (all {L} layers):   {ms_trunk:8.2f} ms")
+    print(f"lm head:                  {ms_head:8.2f} ms")
+    print(f"sampling:                 {ms_samp:8.2f} ms")
+    print(f"attention x1 (einsum):    {ms_attn1:8.2f} ms  (x{L} = {ms_attn1*L:.1f})")
+    print(f"attention x1 (pallas):    {ms_pallas1:8.2f} ms  (x{L} = {ms_pallas1*L:.1f})")
+    print(f"cache scatter x1 (k):     {ms_scat1:8.2f} ms  (x{2*L} = {ms_scat1*2*L:.1f})")
+    print(f"sum trunk+head+sample:    {ms_trunk + ms_head + ms_samp:8.2f} ms")
+
+    # bandwidth sanity: weight bytes + kv bytes
+    wb = sum(np.prod(x.shape) * x.dtype.itemsize
+             for x in jax.tree.leaves(params))
+    kvb = (2 * L * B * T * nkv * D * 1
+           + 2 * L * B * nkv * T * 4)
+    print(f"weight bytes: {wb/1e9:.2f} GB  kv bytes: {kvb/1e9:.2f} GB")
+
+
+if __name__ == "__main__":
+    main()
