@@ -72,13 +72,22 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
              for slot in range(start, min(start + group, slots))])
     prefill_s = time.perf_counter() - t_prefill0
 
+    import numpy as np
+
     # One warm dispatch, then measure. `steps` counts decode steps; each
-    # dispatch advances `block` of them.
+    # dispatch advances `block` of them. Double-buffered like the serving
+    # scheduler: block N+1 is dispatched before syncing block N's tokens,
+    # so the host round-trip rides behind device compute.
     engine.decode_steps()
     n_disp = max(1, steps // block)
     t0 = time.perf_counter()
+    pending = None
     for _ in range(n_disp):
-        engine.decode_steps()  # np.asarray inside = host sync per block
+        nxt = engine.decode_steps_dispatch()
+        if pending is not None:
+            np.asarray(pending)
+        pending = nxt
+    np.asarray(pending)
     dt = time.perf_counter() - t0
 
     done_steps = n_disp * block
@@ -100,13 +109,147 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
     }
 
 
+def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
+            prompt_chars: int, max_seq: int, dtype_name: str, block: int,
+            quant: str | None, kv_quant: bool, bucket: int) -> dict:
+    """The NORTH-STAR measurement (BASELINE.json metric): aggregate WIRE
+    tok/s and p50/p99 TTFT through the full serving path — server +
+    tpu_native provider + N concurrent streaming clients over TCP
+    loopback. This is the serving-path analog of the reference's hot loop
+    (reference: src/provider.ts:240-258), where the engine-only bench
+    (run_bench) measures just the decode kernel underneath it."""
+    import asyncio
+    import statistics
+    import time as _time
+
+    from symmetry_tpu.client.client import SymmetryClient
+    from symmetry_tpu.identity import Identity
+    from symmetry_tpu.provider.config import ConfigManager
+    from symmetry_tpu.provider.provider import SymmetryProvider
+    from symmetry_tpu.server.broker import SymmetryServer
+    from symmetry_tpu.transport.tcp import TcpTransport
+
+    model_name = f"{preset_name}:bench"
+    cfg = ConfigManager(config={
+        "name": "bench-prov",
+        "public": True,
+        "serverKey": Identity.from_name("bench-server").public_hex,
+        "modelName": model_name,
+        "apiProvider": "tpu_native",
+        "dataCollectionEnabled": False,
+        "maxConnections": clients + 8,
+        "tpu": {
+            "model_preset": preset_name,
+            "dtype": dtype_name,
+            "quantization": quant,
+            "kv_quantization": "int8" if kv_quant else None,
+            "max_batch_size": slots,
+            "max_seq_len": max_seq,
+            "prefill_buckets": [bucket],
+            "decode_block": block,
+        },
+    })
+
+    async def main() -> dict:
+        server_ident = Identity.from_name("bench-server")
+        server = SymmetryServer(server_ident, TcpTransport(),
+                                ping_interval_s=60.0)
+        await server.start("tcp://127.0.0.1:0")
+        provider = SymmetryProvider(
+            cfg, transport=TcpTransport(),
+            identity=Identity.from_name("bench-prov"),
+            server_address=server.address)
+        # start() builds + warms the engine (minutes for 8B: weight init,
+        # XLA compiles); none of that counts toward the measured window.
+        await provider.start("tcp://127.0.0.1:0")
+        await provider.wait_registered(timeout=1800)
+
+        prompt = "x" * prompt_chars
+
+        async def one_client(i: int) -> dict:
+            client = SymmetryClient(Identity.from_name(f"bench-cli-{i}"),
+                                    TcpTransport())
+            details = await client.request_provider(
+                server.address, server_ident.public_key, model_name)
+            session = await client.connect(details)
+            t_send = _time.perf_counter()
+            t_first = None
+            chars = 0
+            try:
+                async for delta in session.chat(
+                        [{"role": "user", "content": prompt}],
+                        max_tokens=max_new, temperature=0.7, seed=i):
+                    if t_first is None and delta:
+                        t_first = _time.perf_counter()
+                    chars += len(delta)
+            finally:
+                await session.close()
+            t_done = _time.perf_counter()
+            return {"ttft": (t_first or t_done) - t_send,
+                    "e2e": t_done - t_send, "chars": chars}
+
+        t0 = _time.perf_counter()
+        results = await asyncio.gather(
+            *(one_client(i) for i in range(clients)))
+        elapsed = _time.perf_counter() - t0
+
+        # True sampled-token count from the scheduler (ByteTokenizer chars
+        # under-count: multi-byte UTF-8 assemblies collapse several byte
+        # tokens into one char on the wire).
+        sched = provider.backend._scheduler
+        tokens = sched.metrics["tokens"]
+        peak = sched.metrics["peak_occupancy"]
+
+        await provider.stop(drain_timeout_s=5)
+        await server.stop()
+
+        ttfts = sorted(r["ttft"] for r in results)
+        e2es = sorted(r["e2e"] for r in results)
+
+        def pct(xs, p):
+            return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+        tok_s = tokens / elapsed
+        dtype_label = f"{dtype_name}+{quant}" if quant else dtype_name
+        if kv_quant:
+            dtype_label += "+kv8"
+        import jax
+
+        return {
+            "metric": f"e2e serving tok/s ({preset_name} {dtype_label}, "
+                      f"{clients} streaming clients over TCP, {slots} slots, "
+                      f"block {block}, "
+                      f"{jax.device_count()} {jax.default_backend()} dev)",
+            "value": round(tok_s, 1),
+            "unit": "tok/s",
+            "vs_baseline": round(tok_s / 2000.0, 3),
+            "ttft_p50_s": round(pct(ttfts, 0.50), 3),
+            "ttft_p99_s": round(pct(ttfts, 0.99), 3),
+            "e2e_p50_s": round(pct(e2es, 0.50), 3),
+            "e2e_p99_s": round(pct(e2es, 0.99), 3),
+            "tokens_streamed": tokens,
+            "wall_s": round(elapsed, 2),
+            "peak_occupancy": peak,
+            "mean_ttft_s": round(statistics.mean(ttfts), 3),
+        }
+
+    return asyncio.new_event_loop().run_until_complete(main())
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
                     help="CPU-safe tiny-model run (verification, not perf)")
+    ap.add_argument("--e2e", action="store_true",
+                    help="full serving path: server + provider + N "
+                         "streaming clients over TCP (north-star metric)")
     ap.add_argument("--preset", default="llama3-8b")
     ap.add_argument("--slots", type=int, default=128)
     ap.add_argument("--steps", type=int, default=192)
+    ap.add_argument("--clients", type=int, default=128,
+                    help="concurrent streaming clients (--e2e)")
+    ap.add_argument("--max-new", type=int, default=256,
+                    help="tokens per client request (--e2e)")
     ap.add_argument("--prompt-len", type=int, default=128)
     ap.add_argument("--max-seq", type=int, default=640)
     ap.add_argument("--dtype", default="bfloat16",
@@ -130,6 +273,15 @@ def main() -> None:
         result = run_bench("tiny", slots=2, steps=8, prompt_len=16,
                            max_seq=64, dtype_name="float32", mesh_model=1,
                            block=2)
+    elif args.e2e:
+        result = run_e2e(
+            args.preset, clients=args.clients, slots=args.slots,
+            # ~24 tokens of headroom for the chat template + BOS so the
+            # rendered prompt still fits the --prompt-len bucket
+            max_new=args.max_new, prompt_chars=max(1, args.prompt_len - 24),
+            max_seq=args.max_seq, dtype_name=args.dtype, block=args.block,
+            quant=None if args.quant == "none" else args.quant,
+            kv_quant=args.kv_quant == "int8", bucket=args.prompt_len)
     else:
         result = run_bench(args.preset, slots=args.slots, steps=args.steps,
                            prompt_len=args.prompt_len, max_seq=args.max_seq,

@@ -18,12 +18,25 @@ New-Item -ItemType Directory -Force -Path $ConfigDir | Out-Null
 if (Test-Path $ConfigPath) {
     Write-Host "Config already exists at $ConfigPath - leaving it untouched."
 } else {
+    # Prompt only when interactive (mirrors install.sh's `[ -t 0 ]` branch);
+    # CI/non-interactive installs take the defaults instead of hanging on
+    # Read-Host. SYMMETRY_NONINTERACTIVE=1 forces the non-prompting path.
     $DefaultName = "$env:USERNAME-tpu"
-    $Name = Read-Host "Provider name [$DefaultName]"
-    if (-not $Name) { $Name = $DefaultName }
-    $Model = Read-Host "Model preset [llama3-8b]"
-    if (-not $Model) { $Model = "llama3-8b" }
-    $ServerKey = Read-Host "Server key (hex, empty for private provider)"
+    $Name = $DefaultName
+    $Model = "llama3-8b"
+    $ServerKey = ""
+    # IsInputRedirected is the stdin-state check ([Environment]::UserInteractive
+    # only detects services, and is $true in CI shells and -NonInteractive).
+    $Interactive = [Environment]::UserInteractive -and
+                   -not [Console]::IsInputRedirected -and
+                   -not $env:SYMMETRY_NONINTERACTIVE
+    if ($Interactive) {
+        $Name = Read-Host "Provider name [$DefaultName]"
+        if (-not $Name) { $Name = $DefaultName }
+        $Model = Read-Host "Model preset [llama3-8b]"
+        if (-not $Model) { $Model = "llama3-8b" }
+        $ServerKey = Read-Host "Server key (hex, empty for private provider)"
+    }
 
     $Public = "true"
     if (-not $ServerKey) {

@@ -93,6 +93,30 @@ class SamplingParams:
         )
 
 
+@dataclass
+class ChunkedPrefill:
+    """An in-progress chunked prefill: one prompt's KV prefix being built
+    chunk-by-chunk so long-prompt admission never stalls active decode
+    streams for more than ~one chunk (round-2 verdict: a 2048-bucket
+    prefill froze every stream for ~0.6 s)."""
+
+    slot: int
+    ids: np.ndarray           # [1, n_chunks * C] padded prompt
+    true_len: int
+    n_chunks: int
+    cache: Any                # batch-1 prefix KVCache (bucket capacity)
+    temp: jnp.ndarray         # [1]
+    top_p: jnp.ndarray        # [1]
+    top_k: jnp.ndarray        # [1]
+    prefill_key: jax.Array    # [1] PRNG for the first-token sample
+    decode_key: jax.Array     # [1] PRNG stream carried into decode
+    done_chunks: int = 0
+
+    @property
+    def remaining_chunks(self) -> int:
+        return self.n_chunks - self.done_chunks
+
+
 class InferenceEngine:
     """Owns params + decode state; exposes prefill/insert/decode primitives.
 
@@ -114,6 +138,7 @@ class InferenceEngine:
         decode_block: int = 1,
         kv_quant: bool = False,
         pipeline_microbatches: int = 1,
+        prefill_chunk: int | None = 256,
     ) -> None:
         self.config = config
         self.params = params
@@ -147,6 +172,9 @@ class InferenceEngine:
         # after their first token (scheduler admission check), so buckets up
         # to max_seq_len are allowed — they just can't decode far.
         self.decode_block = decode_block
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise EngineError("prefill_chunk must be >= 1 (or None)")
+        self.prefill_chunk = prefill_chunk
 
         c = config
 
@@ -281,6 +309,25 @@ class InferenceEngine:
                 rng=state.rng.at[slot].set(rng[row]),
             )
 
+        def chunk_step(params, tokens, cache, seq_len):
+            """Extend a batch-1 prefix cache by one prompt chunk. Attention
+            runs the continuation path (absolute-position masking against
+            the cache written by earlier chunks) — prefill_flash's
+            empty-cache contract doesn't hold past chunk 0."""
+            _, cache = trunk(params, tokens, cache, seq_lens=seq_len)
+            return cache
+
+        def chunk_final(params, tokens, cache, seq_len, last_idx,
+                        temp, top_p, top_k, rng):
+            """Last chunk: also project the final valid position and sample
+            the first token (mirrors `prefill`'s tail)."""
+            h, cache = trunk(params, tokens, cache, seq_lens=seq_len)
+            h_last = jnp.take_along_axis(
+                h, last_idx[:, None, None].astype(jnp.int32), axis=1)
+            last = logits_from_hidden(params, cfg, h_last)[:, 0]
+            toks = sample_tokens(last, rng, temp, top_p, top_k)
+            return toks, cache
+
         def decode_one(state: DecodeState, params):
             """Advance every slot one token."""
             h, cache = trunk(params, state.last_token[:, None], state.cache)
@@ -328,13 +375,20 @@ class InferenceEngine:
                 lengths=rep,
                 k_scale=psc, v_scale=psc,
             )
+            self._prefix_shard = prefix_shard
             self._prefill = jax.jit(prefill,
                                     out_shardings=(rep, prefix_shard))
             self._decode = jax.jit(decode_block, donate_argnums=(1,),
                                    out_shardings=(state_shard, rep))
+            self._chunk_step = jax.jit(chunk_step, donate_argnums=(2,),
+                                       out_shardings=prefix_shard)
+            self._chunk_final = jax.jit(chunk_final, donate_argnums=(2,),
+                                        out_shardings=(rep, prefix_shard))
         else:
             self._prefill = jax.jit(prefill)
             self._decode = jax.jit(decode_block, donate_argnums=(1,))
+            self._chunk_step = jax.jit(chunk_step, donate_argnums=(2,))
+            self._chunk_final = jax.jit(chunk_final, donate_argnums=(2,))
         self._insert = jax.jit(
             insert, donate_argnums=(0,),
             out_shardings=state_shard)
@@ -422,6 +476,84 @@ class InferenceEngine:
         host_toks = np.asarray(toks)
         return [int(host_toks[i]) for i in range(n_req)]
 
+    # ------------------------------------------------------------------
+    # Chunked prefill (long prompts, interleaved with decode blocks)
+
+    def wants_chunked(self, prompt_len: int) -> bool:
+        """True when this prompt should prefill chunk-by-chunk: more than
+        one chunk long (a single-chunk prompt IS one dispatch already)."""
+        return (self.prefill_chunk is not None
+                and prompt_len > self.prefill_chunk)
+
+    def start_chunked_prefill(self, slot: int, prompt_ids: list[int],
+                              sampling: SamplingParams) -> ChunkedPrefill:
+        """Begin a chunked prefill for `slot`; drive it to completion with
+        advance_chunked_prefill (one device dispatch per call)."""
+        if not prompt_ids:
+            raise EngineError("empty prompt")
+        C = self.prefill_chunk
+        assert C is not None
+        true_len = len(prompt_ids)
+        bucket = self.bucket_for(true_len)  # validates length; cache size
+        n_chunks = -(-true_len // C)
+        padded = np.zeros((1, n_chunks * C), np.int32)
+        padded[0, :true_len] = prompt_ids
+
+        if sampling.seed is not None:
+            key = jax.random.key(sampling.seed)
+        else:
+            self._requests_served += 1
+            key = jax.random.fold_in(self._base_key, self._requests_served)
+        pk, dk = jax.random.split(key)
+
+        cache = self._new_prefix_cache(bucket)
+        return ChunkedPrefill(
+            slot=slot, ids=padded, true_len=true_len, n_chunks=n_chunks,
+            cache=cache,
+            temp=jnp.asarray([sampling.temperature], jnp.float32),
+            top_p=jnp.asarray([sampling.top_p], jnp.float32),
+            top_k=jnp.asarray([sampling.top_k], jnp.int32),
+            prefill_key=pk[None], decode_key=dk[None],
+        )
+
+    def advance_chunked_prefill(self, job: ChunkedPrefill) -> int | None:
+        """Run ONE chunk; returns the first sampled token when the prompt
+        is complete (the slot is then live), else None."""
+        C = self.prefill_chunk
+        c0 = job.done_chunks * C
+        chunk = jnp.asarray(job.ids[:, c0:c0 + C])
+        valid = jnp.asarray([min(C, job.true_len - c0)], jnp.int32)
+        last = job.done_chunks == job.n_chunks - 1
+        if not last:
+            job.cache = self._chunk_step(self.params, chunk, job.cache,
+                                         valid)
+            job.done_chunks += 1
+            return None
+        last_idx = jnp.asarray([job.true_len - 1 - c0], jnp.int32)
+        toks, cache = self._chunk_final(
+            self.params, chunk, job.cache, valid, last_idx,
+            job.temp, job.top_p, job.top_k, job.prefill_key)
+        job.done_chunks += 1
+        job.cache = None  # old buffer was donated to chunk_final; poison reuse
+        self.state = self._insert(
+            self.state, cache, jnp.int32(0), jnp.int32(job.slot),
+            jnp.asarray([job.true_len], jnp.int32), toks,
+            job.temp, job.top_p, job.top_k, job.decode_key)
+        return int(np.asarray(toks)[0])
+
+    def _new_prefix_cache(self, capacity: int):
+        """Fresh batch-1 prefix cache, created sharded-in-place (jit with
+        out_shardings) so multi-process meshes work like _init_state."""
+        c = self.config
+
+        def make():
+            return init_cache(c, 1, capacity, self.cache_dtype,
+                              quantized=self.kv_quant)
+
+        if self.mesh is not None:
+            return jax.jit(make, out_shardings=self._prefix_shard)()
+        return jax.jit(make)()
+
     def release_slot(self, slot: int) -> None:
         """A finished slot's cache lane is garbage until reuse (insert
         resets it); nothing to do device-side — the hook exists so the
@@ -455,11 +587,39 @@ class InferenceEngine:
                     jnp.ones((batch,), jnp.float32),
                     jnp.zeros((batch,), jnp.int32),
                     jax.random.split(jax.random.key(0), batch))
+        # Chunked-prefill programs: one (step, final) pair per bucket that
+        # can hold a multi-chunk prompt. A mid-traffic compile would be the
+        # exact stall chunking exists to prevent.
+        C = self.prefill_chunk
+        if C is not None:
+            one = jnp.ones((1,), jnp.int32)
+            for bucket in self.prefill_buckets:
+                if bucket <= C:
+                    continue
+                cache = self._new_prefix_cache(bucket)
+                cache = self._chunk_step(
+                    self.params, jnp.zeros((1, C), jnp.int32), cache, one)
+                toks, cache = self._chunk_final(
+                    self.params, jnp.zeros((1, C), jnp.int32), cache, one,
+                    jnp.zeros((1,), jnp.int32),
+                    jnp.zeros((1,), jnp.float32), jnp.ones((1,), jnp.float32),
+                    jnp.zeros((1,), jnp.int32),
+                    jax.random.split(jax.random.key(0), 1))
+                # batch-1 insert at this bucket already compiled above
+
+    def decode_steps_dispatch(self) -> jax.Array:
+        """Dispatch one decode block WITHOUT syncing: returns the [K, B]
+        device token array as a future. JAX async dispatch lets the caller
+        enqueue block N+1 and only then block on block N's tokens, so the
+        host-side work (transfer, detokenize, emit) overlaps block N+1's
+        device execution (SURVEY §7 hard-part 3: double-buffered token
+        fetch)."""
+        self.state, toks = self._decode(self.params, self.state)
+        return toks
 
     def decode_steps(self) -> np.ndarray:
         """decode_block tokens for every slot; host gets [K, B] int32."""
-        self.state, toks = self._decode(self.params, self.state)
-        return np.asarray(toks)
+        return np.asarray(self.decode_steps_dispatch())
 
     def decode_step(self) -> np.ndarray:
         """One decode step [B] (requires decode_block == 1; tests/bench)."""
@@ -557,4 +717,5 @@ class InferenceEngine:
             decode_block=getattr(tpu_cfg, "decode_block", 1),
             kv_quant=tpu_cfg.kv_quantization == "int8",
             pipeline_microbatches=tpu_cfg.pipeline_microbatches,
+            prefill_chunk=getattr(tpu_cfg, "prefill_chunk", 256),
         )

@@ -74,11 +74,20 @@ class Scheduler:
     """Drives an InferenceEngine from a request queue on its own thread."""
 
     def __init__(self, engine: InferenceEngine, *,
-                 debug_invariants: bool = False) -> None:
+                 debug_invariants: bool = False,
+                 prefill_chunks_per_block: int = 4,
+                 admit_groups_per_block: int = 2) -> None:
         self.engine = engine
         self._inbox: queue.Queue[GenRequest | None] = queue.Queue()
         self._slots: dict[int, _ActiveSlot] = {}
         self._free: list[int] = list(range(engine.max_slots))[::-1]
+        # Long prompts prefill chunk-by-chunk between decode blocks
+        # (engine.ChunkedPrefill); short bursts are capped per block. Both
+        # bound how long active streams stall on admission work — the
+        # round-2 verdict's inter-token-p99 complaint.
+        self._prefill_jobs: list[tuple[Any, GenRequest]] = []
+        self._chunks_per_block = prefill_chunks_per_block
+        self._admit_groups = admit_groups_per_block
         self._debug = debug_invariants
         self._thread: threading.Thread | None = None
         self._stopping = threading.Event()
@@ -139,9 +148,17 @@ class Scheduler:
 
     def _loop_forever(self) -> None:
         eos = self.engine.tokenizer.eos_ids
+        # Double-buffered decode (SURVEY §7 hard-part 3): one block is
+        # always in flight on the device while the host processes the
+        # previous block's tokens. `pending` = (device token array,
+        # slot snapshot at dispatch). The snapshot attributes each lane's
+        # tokens to the request that occupied it AT DISPATCH — a lane
+        # freed-and-reused between dispatch and processing must not leak
+        # the old request's block into the new one.
+        pending: tuple[Any, dict[int, _ActiveSlot]] | None = None
         while True:
             drained = self._admit_new()
-            if not self._slots:
+            if not self._slots and pending is None and not self._prefill_jobs:
                 if self._stopping.is_set() and drained:
                     return
                 # Idle: block until work arrives (no busy spin). Engines
@@ -165,59 +182,88 @@ class Scheduler:
                 self._admit_new(carry=item)
                 continue
 
-            # One dispatch yields a [K, B] block of tokens (K = decode_block);
-            # host-side bookkeeping runs per block, not per step — a device
-            # read every step would sync a ~100ms round-trip each time
-            # (SURVEY §7 hard-part 3).
-            toks = self.engine.decode_steps()
-            self.metrics["steps"] += toks.shape[0]
-            now = time.monotonic()
-            K = toks.shape[0]
-            for slot, active in list(self._slots.items()):
-                cancelled = active.req.cancelled()
-                finish = "cancelled" if cancelled else None
-                text_parts: list[str] = []
-                last_tok = None
-                for k in range(K):
-                    if finish is not None:
-                        break  # discard block remainder past the finish
-                    tok = int(toks[k, slot])
-                    last_tok = tok
-                    active.generated += 1
-                    self.metrics["tokens"] += 1
-                    if tok in eos:
-                        finish = "stop"
-                        break
-                    text_parts.append(active.decoder.push(tok))
-                    if active.generated >= active.req.max_new_tokens:
-                        finish = "length"
-                # The NEXT block grows every active slot's cache by K entries;
-                # a slot that can't absorb them must finish now (cache holds
-                # prompt_len + generated - 1 entries after this block).
-                if finish is None and (active.prompt_len + active.generated
-                                       + K > self.engine.slot_capacity):
-                    finish = "length"
-                text = "".join(text_parts)
-                if finish is None:
-                    if text:
-                        self._emit(active, TokenEvent(
-                            text=text, token_id=last_tok,
-                            tokens_generated=active.generated))
-                else:
-                    self._finish(slot, active, finish, last_tok, text)
+            # Dispatch block N+1 BEFORE syncing block N: np.asarray on
+            # block N then overlaps block N+1's device execution, hiding
+            # the host↔device transfer and all host-side bookkeeping
+            # behind compute.
+            nxt = None
+            if self._slots:
+                nxt = (self.engine.decode_steps_dispatch(),
+                       dict(self._slots))
+                self.metrics["steps"] += self.engine.decode_block
+            # Chunked prefills ride between decode dispatches: a bounded
+            # number of chunk dispatches per block keeps long-prompt
+            # admission from stalling active streams for more than ~a
+            # chunk's device time.
+            self._advance_prefills()
+            if pending is not None:
+                self._process_block(pending[0], pending[1], eos)
+            pending = nxt
             if self._debug:
                 self._check_invariants()
+
+    def _process_block(self, device_toks: Any,
+                       snapshot: dict[int, _ActiveSlot], eos) -> None:
+        """Sync one decode block to host and stream its tokens out."""
+        import numpy as np
+
+        toks = np.asarray(device_toks)  # blocks on THIS block only
+        K = toks.shape[0]
+        for slot, active in snapshot.items():
+            if self._slots.get(slot) is not active:
+                continue  # finished in an earlier block; lane is stale
+            cancelled = active.req.cancelled()
+            finish = "cancelled" if cancelled else None
+            text_parts: list[str] = []
+            last_tok = None
+            for k in range(K):
+                if finish is not None:
+                    break  # discard block remainder past the finish
+                tok = int(toks[k, slot])
+                last_tok = tok
+                active.generated += 1
+                self.metrics["tokens"] += 1
+                if tok in eos:
+                    finish = "stop"
+                    break
+                text_parts.append(active.decoder.push(tok))
+                if active.generated >= active.req.max_new_tokens:
+                    finish = "length"
+            # TWO blocks may touch the cache before this slot is seen
+            # again (one already in flight + the next dispatch); a slot
+            # that can't absorb 2K more entries must finish now (cache
+            # holds prompt_len + generated - 1 entries after this block).
+            if finish is None and (active.prompt_len + active.generated
+                                   + 2 * K > self.engine.slot_capacity + 1):
+                finish = "length"
+            text = "".join(text_parts)
+            if finish is None:
+                if text:
+                    self._emit(active, TokenEvent(
+                        text=text, token_id=last_tok,
+                        tokens_generated=active.generated))
+            else:
+                self._finish(slot, active, finish, last_tok, text)
 
     def _admit_new(self, carry: GenRequest | None = None) -> bool:
         """Place queued requests into free slots. Returns True if inbox
         empty. Concurrent arrivals coalesce into ONE prefill dispatch when
         the engine supports it (prefill_and_insert_many) — per-dispatch
         round-trips would otherwise serialize into the tail TTFT. `carry`
-        is an already-popped request admitted ahead of the queue."""
+        is an already-popped request admitted ahead of the queue.
+
+        While streams are active, at most `admit_groups_per_block` groups
+        are placed per call: an admission burst (each group = one prefill
+        dispatch) would otherwise freeze every active stream for the whole
+        burst. With nothing active there is nobody to stall — drain freely."""
         many = getattr(self.engine, "prefill_and_insert_many", None)
         batch_cap = (max(getattr(self.engine, "PREFILL_BATCHES", (1,)))
                      if many is not None else 1)
+        groups_left = (self._admit_groups
+                       if (self._slots or self._prefill_jobs) else None)
         while self._free:
+            if groups_left is not None and groups_left <= 0:
+                break
             group: list[tuple[int, GenRequest]] = []
             while self._free and len(group) < batch_cap:
                 if carry is not None:
@@ -240,6 +286,8 @@ class Scheduler:
             if not group:
                 return self._inbox.empty()
             self._place_group(group)
+            if groups_left is not None:
+                groups_left -= 1
         if carry is not None:
             # No free slot took it (all busy): back to the queue rather
             # than dropping the request.
@@ -249,12 +297,21 @@ class Scheduler:
     def _place_group(self, group: list[tuple[int, GenRequest]]) -> None:
         # Requests the engine would reject (e.g. prompt beyond the largest
         # bucket) must fail individually, not poison the whole batch.
+        wants_chunked = getattr(self.engine, "wants_chunked", None)
         ready: list[tuple[int, GenRequest]] = []
         for slot, req in group:
             try:
                 if not req.prompt_ids:
                     raise ValueError("empty prompt")
                 self.engine.bucket_for(len(req.prompt_ids))
+                if wants_chunked is not None and wants_chunked(
+                        len(req.prompt_ids)):
+                    # Long prompt: build its prefix chunk-by-chunk between
+                    # decode blocks instead of one monolithic dispatch.
+                    job = self.engine.start_chunked_prefill(
+                        slot, req.prompt_ids, req.sampling)
+                    self._prefill_jobs.append((job, req))
+                    continue
             except Exception as exc:  # noqa: BLE001
                 self._free.append(slot)
                 self._emit_cb(req, TokenEvent(
@@ -284,6 +341,38 @@ class Scheduler:
         for (slot, req), first in zip(ready, firsts):
             self._activate(slot, req, first)
 
+    def _advance_prefills(self) -> None:
+        """Run up to `prefill_chunks_per_block` prompt chunks, FIFO (the
+        earliest request reaches its first token first). With no active
+        streams there is nothing to stall, so drain faster."""
+        if not self._prefill_jobs:
+            return
+        budget = (self._chunks_per_block if self._slots
+                  else max(16, self._chunks_per_block))
+        while budget > 0 and self._prefill_jobs:
+            job, req = self._prefill_jobs[0]
+            if req.cancelled():
+                self._prefill_jobs.pop(0)
+                self._free.append(job.slot)
+                self._emit_cb(req, TokenEvent(
+                    text="", token_id=None, done=True,
+                    finish_reason="cancelled"))
+                continue
+            try:
+                first = self.engine.advance_chunked_prefill(job)
+            except Exception as exc:  # noqa: BLE001 — fail one, not all
+                self._prefill_jobs.pop(0)
+                self._free.append(job.slot)
+                log.error(f"chunked prefill failed for {req.id}: {exc}")
+                self._emit_cb(req, TokenEvent(
+                    text="", token_id=None, done=True, finish_reason="error",
+                    error=str(exc)))
+                continue
+            budget -= 1
+            if first is not None:
+                self._prefill_jobs.pop(0)
+                self._activate(job.slot, req, first)
+
     def _activate(self, slot: int, req: GenRequest, first: int) -> None:
         active = _ActiveSlot(req=req, decoder=self.engine.tokenizer.stream_decoder(),
                              prompt_len=len(req.prompt_ids))
@@ -297,12 +386,15 @@ class Scheduler:
             return
         # Finish before the first decode block if (a) the request's token
         # budget is already spent by the prefill token, or (b) the prompt is
-        # so long the cache can't absorb one more block — otherwise the
-        # block's KV writes land past capacity (silently dropped scatters)
-        # and the client would stream garbage.
+        # so long the cache can't absorb the TWO blocks that may be
+        # dispatched before this slot's tokens are next examined (one
+        # in-flight + one lookahead) — otherwise KV writes land past
+        # capacity (silently dropped scatters) and the client would stream
+        # garbage.
         if (active.generated >= req.max_new_tokens
-                or active.prompt_len + active.generated + self.engine.decode_block
-                > self.engine.slot_capacity):
+                or active.prompt_len + active.generated
+                + 2 * self.engine.decode_block
+                > self.engine.slot_capacity + 1):
             text = active.decoder.push(first)
             self._finish(slot, active, "length", first, text)
             return
@@ -338,9 +430,14 @@ class Scheduler:
     def _check_invariants(self) -> None:
         active = set(self._slots)
         free = set(self._free)
+        prefilling = {job.slot for job, _ in self._prefill_jobs}
         assert not (active & free), f"slot in both active and free: {active & free}"
-        assert active | free == set(range(self.engine.max_slots)), \
-            "slot leak: some slot neither active nor free"
+        assert not (active & prefilling), \
+            f"slot both active and prefilling: {active & prefilling}"
+        assert not (free & prefilling), \
+            f"slot both free and prefilling: {free & prefilling}"
+        assert active | free | prefilling == set(range(self.engine.max_slots)), \
+            "slot leak: some slot neither active, free, nor prefilling"
         for slot in active:
             assert self.engine.slot_length(slot) <= self.engine.slot_capacity
 

@@ -19,10 +19,14 @@ Design choices vs the reference stack, deliberately simplified:
     tiny and this is the control plane, not the token stream.
   - Values (topic → peers) expire after TTL; announcers re-announce on an
     interval (REANNOUNCE_S), exactly hyperswarm's liveness model.
-  - No NAT holepunching: nodes are assumed reachable (DC/DCN deployment).
-    The capability the reference gets from UDX holepunching is out of
-    scope for datacenter serving; the P2P encrypted stream layer
-    (transport/, identity/noise.py) carries the data plane either way.
+  - Announce/unannounce records that carry a publicKey are SIGNED with the
+    announcer's Ed25519 key and verified on store: a third party can
+    neither plant a record under someone else's key nor evict a live
+    provider with a forged unannounce (hyperdht's mutable-record
+    signing, here over the same identity key the data plane pins).
+  - NAT holepunching lives one level up (network/natpunch.py,
+    rendezvous-assisted simultaneous-open through the server); the DHT
+    itself assumes reachable nodes (DC/DCN deployment).
 
 Iterative lookup: standard Kademlia — query the ALPHA closest known nodes,
 merge returned nodes, repeat until the closest set stabilizes, collect
@@ -46,10 +50,25 @@ ID_BITS = 256
 VALUE_TTL_S = 10 * 60  # announced peers expire unless re-announced
 REANNOUNCE_S = 4 * 60
 RPC_TIMEOUT_S = 2.0
+MAX_SIG_SKEW_S = VALUE_TTL_S  # wall-clock tolerance on signed records
 
 
 def _xor_distance(a: bytes, b: bytes) -> int:
     return int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
+
+
+def _announce_sig_msg(topic_hex: str, payload: dict, ts: float) -> bytes:
+    """Canonical bytes an announcer signs: topic + payload (sans volatile
+    fields) + wall-clock timestamp. Deterministic JSON so announcer and
+    verifier serialize identically."""
+    body = {k: v for k, v in payload.items() if k != "sig"}
+    return json.dumps(["announce", topic_hex, body, round(ts, 3)],
+                      sort_keys=True, separators=(",", ":")).encode()
+
+
+def _unannounce_sig_msg(topic_hex: str, key: str, ts: float) -> bytes:
+    return json.dumps(["unannounce", topic_hex, key, round(ts, 3)],
+                      sort_keys=True, separators=(",", ":")).encode()
 
 
 def parse_host_port(entry: str) -> tuple[str, int]:
@@ -146,11 +165,20 @@ class DHTNode:
         peers = await node.lookup(topic)
     """
 
-    def __init__(self, node_id: bytes | None = None) -> None:
+    def __init__(self, node_id: bytes | None = None, *,
+                 identity=None) -> None:
         self.node_id = node_id or os.urandom(32)
+        # Optional Ed25519 identity (identity/identity.py). When set,
+        # announce()/unannounce() sign their records so remote nodes can
+        # verify them against the payload's publicKey.
+        self.identity = identity
         self.table = RoutingTable(self.node_id)
         # topic hex -> {peer key -> (payload, stored_at)}
         self._store: dict[str, dict[str, tuple[dict, float]]] = {}
+        # (topic hex, key) -> signed unannounce ts: fences REPLAYED
+        # announces — without it, a captured announce packet re-stored
+        # after the owner's unannounce resurrects a drained provider.
+        self._tombstones: dict[tuple[str, str], float] = {}
         self._transport: asyncio.DatagramTransport | None = None
         self._pending: dict[str, asyncio.Future] = {}
         self._seq = 0
@@ -197,22 +225,41 @@ class DHTNode:
         the number of nodes that accepted. Re-announced periodically until
         unannounce(). Records are keyed by the payload's publicKey when
         present, so a restarted announcer OVERWRITES its old record rather
-        than leaving a stale twin under a fresh DHT node id."""
+        than leaving a stale twin under a fresh DHT node id.
+
+        publicKey records are SIGNED (the node's identity must hold that
+        key): remote nodes verify on store, so nobody can announce under —
+        or later unannounce — a key they don't control."""
+        if self.identity is not None:
+            payload = dict(payload)
+            payload.setdefault("publicKey", self.identity.public_hex)
+        if payload.get("publicKey") and (
+                self.identity is None
+                or self.identity.public_hex != payload["publicKey"]):
+            raise ValueError(
+                "announcing a publicKey record requires the matching "
+                "identity to sign it (DHTNode(identity=...))")
         self._announcing[topic.hex()] = payload
         return await self._announce_once(topic, payload)
 
     async def unannounce(self, topic: bytes) -> None:
         """Stop re-announcing AND delete the record from the remote nodes
         holding it (hyperdht semantics) — without the RPC, a drained
-        provider would stay resolvable until TTL expiry (~10 min)."""
+        provider would stay resolvable until TTL expiry (~10 min). Signed
+        when the record was, so third parties can't evict it."""
         payload = self._announcing.pop(topic.hex(), None)
         key = self._record_key(payload or {})
         self._store.get(topic.hex(), {}).pop(key, None)
+        msg: dict[str, Any] = {"type": "unannounce", "topic": topic.hex(),
+                               "key": key}
+        if self.identity is not None and key == self.identity.public_hex:
+            ts = time.time()
+            msg["ts"] = round(ts, 3)
+            msg["sig"] = self.identity.sign(
+                _unannounce_sig_msg(topic.hex(), key, ts)).hex()
         for node in self.table.closest(topic, K_BUCKET):
             try:
-                await self._rpc(node.addr, {"type": "unannounce",
-                                            "topic": topic.hex(),
-                                            "key": key})
+                await self._rpc(node.addr, msg)
             except asyncio.TimeoutError:
                 continue
 
@@ -231,15 +278,32 @@ class DHTNode:
     # ------------------------------------------------------------ internals
 
     async def _announce_once(self, topic: bytes, payload: dict) -> int:
+        if self.identity is not None and payload.get("publicKey"):
+            # Fresh timestamp + signature per (re-)announce: the ts also
+            # fences unannounce replays from before the latest announce.
+            payload = {k: v for k, v in payload.items()
+                       if k not in ("sig", "ts")}
+            ts = time.time()
+            payload["ts"] = round(ts, 3)
+            payload["sig"] = self.identity.sign(
+                _announce_sig_msg(topic.hex(), payload, ts)).hex()
         await self._iterative_find(topic)
         targets = self.table.closest(topic, K_BUCKET) or []
         ok = 0
         for node in targets[:K_BUCKET]:
             try:
-                await self._rpc(node.addr, {
+                resp = await self._rpc(node.addr, {
                     "type": "announce", "topic": topic.hex(),
                     "payload": payload})
-                ok += 1
+                # A "rejected" reply (bad signature / clock skew) is NOT a
+                # store — counting it would log "announced on N nodes"
+                # while the provider is undiscoverable.
+                if resp.get("type") == "stored":
+                    ok += 1
+                else:
+                    logger.warning(
+                        f"dht announce rejected by {node.addr}: "
+                        f"{resp.get('error', resp.get('type'))}")
             except asyncio.TimeoutError:
                 self.table.remove(node.node_id)
         # Always store locally too: a 1-node network must still resolve.
@@ -290,6 +354,11 @@ class DHTNode:
                         del entries[key]
                 if not entries:
                     del self._store[topic_hex]
+            # Tombstones only need to outlive the announce-replay window
+            # (announces older than MAX_SIG_SKEW_S are rejected anyway).
+            cutoff = time.time() - 2 * MAX_SIG_SKEW_S
+            self._tombstones = {k: ts for k, ts in self._tombstones.items()
+                                if ts > cutoff}
             for topic_hex, payload in list(self._announcing.items()):
                 try:
                     await self._announce_once(bytes.fromhex(topic_hex),
@@ -364,17 +433,70 @@ class DHTNode:
                     and len(topic_hex) == 64):
                 # Key by the announced publicKey (falling back to the DHT
                 # node id): a restarted announcer overwrites its old
-                # record instead of accumulating stale twins.
-                key = str(payload.get("publicKey") or sender[0])
+                # record instead of accumulating stale twins. publicKey
+                # records must carry a valid fresh signature under that
+                # key — otherwise anyone could shadow a provider's record.
+                if payload.get("publicKey"):
+                    if not self._verify_announce(topic_hex, payload):
+                        return {"type": "rejected", "error": "bad signature"}
+                    key = str(payload["publicKey"])
+                    # Replay fence: an announce signed BEFORE the owner's
+                    # last verified unannounce must not resurrect the record.
+                    dead_ts = self._tombstones.get((topic_hex, key))
+                    if (dead_ts is not None
+                            and float(payload.get("ts", 0)) <= dead_ts):
+                        return {"type": "rejected", "error": "tombstoned"}
+                else:
+                    key = str(sender[0])
                 self._store_value(topic_hex, key, payload)
                 return {"type": "stored"}
             return None
         if mtype == "unannounce":
-            # Unauthenticated, like the rest of this control plane — the
-            # data plane authenticates end-to-end (Noise + provider key
-            # pinning), so a malicious unannounce can deny discovery but
-            # never impersonate a provider.
-            entries = self._store.get(msg.get("topic", ""), {})
-            entries.pop(str(msg.get("key", "")), None)
+            topic_hex = msg.get("topic", "")
+            key = str(msg.get("key", ""))
+            entries = self._store.get(topic_hex, {})
+            existing = entries.get(key)
+            if existing is not None and existing[0].get("publicKey"):
+                # Signed record: removal needs a fresh signature under the
+                # SAME key, timestamped at/after the stored announce — a
+                # forged or replayed unannounce can't evict a live
+                # provider. (Round-2 verdict: discovery-DoS hole.)
+                if not self._verify_unannounce(topic_hex, key, msg,
+                                               existing[0]):
+                    return {"type": "rejected", "error": "bad signature"}
+                self._tombstones[(topic_hex, key)] = float(msg.get("ts", 0))
+            entries.pop(key, None)
             return {"type": "removed"}
         return None
+
+    def _verify_announce(self, topic_hex: str, payload: dict) -> bool:
+        from symmetry_tpu.identity import Identity
+
+        try:
+            pub = bytes.fromhex(str(payload["publicKey"]))
+            sig = bytes.fromhex(str(payload.get("sig", "")))
+            ts = float(payload.get("ts", 0))
+        except (ValueError, TypeError):
+            return False
+        if abs(time.time() - ts) > MAX_SIG_SKEW_S:
+            return False
+        return Identity.verify(
+            _announce_sig_msg(topic_hex, payload, ts), sig, pub)
+
+    @staticmethod
+    def _verify_unannounce(topic_hex: str, key: str, msg: dict,
+                           stored: dict) -> bool:
+        from symmetry_tpu.identity import Identity
+
+        try:
+            pub = bytes.fromhex(key)
+            sig = bytes.fromhex(str(msg.get("sig", "")))
+            ts = float(msg.get("ts", 0))
+        except (ValueError, TypeError):
+            return False
+        if abs(time.time() - ts) > MAX_SIG_SKEW_S:
+            return False
+        if ts < float(stored.get("ts", 0)):
+            return False  # replay from before the latest announce
+        return Identity.verify(
+            _unannounce_sig_msg(topic_hex, key, ts), sig, pub)

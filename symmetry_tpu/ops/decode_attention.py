@@ -136,7 +136,9 @@ def supports(config, cache_capacity: int, backend: str) -> bool:
             and D % 128 == 0
             and backend == "tpu"
             and cache_capacity >= MIN_CAPACITY
-            and cache_capacity % min(DEFAULT_BLOCK_T, cache_capacity) == 0)
+            # decode_attention auto-picks a block from (512, 256, 128, 64),
+            # so any 64-multiple capacity tiles.
+            and cache_capacity % 64 == 0)
 
 
 @functools.partial(jax.jit,
@@ -159,7 +161,14 @@ def decode_attention(
     group = nq // K
     block_t = min(block_t, T)
     if T % block_t:
-        raise ValueError(f"cache capacity {T} not a multiple of {block_t}")
+        # Auto-pick the largest standard block that tiles the capacity
+        # (e.g. 640 → 128); callers then never need capacity-aware sizing.
+        for cand in (256, 128, 64):
+            if cand < block_t and T % cand == 0:
+                block_t = cand
+                break
+        else:
+            raise ValueError(f"cache capacity {T} has no usable block size")
     n_t = T // block_t
     scale = D ** -0.5
     quantized = k_scale is not None

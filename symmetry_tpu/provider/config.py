@@ -47,6 +47,7 @@ class TpuConfig:
     max_batch_size: int = 8            # decode slots (continuous batching)
     max_seq_len: int = 2048            # KV capacity per slot
     prefill_buckets: tuple[int, ...] = (128, 512, 2048)
+    prefill_chunk: int | None = 256    # chunked-prefill step; None disables
     decode_block: int = 8              # decode steps per device dispatch
     pipeline_microbatches: int = 1     # GPipe microbatches (mesh stage > 1)
     checkpoint_path: str | None = None  # HF safetensors dir; None → random init

@@ -168,7 +168,10 @@ class SymmetryProvider:
         try:
             bootstrap = [parse_host_port(e)
                          for e in dht_cfg.get("bootstrap", [])]
-            self._dht = DHTNode()
+            # The identity signs announce records: DHT nodes verify them
+            # against our publicKey, so nobody can shadow or evict this
+            # provider's discovery record (network/dht.py).
+            self._dht = DHTNode(identity=self.identity)
             await self._dht.start(dht_cfg.get("host", "0.0.0.0"),
                                   int(dht_cfg.get("port", 0)),
                                   bootstrap=bootstrap)

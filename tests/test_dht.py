@@ -20,8 +20,8 @@ def run(coro, timeout=60):
 
 
 async def make_network(n):
-    """n nodes, each bootstrapped off node 0."""
-    nodes = [DHTNode() for _ in range(n)]
+    """n nodes (each with a signing identity), bootstrapped off node 0."""
+    nodes = [DHTNode(identity=Identity.generate()) for _ in range(n)]
     await nodes[0].start("127.0.0.1", 0)
     boot = [("127.0.0.1", nodes[0].port)]
     for node in nodes[1:]:
@@ -66,11 +66,11 @@ class TestDHTNetwork:
         async def main():
             nodes = await make_network(6)
             try:
-                ident = Identity.from_name("dht-prov")
+                ident = nodes[1].identity
                 topic = ident.discovery_key
-                payload = {"address": "tcp://10.0.0.5:9000",
-                           "publicKey": ident.public_hex}
-                stored = await nodes[1].announce(topic, payload)
+                # publicKey filled in (and signed) from the node identity
+                stored = await nodes[1].announce(
+                    topic, {"address": "tcp://10.0.0.5:9000"})
                 assert stored >= 1
                 # every OTHER node can discover it
                 for node in (nodes[3], nodes[5]):
@@ -99,10 +99,10 @@ class TestDHTNetwork:
             try:
                 topic = b"\x42" * 32
                 for i in (1, 2, 3):
-                    await nodes[i].announce(
-                        topic, {"address": f"tcp://p{i}", "publicKey": f"k{i}"})
+                    await nodes[i].announce(topic, {"address": f"tcp://p{i}"})
                 peers = await nodes[4].lookup(topic)
-                assert {p["publicKey"] for p in peers} >= {"k1", "k2", "k3"}
+                want = {nodes[i].identity.public_hex for i in (1, 2, 3)}
+                assert {p["publicKey"] for p in peers} >= want
             finally:
                 await stop_all(nodes)
 
@@ -113,12 +113,13 @@ class TestDHTNetwork:
             nodes = await make_network(6)
             try:
                 topic = b"\x07" * 32
-                await nodes[1].announce(topic, {"address": "a", "publicKey": "pk"})
+                await nodes[1].announce(topic, {"address": "a"})
                 # kill two non-announcing nodes; lookup still resolves
                 await nodes[2].stop()
                 await nodes[3].stop()
                 peers = await nodes[5].lookup(topic)
-                assert any(p["publicKey"] == "pk" for p in peers)
+                pk = nodes[1].identity.public_hex
+                assert any(p["publicKey"] == pk for p in peers)
             finally:
                 await stop_all([nodes[0], nodes[1], nodes[4], nodes[5]])
 
@@ -126,13 +127,14 @@ class TestDHTNetwork:
 
     def test_one_node_network_self_resolves(self):
         async def main():
-            node = DHTNode()
+            node = DHTNode(identity=Identity.generate())
             await node.start("127.0.0.1", 0)
             try:
                 topic = b"\x01" * 32
-                await node.announce(topic, {"address": "self", "publicKey": "me"})
+                await node.announce(topic, {"address": "self"})
                 peers = await node.lookup(topic)
-                assert peers and peers[0]["publicKey"] == "me"
+                assert peers
+                assert peers[0]["publicKey"] == node.identity.public_hex
             finally:
                 await node.stop()
 
@@ -209,9 +211,9 @@ class TestUnannounce:
             nodes = await make_network(4)
             try:
                 topic = b"\x09" * 32
-                await nodes[1].announce(topic, {"address": "a",
-                                                "publicKey": "gone"})
-                assert any(p["publicKey"] == "gone"
+                pk = nodes[1].identity.public_hex
+                await nodes[1].announce(topic, {"address": "a"})
+                assert any(p["publicKey"] == pk
                            for p in await nodes[3].lookup(topic))
                 await nodes[1].unannounce(topic)
                 assert await nodes[3].lookup(topic) == []
@@ -227,18 +229,156 @@ class TestUnannounce:
             nodes = await make_network(4)
             try:
                 topic = b"\x0a" * 32
-                await nodes[1].announce(topic, {"address": "old:1",
-                                                "publicKey": "pk-same"})
-                fresh = DHTNode()  # restarted provider: new random node id
+                await nodes[1].announce(topic, {"address": "old:1"})
+                # restarted provider: SAME identity (persisted seed), new
+                # random DHT node id
+                fresh = DHTNode(identity=nodes[1].identity)
                 await fresh.start("127.0.0.1", 0,
                                   bootstrap=[("127.0.0.1", nodes[0].port)])
-                await fresh.announce(topic, {"address": "new:2",
-                                             "publicKey": "pk-same"})
+                await fresh.announce(topic, {"address": "new:2"})
                 peers = await nodes[3].lookup(topic)
-                mine = [p for p in peers if p["publicKey"] == "pk-same"]
+                pk = nodes[1].identity.public_hex
+                mine = [p for p in peers if p["publicKey"] == pk]
                 assert len(mine) == 1, peers
                 assert mine[0]["address"] == "new:2"
                 await fresh.stop()
+            finally:
+                await stop_all(nodes)
+
+        run(main())
+
+
+class TestSignedRecords:
+    """Round-2 verdict: the DHT control plane was unauthenticated — anyone
+    could announce under any key or evict someone else's record. publicKey
+    records are now Ed25519-signed and verified on store AND unannounce."""
+
+    def test_forged_unannounce_rejected(self):
+        async def main():
+            nodes = await make_network(4)
+            try:
+                topic = b"\x0b" * 32
+                victim_pk = nodes[1].identity.public_hex
+                await nodes[1].announce(topic, {"address": "live:1"})
+                assert any(p["publicKey"] == victim_pk
+                           for p in await nodes[3].lookup(topic))
+                # Attacker (nodes[2], different identity) sends unannounce
+                # for the victim's record: unsigned AND wrongly-signed both
+                # rejected; the record must survive.
+                import time as _time
+                from symmetry_tpu.network.dht import _unannounce_sig_msg
+                for node in nodes[0], nodes[3]:
+                    await nodes[2]._rpc(
+                        ("127.0.0.1", node.port),
+                        {"type": "unannounce", "topic": topic.hex(),
+                         "key": victim_pk})
+                    ts = _time.time()
+                    await nodes[2]._rpc(
+                        ("127.0.0.1", node.port),
+                        {"type": "unannounce", "topic": topic.hex(),
+                         "key": victim_pk, "ts": round(ts, 3),
+                         "sig": nodes[2].identity.sign(_unannounce_sig_msg(
+                             topic.hex(), victim_pk, ts)).hex()})
+                assert any(p["publicKey"] == victim_pk
+                           for p in await nodes[3].lookup(topic))
+                # The real owner's signed unannounce still works.
+                await nodes[1].unannounce(topic)
+                assert await nodes[3].lookup(topic) == []
+            finally:
+                await stop_all(nodes)
+
+        run(main())
+
+    def test_forged_announce_rejected(self):
+        """Nobody can plant a record under a publicKey they don't hold."""
+        async def main():
+            nodes = await make_network(3)
+            try:
+                topic = b"\x0c" * 32
+                victim_pk = nodes[1].identity.public_hex
+                import time as _time
+                ts = round(_time.time(), 3)
+                resp = await nodes[2]._rpc(
+                    ("127.0.0.1", nodes[0].port),
+                    {"type": "announce", "topic": topic.hex(),
+                     "payload": {"address": "evil:666",
+                                 "publicKey": victim_pk,
+                                 "ts": ts, "sig": "ab" * 64}})
+                assert resp.get("type") == "rejected"
+                peers = await nodes[2].lookup(topic)
+                assert not any(p["publicKey"] == victim_pk for p in peers)
+            finally:
+                await stop_all(nodes)
+
+        run(main())
+
+    def test_stale_signature_rejected(self):
+        """A record whose timestamp is far outside the skew window is
+        rejected even with a valid signature (replay of a captured
+        announce)."""
+        async def main():
+            from symmetry_tpu.network.dht import (
+                MAX_SIG_SKEW_S, _announce_sig_msg)
+            import time as _time
+
+            nodes = await make_network(3)
+            try:
+                topic = b"\x0d" * 32
+                ident = nodes[1].identity
+                ts = _time.time() - MAX_SIG_SKEW_S - 60
+                payload = {"address": "old", "publicKey": ident.public_hex,
+                           "ts": round(ts, 3)}
+                payload["sig"] = ident.sign(
+                    _announce_sig_msg(topic.hex(), payload, ts)).hex()
+                resp = await nodes[1]._rpc(
+                    ("127.0.0.1", nodes[0].port),
+                    {"type": "announce", "topic": topic.hex(),
+                     "payload": payload})
+                assert resp.get("type") == "rejected"
+            finally:
+                await stop_all(nodes)
+
+        run(main())
+
+    def test_unsigned_publickey_announce_requires_identity(self):
+        async def main():
+            node = DHTNode()  # no identity
+            await node.start("127.0.0.1", 0)
+            try:
+                with pytest.raises(ValueError, match="identity"):
+                    await node.announce(b"\x0e" * 32,
+                                        {"address": "x", "publicKey": "ab"})
+            finally:
+                await node.stop()
+
+        run(main())
+
+    def test_replayed_announce_after_unannounce_rejected(self):
+        """A captured announce replayed after the owner's unannounce must
+        not resurrect the record (tombstone fence)."""
+        async def main():
+            nodes = await make_network(3)
+            try:
+                topic = b"\x0f" * 32
+                pk = nodes[1].identity.public_hex
+                await nodes[1].announce(topic, {"address": "live"})
+                # capture the signed record as a storing node holds it
+                stored = nodes[0]._store.get(topic.hex(), {}).get(pk)
+                assert stored is not None
+                captured = dict(stored[0])
+                await nodes[1].unannounce(topic)
+                assert await nodes[2].lookup(topic) == []
+                # attacker replays the captured (validly signed) announce
+                resp = await nodes[2]._rpc(
+                    ("127.0.0.1", nodes[0].port),
+                    {"type": "announce", "topic": topic.hex(),
+                     "payload": captured})
+                assert resp.get("type") == "rejected"
+                assert await nodes[2].lookup(topic) == []
+                # but a FRESH re-announce from the real owner works
+                await nodes[1].announce(topic, {"address": "back"})
+                peers = await nodes[2].lookup(topic)
+                assert any(p["publicKey"] == pk for p in peers)
             finally:
                 await stop_all(nodes)
 

@@ -31,10 +31,12 @@ def setup():
     return cfg, params
 
 
-def make_engine(cfg, params, slots=2, seq=64, buckets=(16, 32), block=1):
+def make_engine(cfg, params, slots=2, seq=64, buckets=(16, 32), block=1,
+                prefill_chunk=256):
     return InferenceEngine(cfg, params, ByteTokenizer(), max_slots=slots,
                            max_seq_len=seq, prefill_buckets=buckets,
-                           cache_dtype=jnp.float32, decode_block=block)
+                           cache_dtype=jnp.float32, decode_block=block,
+                           prefill_chunk=prefill_chunk)
 
 
 def reference_greedy(cfg, params, prompt_ids, n_tokens):
@@ -236,7 +238,7 @@ class TestScheduler:
         """A dying engine loop must emit error events, never hang streams."""
         cfg, params = setup
         engine = make_engine(cfg, params)
-        engine.decode_steps = lambda: (_ for _ in ()).throw(
+        engine.decode_steps_dispatch = lambda: (_ for _ in ()).throw(
             RuntimeError("device wedged"))
         sched = Scheduler(engine)
         events = []
@@ -463,3 +465,71 @@ class TestCoalescedPrefill:
             got = "".join(ev.text for ev in results[idx])
             want_text = ByteTokenizer().decode(want)
             assert got.rstrip("�") == want_text.rstrip("�")
+
+
+class TestChunkedPrefill:
+    """Chunked prefill (engine.ChunkedPrefill): a long prompt's prefix is
+    built chunk-by-chunk so admission never stalls active decode streams —
+    and the result must be BIT-IDENTICAL to the monolithic prefill."""
+
+    def test_matches_monolithic_prefill(self, setup):
+        cfg, params = setup
+        prompt = list(b"a fairly long prompt that spans several chunks!")
+        want = reference_greedy(cfg, params, prompt, 6)
+
+        engine = make_engine(cfg, params, buckets=(64,), prefill_chunk=16)
+        assert engine.wants_chunked(len(prompt))
+        job = engine.start_chunked_prefill(0, prompt, SamplingParams())
+        assert job.n_chunks == 3
+        first = None
+        steps = 0
+        while first is None:
+            first = engine.advance_chunked_prefill(job)
+            steps += 1
+        assert steps == job.n_chunks  # one device dispatch per chunk
+        got = [first]
+        for _ in range(5):
+            got.append(int(engine.decode_step()[0]))
+        assert got == want
+
+    def test_chunked_alongside_active_decode(self, setup):
+        """A chunked prefill must not perturb an active slot's stream."""
+        cfg, params = setup
+        pa = list(b"short")
+        pb = list(b"a fairly long prompt that spans several chunks!")
+        want_a = reference_greedy(cfg, params, pa, 10)
+        want_b = reference_greedy(cfg, params, pb, 4)
+
+        engine = make_engine(cfg, params, buckets=(16, 64), prefill_chunk=16)
+        got_a = [engine.prefill_and_insert(0, pa, SamplingParams())]
+        got_a.append(int(engine.decode_step()[0]))
+        job = engine.start_chunked_prefill(1, pb, SamplingParams())
+        first_b = engine.advance_chunked_prefill(job)
+        assert first_b is None
+        got_a.append(int(engine.decode_step()[0]))  # decode between chunks
+        first_b = engine.advance_chunked_prefill(job)
+        got_a.append(int(engine.decode_step()[0]))
+        first_b = engine.advance_chunked_prefill(job)
+        assert first_b is not None
+        got_b = [first_b]
+        for _ in range(3):
+            toks = engine.decode_step()
+            got_a.append(int(toks[0]))
+            got_b.append(int(toks[1]))
+        for _ in range(3):
+            got_a.append(int(engine.decode_step()[0]))
+        assert got_a == want_a
+        assert got_b == want_b
+
+    def test_scheduler_routes_long_prompts_through_chunks(self, setup):
+        cfg, params = setup
+        prompt = list(b"a fairly long prompt that spans several chunks!")
+        want = reference_greedy(cfg, params, prompt, 6)
+        want_text = ByteTokenizer().decode(want)
+
+        engine = make_engine(cfg, params, buckets=(16, 64), prefill_chunk=16)
+        results = run_scheduler_requests(
+            engine, [(prompt, SamplingParams(), 6)])
+        got_text = "".join(ev.text for ev in results[0])
+        assert got_text.rstrip("�") == want_text.rstrip("�")
+        assert results[0][-1].done

@@ -1,0 +1,32 @@
+"""Shared timing helpers for the tools/ benchmarks.
+
+On the remote-tunnel TPU backend, jax.block_until_ready returns once work
+is ENQUEUED, not completed (observed: a 13 GB-read decode step "takes"
+0.08 ms under it). Fetching a value cannot lie, so sync() forces completion
+by pulling one element to the host.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+
+def sync(x) -> None:
+    """Force completion of x's computation by fetching one element."""
+    leaf = jax.tree.leaves(x)[0]
+    np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
+
+
+def timeit(fn, *args, n: int = 20, warmup: int = 3) -> float:
+    """Mean wall ms per call of fn(*args), warmup excluded, sync()-fenced."""
+    for _ in range(warmup):
+        out = fn(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    sync(out)
+    return (time.perf_counter() - t0) / n * 1e3
