@@ -40,6 +40,8 @@ constexpr uint8_t F_ACK = 2;
 constexpr uint8_t F_FIN = 4;
 constexpr uint8_t F_DATA = 8;
 constexpr uint8_t F_EOFR = 16;  // last segment of a frame
+constexpr uint8_t F_RAW = 32;   // connectionless datagram (NAT punch /
+                                // rendezvous side-channel, us_send_raw)
 
 constexpr size_t HDR = 16;
 constexpr size_t MTU_PAYLOAD = 1200;
@@ -97,6 +99,8 @@ struct Ctx {
   std::condition_variable cv;
   std::map<uint64_t, Conn> conns;               // key: addr-hash<<32 | id
   std::deque<uint64_t> accept_q;
+  // connectionless F_RAW datagrams (payload, source) for us_recv_raw
+  std::deque<std::pair<Addr, std::vector<uint8_t>>> raw_q;
   std::mt19937 rng{std::random_device{}()};
 
   uint64_t key_for(const Addr& a, uint32_t id) {
@@ -153,6 +157,18 @@ void handle_packet(Ctx* c, const Addr& from, const uint8_t* b, ssize_t n) {
   if (ssize_t(HDR) + len > n) return;
 
   std::lock_guard<std::mutex> lk(c->mu);
+
+  if (flags & F_RAW) {
+    // Side-channel datagram: same socket (the NAT mapping under the
+    // streams), no connection state. Bounded queue: punch bursts are
+    // small and stale entries are worthless.
+    if (c->raw_q.size() < 256) {
+      c->raw_q.emplace_back(from, std::vector<uint8_t>(b + HDR, b + HDR + len));
+      c->cv.notify_all();
+    }
+    return;
+  }
+
   uint64_t key = c->key_for(from, conn_id);
   auto it = c->conns.find(key);
 
@@ -388,6 +404,42 @@ void us_close(void* h, uint64_t key) {
   send_pkt(c, it->second.peer, F_FIN, it->second.id, 0, 0, nullptr, 0);
   it->second.closed = true;
   c->cv.notify_all();
+}
+
+int us_send_raw(void* h, const char* ip, int port, const uint8_t* data,
+                int len) {
+  // Connectionless datagram from THIS ctx's socket — the packet's source
+  // is the same (addr, port) the stream protocol uses, which is what
+  // makes it useful: it opens/keeps-open the NAT mapping that a
+  // subsequent us_dial (or an inbound SYN) will traverse.
+  Ctx* c = static_cast<Ctx*>(h);
+  if (len < 0 || size_t(len) > MTU_PAYLOAD) return 0;
+  Addr to;
+  to.sa.sin_family = AF_INET;
+  to.sa.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, ip, &to.sa.sin_addr) != 1) return 0;
+  send_pkt(c, to, F_RAW, 0, 0, 0, data, uint16_t(len));
+  return 1;
+}
+
+int us_recv_raw(void* h, uint8_t* buf, int cap, char* ip_out, int* port_out,
+                int timeout_ms) {
+  // Pop one raw datagram; returns its length, or -1 on timeout. ip_out
+  // must hold >= 16 bytes (INET_ADDRSTRLEN).
+  Ctx* c = static_cast<Ctx*>(h);
+  std::unique_lock<std::mutex> lk(c->mu);
+  if (!c->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                      [&] { return !c->raw_q.empty() || c->stop.load(); }))
+    return -1;
+  if (c->raw_q.empty()) return -1;
+  auto [from, payload] = std::move(c->raw_q.front());
+  c->raw_q.pop_front();
+  int n = int(payload.size());
+  if (n > cap) n = cap;
+  memcpy(buf, payload.data(), n);
+  inet_ntop(AF_INET, &from.sa.sin_addr, ip_out, 16);
+  *port_out = ntohs(from.sa.sin_port);
+  return n;
 }
 
 void us_destroy(void* h) {

@@ -44,6 +44,15 @@ class ProviderDetails:
     raw: dict = field(default_factory=dict)
 
 
+@dataclass(slots=True)
+class ChatRestart:
+    """Failover marker: a new provider took over and generation restarted —
+    everything streamed before this event must be discarded."""
+
+    attempt: int
+    provider_key: str
+
+
 class ProviderSession:
     """A live connection to one provider."""
 
@@ -177,16 +186,21 @@ class SymmetryClient:
 
     async def request_provider(
         self, server_address: str, server_key: bytes, model_name: str | None = None,
-        timeout: float = 10.0,
+        timeout: float = 10.0, exclude: list[str] | None = None,
     ) -> ProviderDetails:
         """Ask the server for a provider assignment (requestProvider →
-        providerDetails, reference keys src/constants.ts:16,14)."""
+        providerDetails, reference keys src/constants.ts:16,14). `exclude`
+        lists peer keys the server must not hand back (failover re-request
+        after a provider died)."""
         conn = await self._transport.dial(server_address)
         peer = await Peer.connect(
             conn, self.identity, initiator=True, expected_remote_key=server_key
         )
         try:
-            await peer.send(MessageKey.REQUEST_PROVIDER, {"modelName": model_name})
+            req: dict[str, Any] = {"modelName": model_name}
+            if exclude:
+                req["excludePeers"] = list(exclude)
+            await peer.send(MessageKey.REQUEST_PROVIDER, req)
             msg = await asyncio.wait_for(peer.recv(), timeout)
             if msg is None or msg.key != MessageKey.PROVIDER_DETAILS:
                 raise ClientError(f"unexpected server reply: {msg and msg.key}")
@@ -219,16 +233,140 @@ class SymmetryClient:
         finally:
             await peer.close()
 
-    async def connect(self, details: ProviderDetails) -> ProviderSession:
-        """Dial a provider directly, pinning its key from providerDetails."""
-        if not details.address:
+    async def chat_failover(
+        self,
+        server_address: str,
+        server_key: bytes,
+        model_name: str,
+        messages: list[dict[str, str]],
+        *,
+        attempts: int = 3,
+        **chat_kw,
+    ) -> AsyncIterator[str | "ChatRestart"]:
+        """Streaming chat with provider failover.
+
+        If the assigned provider dies before the stream completes, the
+        server is asked for a FRESH provider (the dead one excluded — its
+        sessions were invalidated server-side) and generation restarts.
+        A restart yields a ChatRestart sentinel first: text streamed from
+        the dead provider is void and consumers must discard it (a
+        half-finished completion cannot be resumed token-exactly on
+        another node). chat_text_failover does that bookkeeping for you.
+        """
+        dead: list[str] = []
+        last_exc: Exception | None = None
+        for attempt in range(attempts):
+            try:
+                details = await self.request_provider(
+                    server_address, server_key, model_name, exclude=dead)
+            except ClientError as exc:
+                last_exc = exc
+                break  # no provider left to fail over to
+            if attempt > 0:
+                yield ChatRestart(attempt=attempt,
+                                  provider_key=details.peer_key)
+            try:
+                session = await self.connect(details)
+            except (ClientError, ConnectionError, OSError) as exc:
+                last_exc = exc
+                if details.peer_key:
+                    dead.append(details.peer_key)
+                continue
+            try:
+                async for delta in session.chat(messages, **chat_kw):
+                    yield delta
+                return
+            except (ClientError, ConnectionError, OSError) as exc:
+                last_exc = exc
+                if details.peer_key:
+                    dead.append(details.peer_key)
+            finally:
+                await session.close()
+        raise ClientError(
+            f"chat failed after {attempts} provider attempt(s): {last_exc}")
+
+    async def chat_text_failover(self, server_address: str, server_key: bytes,
+                                 model_name: str,
+                                 messages: list[dict[str, str]],
+                                 **kw) -> str:
+        """chat_failover collected to a final string (restart-aware)."""
+        parts: list[str] = []
+        async for item in self.chat_failover(server_address, server_key,
+                                             model_name, messages, **kw):
+            if isinstance(item, ChatRestart):
+                parts.clear()  # the dead provider's partial text is void
+            else:
+                parts.append(item)
+        return "".join(parts)
+
+    async def connect(self, details: ProviderDetails,
+                      *, relay_via: tuple[str, bytes] | None = None
+                      ) -> ProviderSession:
+        """Dial a provider directly, pinning its key from providerDetails.
+
+        With `relay_via=(server_address, server_key)`, a failed direct
+        dial falls back to the server-spliced relay (network/relay.py) —
+        the reference's behind-NAT reachability leg."""
+        if not details.address and relay_via is None:
             raise ClientError("provider has no dialable address")
-        conn = await self._transport.dial(details.address)
         expected = bytes.fromhex(details.peer_key) if details.peer_key else None
+        conn = None
+        if details.address:
+            try:
+                conn = await self._transport.dial(details.address)
+            except (ConnectionError, OSError) as exc:
+                if relay_via is None:
+                    raise
+                logger.info(f"direct dial {details.address} failed ({exc}); "
+                            f"falling back to relay")
+        if conn is None:
+            assert relay_via is not None
+            if not details.peer_key:
+                raise ClientError("relay requires the provider's key")
+            conn = await self.connect_relay(relay_via[0], relay_via[1],
+                                            details.peer_key)
         peer = await Peer.connect(
             conn, self.identity, initiator=True, expected_remote_key=expected
         )
         return ProviderSession(peer, details)
+
+    async def connect_relay(self, server_address: str, server_key: bytes,
+                            provider_key_hex: str):
+        """Open a server-spliced relay channel to a provider (the Noise
+        handshake with the provider then runs THROUGH it — the server
+        carries only ciphertext)."""
+        from symmetry_tpu.network.relay import RelayedConnection
+
+        conn = await self._transport.dial(server_address)
+        server_peer = await Peer.connect(
+            conn, self.identity, initiator=True,
+            expected_remote_key=server_key)
+        try:
+            await server_peer.send(MessageKey.RELAY_CONNECT,
+                                   {"providerKey": provider_key_hex})
+            # the relayId arrives in relayReady; connect waits for it
+            relay_id = await self._await_relay_ready(server_peer)
+        except BaseException:
+            # failed setup must not leak the dialed server connection —
+            # failover retries would accumulate sockets
+            await server_peer.close()
+            raise
+        return RelayedConnection(server_peer, relay_id)
+
+    @staticmethod
+    async def _await_relay_ready(server_peer: Peer,
+                                 timeout: float = 10.0) -> str:
+        async def _wait() -> str:
+            async for msg in server_peer:
+                if msg.key == MessageKey.RELAY_READY:
+                    return str((msg.data or {}).get("id", ""))
+                if msg.key in (MessageKey.RELAY_CLOSE,
+                               MessageKey.INFERENCE_ERROR):
+                    raise ClientError(
+                        (msg.data or {}).get("error", "relay refused"))
+            raise ClientError("server closed during relay setup")
+
+        return await asyncio.wait_for(_wait(), timeout)
 
     async def connect_direct(self, address: str, provider_key: bytes | None = None,
                              model_name: str = "") -> ProviderSession:

@@ -257,11 +257,18 @@ class DHTNode:
             msg["ts"] = round(ts, 3)
             msg["sig"] = self.identity.sign(
                 _unannounce_sig_msg(topic.hex(), key, ts)).hex()
+        # One retry on timeout: a node that misses the unannounce also
+        # misses the replay-fencing tombstone, so a captured announce could
+        # be replayed at it for up to MAX_SIG_SKEW_S (best-effort fence —
+        # nodes unreachable through both attempts keep that residual
+        # window, bounded by the record TTL).
         for node in self.table.closest(topic, K_BUCKET):
-            try:
-                await self._rpc(node.addr, msg)
-            except asyncio.TimeoutError:
-                continue
+            for _ in range(2):
+                try:
+                    await self._rpc(node.addr, msg)
+                    break
+                except asyncio.TimeoutError:
+                    continue
 
     def _record_key(self, payload: dict) -> str:
         return str(payload.get("publicKey") or self.node_id.hex())
@@ -447,6 +454,9 @@ class DHTNode:
                             and float(payload.get("ts", 0)) <= dead_ts):
                         return {"type": "rejected", "error": "tombstoned"}
                 else:
+                    # sender[0] is the announcer's DHT node id (the "from"
+                    # field is [node_id_hex, port]) — the same fallback
+                    # _record_key uses, so its unannounce key matches.
                     key = str(sender[0])
                 self._store_value(topic_hex, key, payload)
                 return {"type": "stored"}

@@ -1,0 +1,123 @@
+"""Native int8 MXU matmul (W8A8) — EXPERIMENTAL, not routed by default.
+
+Engineering record of a measured dead end on v5e, kept because the
+arithmetic is correct (tests/test_qmm.py) and other TPU generations may
+change the verdict:
+
+  - Every XLA int8 dot form — mixed bf16×s8, dequant-materialize, s8×s8
+    with s32 accumulation — measures at the s8→float convert throughput
+    (~270–480 GB/s effective), while bf16×bf16 streams at ~820 GB/s
+    (tools/microbench_matmul.py, carry-dependent loop).
+  - Hypothesis: feeding the MXU s8×s8 tiles directly from a Pallas kernel
+    skips the convert. Microbenchmarks first showed ~590 GB/s, but that
+    was a loop-invariant-hoisting artifact; with the input made
+    carry-dependent the kernel measures ~258 GB/s (tools/probe_s8_mxu.py),
+    and routed into the real decode trunk it is ~50% SLOWER end-to-end
+    (48.5 vs 32.1 ms — tools/bisect_decode.py, BISECT_W8A8=1).
+  - Conclusion: Mosaic's s8 dot path on v5e is no faster than XLA's, and
+    the mixed dot in ops/quant.qmatmul stays the production path.
+
+The activation is quantized dynamically per row (per token/slot) to int8;
+the s32 tile products are rescaled in the kernel epilogue by
+(row activation scale × per-output-channel weight scale).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Measured on v5e (tools/probe_s8_mxu.py): (bn=256, bk=512) and
+# (512, 1024) both hit the ~590 GB/s mode; smaller bn keeps more N-blocks
+# for the grid, which generalizes better to narrow layers.
+BLOCK_N = 256
+BLOCK_K = 512
+MIN_ROWS = 32  # below this the MXU is mostly idle; mixed dot wins
+
+
+def _kernel(x_ref, w_ref, xs_ref, ws_ref, o_ref, acc_scr, *, n_k: int,
+            out_dtype):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    acc_scr[:] += jax.lax.dot_general(
+        x_ref[:], w_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+    @pl.when(k == n_k - 1)
+    def _():
+        # epilogue: s32 -> f32, row scale × column scale, cast out
+        o_ref[:] = (acc_scr[:].astype(jnp.float32)
+                    * xs_ref[:] * ws_ref[:]).astype(out_dtype)
+
+
+def _pick_block(dim: int, prefer: int) -> int | None:
+    for cand in (prefer, 512, 256, 128, 64):
+        if cand <= prefer and dim % cand == 0:
+            return cand
+    return None
+
+
+def supports(m: int, k: int, n: int, backend: str) -> bool:
+    """Static gate for the w8a8 kernel (shapes tileable, MXU-worthy M)."""
+    return (backend == "tpu"
+            and m >= MIN_ROWS
+            and _pick_block(k, BLOCK_K) is not None
+            and _pick_block(n, BLOCK_N) is not None)
+
+
+def quantize_rows(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-row int8: x [M, K] -> (q [M, K] s8, scale [M, 1] f32)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
+def w8a8_matmul(
+    x: jnp.ndarray,        # [M, K] float (bf16/f32)
+    wq: jnp.ndarray,       # [K, N] int8
+    w_scale: jnp.ndarray,  # [N] f32 per-output-channel
+    *,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """x @ dequant(wq) with the activation quantized per row to int8 and
+    the product computed as native s8×s8 → s32 MXU tiles."""
+    M, K = x.shape
+    Kw, N = wq.shape
+    assert K == Kw, (K, Kw)
+    out_dtype = out_dtype or x.dtype
+    bk = _pick_block(K, BLOCK_K)
+    bn = _pick_block(N, BLOCK_N)
+    if bk is None or bn is None:
+        raise ValueError(f"untileable w8a8 shape K={K} N={N}")
+    n_k = K // bk
+
+    xq, xs = quantize_rows(x)
+    ws = w_scale.astype(jnp.float32).reshape(1, N)
+
+    return pl.pallas_call(
+        functools.partial(_kernel, n_k=n_k, out_dtype=out_dtype),
+        grid=(N // bn, n_k),
+        in_specs=[
+            pl.BlockSpec((M, bk), lambda n, k: (0, k)),
+            pl.BlockSpec((bk, bn), lambda n, k: (k, n)),
+            pl.BlockSpec((M, 1), lambda n, k: (0, 0)),
+            pl.BlockSpec((1, bn), lambda n, k: (0, n)),
+        ],
+        out_specs=pl.BlockSpec((M, bn), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        scratch_shapes=[pltpu.VMEM((M, bn), jnp.int32)],
+        interpret=interpret,
+    )(xq, wq, xs, ws)

@@ -264,6 +264,13 @@ class MultihostEngine:
     def decode_steps(self) -> np.ndarray:
         return self._loop.lead(Command(kind=CMD_DECODE))
 
+    def decode_steps_dispatch(self) -> np.ndarray:
+        """Scheduler's double-buffer hook. Multihost decode must complete
+        the cross-process command round before returning, so there is no
+        async lookahead here — the already-materialized token block is
+        returned and the scheduler's np.asarray on it is a no-op."""
+        return self.decode_steps()
+
     def release_slot(self, slot: int) -> None:
         """Host-side no-op (engine.release_slot); nothing to broadcast."""
         self._loop.engine.release_slot(slot)

@@ -40,6 +40,16 @@ class MessageKey:
     METRICS = "metrics"                       # provider → server load metrics (tok/s, queue depth)
     PROVIDER_LIST = "providerList"            # server → client available models
 
+    # --- relay (NAT fallback: server splices client↔provider, payload
+    #     stays end-to-end Noise-encrypted — the reference gets this leg
+    #     from hyperdht relaying; network/relay.py) ---
+    RELAY_CONNECT = "relayConnect"            # client → server {providerKey}
+    RELAY_OPEN = "relayOpen"                  # server → provider {relayId}
+    RELAY_ACCEPT = "relayAccept"              # provider → server {relayId}
+    RELAY_READY = "relayReady"                # server → both ends
+    RELAY_DATA = "relayData"                  # spliced opaque frames
+    RELAY_CLOSE = "relayClose"                # either end / server teardown
+
 
 SERVER_MESSAGE_KEYS = frozenset(
     v for k, v in vars(MessageKey).items() if not k.startswith("_")

@@ -151,6 +151,33 @@ class SymmetryProvider:
             self._spawn(self._server_loop())
         self._spawn(self._health_loop())
         await self._join_dht()
+        self._start_puncher()
+
+    def _start_puncher(self) -> None:
+        """NAT hole punching (network/natpunch.py): keep this provider
+        registered at a rendezvous and answer punch invites, so clients
+        behind NATs can reach the UDP listener directly. Requires the
+        native udp transport (the raw side channel rides its socket)."""
+        self._puncher = None
+        punch_cfg = self.config.get("natPunch")
+        if not punch_cfg:
+            return
+        raw_factory = getattr(self._listener, "raw_channel", None)
+        if raw_factory is None:
+            logger.warning("natPunch configured but the transport has no "
+                           "raw channel (udp:// required); punching disabled")
+            return
+        from symmetry_tpu.network.dht import parse_host_port
+        from symmetry_tpu.network.natpunch import ProviderPuncher
+
+        try:
+            rdv = parse_host_port(punch_cfg["rendezvous"])
+        except (KeyError, ValueError) as exc:
+            logger.error(f"natPunch disabled: {exc}")
+            return
+        self._puncher = ProviderPuncher(raw_factory(), rdv,
+                                        self.identity.public_hex)
+        self._puncher.start()
 
     async def _join_dht(self) -> None:
         """Announce on the Kademlia DHT (network/dht.py) so clients can
@@ -195,6 +222,9 @@ class SymmetryProvider:
     async def stop(self, drain_timeout_s: float = 30.0) -> None:
         """Graceful drain: stop accepting, finish in-flight, leave, close."""
         self._draining = True
+        if getattr(self, "_puncher", None) is not None:
+            await self._puncher.stop()
+            self._puncher = None
         if self._dht is not None:
             with contextlib.suppress(Exception):
                 await self._dht.unannounce(self.identity.discovery_key)
@@ -279,9 +309,36 @@ class SymmetryProvider:
                 self._server_ready.set()
             elif msg.key == MessageKey.PING:
                 await peer.send(MessageKey.PONG)
+            elif msg.key == MessageKey.RELAY_OPEN:
+                # NAT fallback (network/relay.py): a client that cannot
+                # reach us directly asked the server to splice. Dial the
+                # server back on a fresh connection and serve the client
+                # through it — end-to-end encrypted, server sees only
+                # ciphertext.
+                relay_id = str((msg.data or {}).get("id", ""))
+                if relay_id:
+                    self._spawn(self._serve_relay(relay_id))
             else:
                 logger.debug(f"provider: unhandled server key {msg.key!r}")
         raise ConnectionError("server closed connection")
+
+    async def _serve_relay(self, relay_id: str) -> None:
+        from symmetry_tpu.network.relay import RelayedConnection, await_ready
+
+        try:
+            conn = await self._transport.dial(self._server_address)
+            peer = await Peer.connect(
+                conn, self.identity, initiator=True,
+                expected_remote_key=self.config.server_key)
+            await peer.send(MessageKey.RELAY_ACCEPT, {"id": relay_id})
+            await await_ready(peer, relay_id)
+        except (ConnectionError, OSError, asyncio.TimeoutError) as exc:
+            logger.warning(f"relay {relay_id[:8]} setup failed: {exc}")
+            return
+        # From here the relayed channel is an ordinary inbound connection:
+        # the client's Noise handshake (with OUR key pinned) runs through
+        # it, maxConnections and session checks included.
+        await self._on_peer(RelayedConnection(peer, relay_id))
 
     async def _report_connections(self) -> None:
         if self._server_peer is not None and not self._server_peer.closed:

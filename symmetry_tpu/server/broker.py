@@ -57,6 +57,9 @@ class SymmetryServer:
         self._stale_after = stale_after_s
         self._listener: Listener | None = None
         self._provider_peers: dict[str, Peer] = {}  # peer_key hex → live peer
+        # relay splices (NAT fallback, network/relay.py): relayId →
+        # {"a": client peer, "b": provider peer | None (pre-accept)}
+        self._relays: dict[str, dict[str, Peer | None]] = {}
         self._tasks: set[asyncio.Task] = set()
         self._stopped = asyncio.Event()
 
@@ -103,8 +106,10 @@ class SymmetryServer:
             # detects departure via ping timeout; we do it immediately too).
             if self._provider_peers.get(peer_key) is peer:
                 del self._provider_peers[peer_key]
-                self.registry.set_offline(peer_key)
-                logger.info(f"provider {peer_key[:12]} disconnected")
+                self._provider_down(peer_key, "disconnected")
+            for relay_id, relay in list(self._relays.items()):
+                if relay["a"] is peer or relay["b"] is peer:
+                    await self._teardown_relay(relay_id, peer)
 
     async def _dispatch(self, peer: Peer, peer_key: str, key: str, data: Any) -> None:
         if key == MessageKey.CHALLENGE:
@@ -144,8 +149,7 @@ class SymmetryServer:
             )
         elif key == MessageKey.LEAVE:
             self._provider_peers.pop(peer_key, None)
-            self.registry.set_offline(peer_key)
-            logger.info(f"provider {peer_key[:12]} left gracefully")
+            self._provider_down(peer_key, "left gracefully")
         elif key == MessageKey.REQUEST_PROVIDER:
             await self._handle_request_provider(peer, peer_key, data or {})
         elif key == MessageKey.VERIFY_SESSION:
@@ -158,8 +162,26 @@ class SymmetryServer:
             await peer.send(MessageKey.PROVIDER_LIST, {"models": self.registry.list_models()})
         elif key == MessageKey.PING:
             await peer.send(MessageKey.PONG)
+        elif key == MessageKey.RELAY_CONNECT:
+            await self._handle_relay_connect(peer, peer_key, data or {})
+        elif key == MessageKey.RELAY_ACCEPT:
+            await self._handle_relay_accept(peer, data or {})
+        elif key == MessageKey.RELAY_DATA:
+            await self._handle_relay_data(peer, data or {})
+        elif key == MessageKey.RELAY_CLOSE:
+            await self._teardown_relay(str((data or {}).get("id", "")), peer)
         else:
             logger.debug(f"server: unhandled key {key!r} from {peer_key[:12]}")
+
+    def _provider_down(self, peer_key: str, reason: str) -> None:
+        """One path for every way a provider dies: deregister AND expire
+        its in-flight sessions, so clients whose stream broke re-request a
+        provider instead of retrying a dead assignment (round-2 verdict:
+        sessions of a dead provider just died with it)."""
+        self.registry.set_offline(peer_key)
+        n = self.registry.invalidate_sessions_for(peer_key)
+        logger.info(f"provider {peer_key[:12]} {reason}"
+                    + (f"; invalidated {n} session(s)" if n else ""))
 
     async def _handle_join(self, peer: Peer, peer_key: str, data: dict) -> None:
         config = data.get("config") or {}
@@ -184,7 +206,9 @@ class SymmetryServer:
 
     async def _handle_request_provider(self, peer: Peer, client_key: str, data: dict) -> None:
         model_name = data.get("modelName")
-        row = self.registry.select_provider(model_name)
+        exclude = tuple(str(k) for k in (data.get("excludePeers") or ())
+                        if isinstance(k, str))[:16]
+        row = self.registry.select_provider(model_name, exclude=exclude)
         if row is None:
             await peer.send(
                 MessageKey.PROVIDER_DETAILS,
@@ -220,6 +244,72 @@ class SymmetryServer:
             },
         )
 
+    # --- relay splice (NAT fallback; network/relay.py protocol notes) ---
+
+    async def _handle_relay_connect(self, peer: Peer, client_key: str,
+                                    data: dict) -> None:
+        provider_key = str(data.get("providerKey", ""))
+        control = self._provider_peers.get(provider_key)
+        if control is None or control.closed:
+            await peer.send(MessageKey.INFERENCE_ERROR,
+                            {"error": f"provider {provider_key[:12]} not "
+                                      f"connected; cannot relay"})
+            return
+        relay_id = str(uuid.uuid4())
+        self._relays[relay_id] = {"a": peer, "b": None}
+        try:
+            await control.send(MessageKey.RELAY_OPEN, {"id": relay_id})
+        except (ConnectionError, OSError):
+            del self._relays[relay_id]
+            await peer.send(MessageKey.INFERENCE_ERROR,
+                            {"error": "provider control channel failed"})
+            return
+        logger.debug(f"relay {relay_id[:8]} pending: {client_key[:12]} → "
+                     f"{provider_key[:12]}")
+
+    async def _handle_relay_accept(self, peer: Peer, data: dict) -> None:
+        relay_id = str(data.get("id", ""))
+        relay = self._relays.get(relay_id)
+        if relay is None or relay["b"] is not None:
+            await peer.send(MessageKey.RELAY_CLOSE, {"id": relay_id})
+            return
+        relay["b"] = peer
+        for end in (relay["a"], relay["b"]):
+            await end.send(MessageKey.RELAY_READY, {"id": relay_id})
+        logger.debug(f"relay {relay_id[:8]} spliced")
+
+    async def _handle_relay_data(self, peer: Peer, data: dict) -> None:
+        relay_id = str(data.get("id", ""))
+        relay = self._relays.get(relay_id)
+        if relay is None:
+            return
+        if peer is relay["a"]:
+            other = relay["b"]
+        elif peer is relay["b"]:
+            other = relay["a"]
+        else:
+            return  # third parties cannot inject into a splice
+        if other is None or other.closed:
+            await self._teardown_relay(relay_id, peer)
+            return
+        try:
+            # Forward verbatim — the frame is client↔provider Noise
+            # ciphertext this server cannot read.
+            await other.send(MessageKey.RELAY_DATA, data)
+        except (ConnectionError, OSError):
+            await self._teardown_relay(relay_id, peer)
+
+    async def _teardown_relay(self, relay_id: str, requester: Peer) -> None:
+        relay = self._relays.pop(relay_id, None)
+        if relay is None:
+            return
+        for end in (relay["a"], relay["b"]):
+            if end is not None and end is not requester and not end.closed:
+                try:
+                    await end.send(MessageKey.RELAY_CLOSE, {"id": relay_id})
+                except (ConnectionError, OSError):
+                    pass
+
     # --- liveness (reference: server→provider ping, src/provider.ts:124-126) ---
 
     async def _liveness_loop(self) -> None:
@@ -232,11 +322,10 @@ class SymmetryServer:
                     await peer.send(MessageKey.PING)
                 except (ConnectionError, OSError):
                     self._provider_peers.pop(peer_key, None)
-                    self.registry.set_offline(peer_key)
+                    self._provider_down(peer_key, "ping failed")
             for peer_key in self.registry.stale_providers(self._stale_after):
-                logger.warning(f"provider {peer_key[:12]} stale; marking offline")
                 self._provider_peers.pop(peer_key, None)
-                self.registry.set_offline(peer_key)
+                self._provider_down(peer_key, "stale")
 
 
 async def main() -> None:

@@ -180,18 +180,26 @@ class Registry:
         ).fetchone()
         return _row_to_provider(row) if row else None
 
-    def select_provider(self, model_name: str | None = None) -> ProviderRow | None:
-        """Model-matched, online, capacity-available, least-loaded provider."""
+    def select_provider(self, model_name: str | None = None,
+                        exclude: tuple[str, ...] = ()) -> ProviderRow | None:
+        """Model-matched, online, capacity-available, least-loaded provider.
+
+        `exclude` drops specific peer keys — clients re-requesting after a
+        provider died mid-stream must not be handed the same one back."""
         query = (
             "SELECT * FROM peers WHERE online = 1 AND public = 1"
             " AND connections < max_connections"
         )
-        params: tuple = ()
+        params: list = []
         if model_name:
             query += " AND model_name = ?"
-            params = (model_name,)
+            params.append(model_name)
+        if exclude:
+            query += (" AND peer_key NOT IN ("
+                      + ",".join("?" * len(exclude)) + ")")
+            params.extend(exclude)
         query += " ORDER BY CAST(connections AS REAL) / max_connections ASC, last_seen DESC LIMIT 1"
-        row = self._db.execute(query, params).fetchone()
+        row = self._db.execute(query, tuple(params)).fetchone()
         return _row_to_provider(row) if row else None
 
     def list_providers(self, online_only: bool = True) -> list[ProviderRow]:
@@ -228,6 +236,19 @@ class Registry:
             (session_id, peer_key, client_key, model_name, now, now + ttl_s),
         )
         self._db.commit()
+
+    def invalidate_sessions_for(self, peer_key: str) -> int:
+        """Expire every incomplete session assigned to a dead provider so
+        verifySession reports them invalid and clients re-request
+        (SURVEY §5.3: request requeue on provider loss). Returns the count
+        invalidated."""
+        cur = self._db.execute(
+            "UPDATE sessions SET expires_at = 0"
+            " WHERE peer_key = ? AND completed = 0 AND expires_at > ?",
+            (peer_key, time.time()),
+        )
+        self._db.commit()
+        return cur.rowcount
 
     def session_valid(self, session_id: str) -> bool:
         row = self._db.execute(

@@ -74,8 +74,44 @@ def load_library() -> ctypes.CDLL:
                             ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
     lib.us_close.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.us_destroy.argtypes = [ctypes.c_void_p]
+    lib.us_send_raw.restype = ctypes.c_int
+    lib.us_send_raw.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.us_recv_raw.restype = ctypes.c_int
+    lib.us_recv_raw.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int, ctypes.c_char_p,
+                                ctypes.POINTER(ctypes.c_int), ctypes.c_int]
     _lib = lib
     return lib
+
+
+class RawChannel:
+    """Connectionless datagrams over a udpstream ctx's socket (F_RAW).
+
+    The NAT-punch side channel: packets leave from the SAME (addr, port)
+    the stream protocol uses, so a raw datagram opens exactly the NAT
+    mapping a later us_dial / inbound SYN will traverse."""
+
+    def __init__(self, ctx: int) -> None:
+        self._lib = load_library()
+        self._ctx = ctx
+
+    def send(self, host: str, port: int, payload: bytes) -> bool:
+        return bool(self._lib.us_send_raw(
+            self._ctx, host.encode(), port, payload, len(payload)))
+
+    async def recv(self, timeout_s: float
+                   ) -> tuple[bytes, str, int] | None:
+        """One raw datagram as (payload, host, port), or None on timeout."""
+        buf = ctypes.create_string_buffer(2048)
+        ip = ctypes.create_string_buffer(16)
+        port = ctypes.c_int(0)
+        n = await asyncio.to_thread(
+            self._lib.us_recv_raw, self._ctx, buf, len(buf), ip,
+            ctypes.byref(port), int(timeout_s * 1000))
+        if n < 0:
+            return None
+        return buf.raw[:n], ip.value.decode(), port.value
 
 
 def _parse(address: str) -> tuple[str, int]:
@@ -146,6 +182,12 @@ class UdpListener(Listener):
     def address(self) -> str:
         return f"udp://{self._host}:{self._lib.us_port(self._ctx)}"
 
+    def raw_channel(self) -> RawChannel:
+        """NAT-punch side channel on the LISTENER socket: raw datagrams
+        from the same (addr, port) inbound streams arrive on, which is the
+        port whose reflexive mapping the rendezvous must learn."""
+        return RawChannel(self._ctx)
+
     async def _accept_loop(self) -> None:
         while not self._closing:
             key = await asyncio.to_thread(self._lib.us_accept, self._ctx, 500)
@@ -181,14 +223,24 @@ class UdpTransport(Transport):
             raise OSError(f"cannot bind udp socket at {address}")
         return UdpListener(ctx, host, handler)
 
-    async def dial(self, address: str) -> Connection:
-        host, port = _parse(address)
+    def _ensure_dial_ctx(self) -> int:
         if self._dial_ctx is None:
             self._dial_ctx = self._lib.us_create(b"0.0.0.0", 0)
             if not self._dial_ctx:
                 raise OSError("cannot create udp dial socket")
+        return self._dial_ctx
+
+    def dial_raw_channel(self) -> RawChannel:
+        """Raw datagrams from the DIAL socket: a punch sent here opens the
+        pinhole that this transport's subsequent dial() will traverse
+        (same ctx, same port — network/natpunch.py)."""
+        return RawChannel(self._ensure_dial_ctx())
+
+    async def dial(self, address: str) -> Connection:
+        host, port = _parse(address)
+        ctx = self._ensure_dial_ctx()
         key = await asyncio.to_thread(
-            self._lib.us_dial, self._dial_ctx, host.encode(), port, 5000)
+            self._lib.us_dial, ctx, host.encode(), port, 5000)
         if not key:
             raise ConnectionError(f"udp dial to {address} failed")
-        return UdpConnection(self._dial_ctx, key, address)
+        return UdpConnection(ctx, key, address)

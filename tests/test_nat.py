@@ -1,0 +1,198 @@
+"""NAT traversal: UDP hole punching (network/natpunch.py) and the
+server-spliced relay fallback (network/relay.py).
+
+The reference gets both legs from hyperdht (holepunching + relaying,
+SURVEY §2.2). No real NAT exists on loopback (and this box has no
+nftables to build one), so these tests verify the full traversal
+CHOREOGRAPHY — reflexive-address learning, invite delivery, simultaneous
+punch bursts, dialing through the punched path, and ciphertext-only
+relay splicing — over real UDP/memory transports.
+"""
+
+import asyncio
+
+import pytest
+
+from symmetry_tpu.client.client import ClientError, ProviderDetails, SymmetryClient
+from symmetry_tpu.identity import Identity
+from symmetry_tpu.network.natpunch import (
+    PunchRendezvous,
+    punch_dial,
+    unwrap_raw,
+    wrap_raw,
+)
+from symmetry_tpu.provider.config import ConfigManager
+from symmetry_tpu.provider.provider import SymmetryProvider
+from symmetry_tpu.server.broker import SymmetryServer
+from symmetry_tpu.transport.memory import MemoryTransport
+
+
+def run(coro, timeout=60):
+    return asyncio.new_event_loop().run_until_complete(
+        asyncio.wait_for(coro, timeout))
+
+
+class TestRawFraming:
+    def test_roundtrip(self):
+        assert unwrap_raw(wrap_raw(b"hello")) == b"hello"
+
+    def test_rejects_garbage(self):
+        assert unwrap_raw(b"\xff\xff\xff\xffAAAA") is None
+        assert unwrap_raw(b"") is None
+
+
+def _udp_available():
+    try:
+        from symmetry_tpu.transport.udp import load_library
+
+        load_library()
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+@pytest.mark.skipif(not _udp_available(), reason="udpstream lib unavailable")
+class TestHolePunch:
+    def test_punch_then_stream(self):
+        """Full choreography: provider registers its reflexive address,
+        client punches through the rendezvous, then opens a real
+        udpstream connection on the punched path and exchanges frames."""
+        async def main():
+            from symmetry_tpu.network.natpunch import ProviderPuncher
+            from symmetry_tpu.transport.udp import UdpTransport
+
+            rdv = PunchRendezvous()
+            await rdv.start("127.0.0.1", 0)
+
+            got = asyncio.Queue()
+
+            async def echo_handler(conn):
+                frame = await conn.recv()
+                await got.put(frame)
+                await conn.send(b"pong:" + (frame or b""))
+
+            provider_t = UdpTransport()
+            listener = await provider_t.listen("udp://127.0.0.1:0",
+                                               echo_handler)
+            puncher = ProviderPuncher(listener.raw_channel(),
+                                      ("127.0.0.1", rdv.port), "prov-key")
+            puncher.start()
+            await asyncio.sleep(0.3)  # registration datagram lands
+
+            client_t = UdpTransport()
+            address = await punch_dial(client_t, ("127.0.0.1", rdv.port),
+                                       "prov-key")
+            assert address == listener.address
+            assert puncher.punched == 1  # the invite produced a burst
+
+            conn = await client_t.dial(address)
+            await conn.send(b"ping")
+            assert await conn.recv() == b"pong:ping"
+            await conn.close()
+
+            await puncher.stop()
+            await listener.close()
+            await rdv.stop()
+
+        run(main())
+
+    def test_unknown_key_fails_fast(self):
+        async def main():
+            from symmetry_tpu.transport.udp import UdpTransport
+
+            rdv = PunchRendezvous()
+            await rdv.start("127.0.0.1", 0)
+            with pytest.raises(ConnectionError, match="does not know"):
+                await punch_dial(UdpTransport(), ("127.0.0.1", rdv.port),
+                                 "nobody", timeout_s=3.0)
+            await rdv.stop()
+
+        run(main())
+
+
+class TestRelayFallback:
+    def test_chat_through_relay_when_direct_dial_fails(self):
+        """Provider reachable ONLY via the server splice (its advertised
+        address is bogus — the behind-NAT case): the chat must complete
+        through the relay, with the provider's key still pinned end to
+        end."""
+        async def main():
+            hub = MemoryTransport()
+            server_ident = Identity.from_name("relay-server")
+            server = SymmetryServer(server_ident, hub, ping_interval_s=30.0)
+            await server.start("mem://server")
+
+            cfg = ConfigManager(config={
+                "name": "relay-prov", "public": True,
+                "serverKey": server_ident.public_hex,
+                "modelName": "tiny:relay", "apiProvider": "echo",
+                "dataCollectionEnabled": False,
+            })
+            prov_ident = Identity.from_name("relay-prov")
+            provider = SymmetryProvider(cfg, transport=hub,
+                                        identity=prov_ident,
+                                        server_address="mem://server")
+            await provider.start("mem://relay-prov")
+            await provider.wait_registered()
+
+            client = SymmetryClient(Identity.from_name("relay-cli"), hub)
+            details = await client.request_provider(
+                "mem://server", server_ident.public_key, "tiny:relay")
+            # Simulate NAT: the advertised address is undialable.
+            details = ProviderDetails(
+                peer_key=details.peer_key, address="mem://unreachable",
+                model_name=details.model_name,
+                session_token=details.session_token,
+                session_id=details.session_id)
+
+            session = await client.connect(
+                details,
+                relay_via=("mem://server", server_ident.public_key))
+            text = await session.chat_text(
+                [{"role": "user", "content": "through the wall"}])
+            assert text
+            await session.close()
+            await provider.stop(drain_timeout_s=2)
+            await server.stop()
+
+        run(main())
+
+    def test_relay_refused_for_unknown_provider(self):
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("relay-server2")
+            server = SymmetryServer(ident, hub, ping_interval_s=30.0)
+            await server.start("mem://server")
+            client = SymmetryClient(Identity.from_name("relay-cli2"), hub)
+            with pytest.raises(ClientError, match="cannot relay"):
+                await client.connect_relay(
+                    "mem://server", ident.public_key, "ab" * 32)
+            await server.stop()
+
+        run(main())
+
+    def test_relay_cannot_be_hijacked_by_third_party(self):
+        """A third peer must not be able to impersonate the provider on a
+        pending relay: connecting and sending relayAccept for someone
+        else's relayId gets relayClose, and the end-to-end pinning means
+        even a successful splice to the wrong node fails the handshake."""
+        async def main():
+            from symmetry_tpu.network.peer import Peer
+            from symmetry_tpu.protocol.keys import MessageKey
+
+            hub = MemoryTransport()
+            ident = Identity.from_name("relay-server3")
+            server = SymmetryServer(ident, hub, ping_interval_s=30.0)
+            await server.start("mem://server")
+
+            evil = Identity.from_name("relay-evil")
+            conn = await hub.dial("mem://server")
+            peer = await Peer.connect(conn, evil, initiator=True,
+                                      expected_remote_key=ident.public_key)
+            await peer.send(MessageKey.RELAY_ACCEPT, {"id": "not-a-relay"})
+            msg = await asyncio.wait_for(peer.recv(), 5)
+            assert msg is not None and msg.key == MessageKey.RELAY_CLOSE
+            await peer.close()
+            await server.stop()
+
+        run(main())

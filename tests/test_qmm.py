@@ -1,0 +1,73 @@
+"""w8a8 native-int8 matmul kernel (ops/qmm.py), Pallas interpret mode.
+
+The integer part of the kernel is exact: s8×s8 products accumulated in
+s32 must equal the same integer matmul computed in numpy, so the kernel
+is tested against that bit-exact reference (scales are f32 — compared
+with float tolerance), and separately against the dense matmul within
+the activation-quantization error bound.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symmetry_tpu.ops.qmm import (
+    MIN_ROWS,
+    quantize_rows,
+    supports,
+    w8a8_matmul,
+)
+from symmetry_tpu.ops.quant import quantize
+
+
+@pytest.fixture(scope="module")
+def case():
+    key = jax.random.key(0)
+    kx, kw = jax.random.split(key)
+    x = jax.random.normal(kx, (64, 128), jnp.float32)
+    w = jax.random.normal(kw, (128, 256), jnp.float32) * 0.05
+    return x, quantize(w), w
+
+
+class TestW8A8:
+    def test_matches_integer_reference(self, case):
+        x, wq, _ = case
+        got = w8a8_matmul(x, wq.q, wq.scale, interpret=True)
+
+        xq, xs = quantize_rows(x)
+        acc = (np.asarray(xq, np.int32) @ np.asarray(wq.q, np.int32))
+        want = acc.astype(np.float32) * np.asarray(xs) * np.asarray(
+            wq.scale)[None, :]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_close_to_dense(self, case):
+        x, wq, w = case
+        got = np.asarray(w8a8_matmul(x, wq.q, wq.scale, interpret=True))
+        want = np.asarray(x) @ np.asarray(w)
+        # both weight and activation are 8-bit: ~1% relative on a
+        # 128-deep contraction
+        err = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+        assert err < 0.02, err
+
+    def test_out_dtype(self, case):
+        x, wq, _ = case
+        got = w8a8_matmul(x.astype(jnp.bfloat16), wq.q, wq.scale,
+                          interpret=True)
+        assert got.dtype == jnp.bfloat16
+
+    def test_block_fallback_shapes(self):
+        """Shapes needing the smaller block candidates still tile."""
+        x = jnp.ones((MIN_ROWS, 192), jnp.float32)  # K=192 -> bk=64
+        w = quantize(jnp.ones((192, 320), jnp.float32))  # N=320 -> bn=64
+        got = w8a8_matmul(x, w.q, w.scale, interpret=True)
+        assert got.shape == (MIN_ROWS, 320)
+
+    def test_supports_gate(self):
+        assert supports(128, 4096, 14336, "tpu")
+        assert supports(128, 4096, 128256, "tpu")  # llama3 lm_head
+        assert not supports(128, 4096, 14336, "cpu")
+        assert not supports(MIN_ROWS - 1, 4096, 14336, "tpu")
+        assert not supports(128, 100, 14336, "tpu")   # K untileable
+        assert not supports(128, 4096, 258, "tpu")    # N untileable

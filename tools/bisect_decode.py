@@ -1,0 +1,125 @@
+"""Ablate decode-step components to locate the per-slot compute overhead.
+
+profile_decode shows ~7.6 ms of the B=128 step scales with batch but not
+with KV or weight traffic (trunk: 16.9 ms at B=1, 24.5 ms at B=128 with a
+64-entry cache). This monkeypatches one component at a time out of the
+trunk and re-times it; the delta attributes the overhead.
+
+Run: python tools/bisect_decode.py [--slots 128 --max-seq 640]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bench_util import sync  # noqa: E402
+
+
+def time_trunk(cfg, params, B, T, n=15):
+    import time
+
+    from symmetry_tpu.models import llama
+
+    cache = llama.init_cache(cfg, B, T, jnp.bfloat16, quantized=True)
+    cache = cache._replace(lengths=jnp.full((B,), T - (n + 4), jnp.int32))
+    tok = jnp.ones((B, 1), jnp.int32)
+    trunk = jax.jit(lambda p, t, c: llama.forward_hidden(p, cfg, t, c),
+                    donate_argnums=(2,))
+    for _ in range(3):
+        h, cache = trunk(params, tok, cache)
+    sync(h)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        h, cache = trunk(params, tok, cache)
+    sync(h)
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="llama3-8b")
+    ap.add_argument("--slots", type=int, default=128)
+    ap.add_argument("--max-seq", type=int, default=640)
+    args = ap.parse_args()
+
+    from symmetry_tpu.models import llama
+    from symmetry_tpu.ops import quant
+
+    cfg = llama.preset(args.preset)
+    B, T = args.slots, args.max_seq
+    params = llama.init_params(cfg, jax.random.key(0), jnp.bfloat16,
+                               quantize=True)
+
+    if os.environ.get("BISECT_W8A8"):
+        ab_w8a8(cfg, params, B, T)
+        return
+
+    base = time_trunk(cfg, params, B, T)
+    print(f"baseline:        {base:7.2f} ms", flush=True)
+
+    # --- no rope (identity)
+    real_rope = llama.apply_rope
+    llama.apply_rope = lambda x, positions, theta=0.0: x
+    ms = time_trunk(cfg, params, B, T)
+    llama.apply_rope = real_rope
+    print(f"rope ablated:    {ms:7.2f} ms  (rope cost ~{base - ms:5.2f})",
+          flush=True)
+
+    # --- no kv quantize (write zeros: kills abs/round/clip chain)
+    real_qkv = quant.quantize_kv
+
+    def fake_qkv(x):
+        q = jnp.zeros(x.shape, jnp.int8)
+        s = jnp.ones(x.shape[:-1], jnp.float32)
+        return q, s
+
+    llama_quant = sys.modules["symmetry_tpu.models.llama"]
+    # _layer imports quantize_kv lazily from ops.quant, so patch the module
+    quant.quantize_kv = fake_qkv
+    ms = time_trunk(cfg, params, B, T)
+    quant.quantize_kv = real_qkv
+    print(f"kvquant ablated: {ms:7.2f} ms  (quantize_kv ~{base - ms:5.2f})",
+          flush=True)
+
+    # --- attention bypassed entirely (q passes through)
+    real_attn = llama.gqa_attention
+
+    def fake_attn(q, k, v, positions, kv_length, **kw):
+        return q
+
+    llama.gqa_attention = fake_attn
+    ms = time_trunk(cfg, params, B, T)
+    llama.gqa_attention = real_attn
+    print(f"attn ablated:    {ms:7.2f} ms  (attention ~{base - ms:5.2f})",
+          flush=True)
+
+    # --- rms_norm ablated
+    real_norm = llama.rms_norm
+    llama.rms_norm = lambda x, w, eps: x
+    ms = time_trunk(cfg, params, B, T)
+    llama.rms_norm = real_norm
+    print(f"norm ablated:    {ms:7.2f} ms  (rms_norm ~{base - ms:5.2f})",
+          flush=True)
+
+
+def ab_w8a8(cfg, params, B, T):
+    """In-trunk A/B of the w8a8 Pallas routing (ops/qmm.py)."""
+    from symmetry_tpu.ops import qmm
+
+    ms_on = time_trunk(cfg, params, B, T)
+    print(f"w8a8 kernel ON:  {ms_on:7.2f} ms", flush=True)
+    real = qmm.supports
+    qmm.supports = lambda *a, **k: False
+    ms_off = time_trunk(cfg, params, B, T)
+    qmm.supports = real
+    print(f"w8a8 kernel OFF: {ms_off:7.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

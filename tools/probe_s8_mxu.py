@@ -1,0 +1,152 @@
+"""Probe: can a Pallas kernel drive the MXU with native s8 x s8 matmuls?
+
+XLA's mixed/int8 dot_generals all measure ~270-480 GB/s effective — the
+s8->float convert throughput, not HBM bandwidth (tools/microbench_matmul).
+If Mosaic emits native int8 MXU ops, a hand kernel should stream weights
+at ~819 GB/s with s32 accumulation and no convert. This decides whether a
+quantized-matmul kernel is worth building into the decode path.
+
+Run: python tools/probe_s8_mxu.py
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bench_util import timeit  # noqa: E402
+
+
+def matmul_kernel(x_ref, w_ref, o_ref, acc_scr, *, n_k: int, out_dtype):
+    """One [M, bk] x [bk, bn] tile product per grid step, K innermost."""
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    acc_scr[:] += jax.lax.dot_general(
+        x_ref[:], w_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=acc_scr.dtype)
+
+    @pl.when(k == n_k - 1)
+    def _():
+        o_ref[:] = acc_scr[:].astype(out_dtype)
+
+
+def pallas_matmul(x, w, *, bn=512, bk=1024, acc_dtype=jnp.int32):
+    M, K = x.shape
+    K2, N = w.shape
+    n_k = K // bk
+    grid = (N // bn, n_k)
+    return pl.pallas_call(
+        functools.partial(matmul_kernel, n_k=n_k, out_dtype=jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((M, bk), lambda n, k: (0, k)),
+            pl.BlockSpec((bk, bn), lambda n, k: (k, n)),
+        ],
+        out_specs=pl.BlockSpec((M, bn), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((M, N), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((M, bn), acc_dtype)],
+    )(x, w)
+
+
+def main():
+    B, E, H = 128, 4096, 4 * 14336
+    ITERS = 20
+
+    xq = jnp.ones((B, E), jnp.int8)
+    wq = jnp.ones((E, H), jnp.int8)
+    xb = jnp.ones((B, E), jnp.bfloat16)
+    wb = jnp.ones((E, H), jnp.bfloat16)
+
+    def loop(body):
+        """Carry-DEPENDENT input: without it XLA hoists the loop-invariant
+        matmul out of the scan and the timing is fiction (observed: "bf16"
+        at 905 GB/s, above HBM peak)."""
+        def run(x, w):
+            def step(carry, _):
+                y = body(carry, w)
+                nxt = (x ^ (y[:, :x.shape[1]] & 1).astype(jnp.int8)
+                       if x.dtype == jnp.int8
+                       else x + (y[:, :x.shape[1]] * 1e-9).astype(x.dtype))
+                return nxt, ()
+            out, _ = jax.lax.scan(step, x, None, length=ITERS)
+            return out
+        return jax.jit(run)
+
+    def report(name, ms, nbytes):
+        gbs = nbytes * ITERS / (ms / 1e3) / 1e9
+        print(f"{name:18s} {ms:8.2f} ms/loop  {gbs:7.1f} GB/s", flush=True)
+
+    for bn, bk in ((256, 512), (512, 1024), (256, 512), (512, 1024),
+                   (256, 512), (512, 1024)):
+        try:
+            f = loop(lambda x, w, bn=bn, bk=bk: pallas_matmul(
+                x, w, bn=bn, bk=bk))
+            report(f"s8s8 bn{bn} bk{bk}", timeit(f, xq, wq, n=10), E * H)
+        except Exception as exc:  # noqa: BLE001
+            print(f"s8s8 bn{bn} bk{bk} failed: "
+                  f"{type(exc).__name__}: {exc}"[:300], flush=True)
+
+    try:
+        f = loop(lambda x, w: pallas_matmul(
+            x, w, acc_dtype=jnp.float32))
+        report("pallas-bf16", timeit(f, xb, wb, n=10), 2 * E * H)
+    except Exception as exc:  # noqa: BLE001
+        print(f"pallas-bf16 failed: {type(exc).__name__}: {exc}"[:500],
+              flush=True)
+
+    # mixed: s8 weight converted in-kernel (Mosaic's convert, VMEM-resident)
+    def mixed_kernel(x_ref, w_ref, o_ref, acc_scr, *, n_k):
+        k = pl.program_id(1)
+
+        @pl.when(k == 0)
+        def _():
+            acc_scr[:] = jnp.zeros_like(acc_scr)
+
+        acc_scr[:] += jax.lax.dot_general(
+            x_ref[:], w_ref[:].astype(jnp.bfloat16),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(k == n_k - 1)
+        def _():
+            o_ref[:] = acc_scr[:]
+
+    def mixed(x, w, bn=512, bk=1024):
+        M, K = x.shape
+        _, N = w.shape
+        n_k = K // bk
+        return pl.pallas_call(
+            functools.partial(mixed_kernel, n_k=n_k),
+            grid=(N // bn, n_k),
+            in_specs=[
+                pl.BlockSpec((M, bk), lambda n, k: (0, k)),
+                pl.BlockSpec((bk, bn), lambda n, k: (k, n)),
+            ],
+            out_specs=pl.BlockSpec((M, bn), lambda n, k: (0, n)),
+            out_shape=jax.ShapeDtypeStruct((M, N), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((M, bn), jnp.float32)],
+        )(x, w)
+
+    try:
+        f = loop(mixed)
+        report("pallas-mixed", timeit(f, xb, wq, n=10), E * H)
+    except Exception as exc:  # noqa: BLE001
+        print(f"pallas-mixed failed: {type(exc).__name__}: {exc}"[:500],
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
