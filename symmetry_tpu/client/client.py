@@ -32,6 +32,13 @@ class ClientError(RuntimeError):
     pass
 
 
+class ProviderGoneError(ClientError):
+    """The assigned provider died or closed mid-stream — the retryable
+    failure class. Request-level errors (bad messages, invalid session)
+    stay plain ClientError: replaying those on another provider would
+    burn the pool on a deterministically-bad request."""
+
+
 @dataclass(slots=True)
 class ProviderDetails:
     peer_key: str
@@ -59,6 +66,9 @@ class ProviderSession:
     def __init__(self, peer: Peer, details: ProviderDetails) -> None:
         self._peer = peer
         self._details = details
+        # Usage of the last completed chat, from inferenceEnded:
+        # {"tokens": N, "chunks": M} (engine backends count exact tokens).
+        self.last_usage: dict | None = None
         # The wire protocol carries no request ids (reference parity:
         # one in-flight inference per peer, src/provider.ts:195), so the
         # session SERIALIZES its requests — concurrent chat()/stats()
@@ -107,7 +117,7 @@ class ProviderSession:
                     msg = await self._peer.recv()
                     if msg is None:
                         ended = True  # wire gone; nothing left to misroute
-                        raise ClientError(
+                        raise ProviderGoneError(
                             "provider closed connection mid-stream")
                     if msg.key == MessageKey.INFERENCE:
                         # stream-start marker; carries the backend dialect
@@ -122,6 +132,7 @@ class ProviderSession:
                             yield delta
                     elif msg.key == MessageKey.INFERENCE_ENDED:
                         ended = True
+                        self.last_usage = msg.data or {}
                         return
                     elif msg.key == MessageKey.INFERENCE_ERROR:
                         ended = True
@@ -266,7 +277,10 @@ class SymmetryClient:
                 yield ChatRestart(attempt=attempt,
                                   provider_key=details.peer_key)
             try:
-                session = await self.connect(details)
+                # relay_via: a NAT-only provider (direct dial fails, the
+                # server splice works) is serviceable, not dead
+                session = await self.connect(
+                    details, relay_via=(server_address, server_key))
             except (ClientError, ConnectionError, OSError) as exc:
                 last_exc = exc
                 if details.peer_key:
@@ -276,7 +290,11 @@ class SymmetryClient:
                 async for delta in session.chat(messages, **chat_kw):
                     yield delta
                 return
-            except (ClientError, ConnectionError, OSError) as exc:
+            except (ProviderGoneError, ConnectionError, OSError) as exc:
+                # Only provider-death failures fail over. A request-level
+                # ClientError (bad messages, rejected params) propagates:
+                # replaying it elsewhere would fail identically while
+                # blacklisting healthy providers.
                 last_exc = exc
                 if details.peer_key:
                     dead.append(details.peer_key)
@@ -335,7 +353,7 @@ class SymmetryClient:
         """Open a server-spliced relay channel to a provider (the Noise
         handshake with the provider then runs THROUGH it — the server
         carries only ciphertext)."""
-        from symmetry_tpu.network.relay import RelayedConnection
+        from symmetry_tpu.network.relay import RelayedConnection, await_ready
 
         conn = await self._transport.dial(server_address)
         server_peer = await Peer.connect(
@@ -344,29 +362,18 @@ class SymmetryClient:
         try:
             await server_peer.send(MessageKey.RELAY_CONNECT,
                                    {"providerKey": provider_key_hex})
-            # the relayId arrives in relayReady; connect waits for it
-            relay_id = await self._await_relay_ready(server_peer)
+            # the relayId arrives in relayReady (shared wait helper —
+            # one refusal-handling implementation for both roles)
+            relay_id = await await_ready(server_peer)
+        except ConnectionError as exc:
+            await server_peer.close()
+            raise ClientError(str(exc)) from exc
         except BaseException:
             # failed setup must not leak the dialed server connection —
             # failover retries would accumulate sockets
             await server_peer.close()
             raise
         return RelayedConnection(server_peer, relay_id)
-
-    @staticmethod
-    async def _await_relay_ready(server_peer: Peer,
-                                 timeout: float = 10.0) -> str:
-        async def _wait() -> str:
-            async for msg in server_peer:
-                if msg.key == MessageKey.RELAY_READY:
-                    return str((msg.data or {}).get("id", ""))
-                if msg.key in (MessageKey.RELAY_CLOSE,
-                               MessageKey.INFERENCE_ERROR):
-                    raise ClientError(
-                        (msg.data or {}).get("error", "relay refused"))
-            raise ClientError("server closed during relay setup")
-
-        return await asyncio.wait_for(_wait(), timeout)
 
     async def connect_direct(self, address: str, provider_key: bytes | None = None,
                              model_name: str = "") -> ProviderSession:

@@ -405,7 +405,11 @@ class InferenceEngine:
             f"bucket ({self.prefill_buckets[-1]})")
 
     # Coalesced-prefill batch sizes: one compiled prefill program per
-    # (batch, bucket) pair, so batch is bucketed too.
+    # (batch, bucket) pair, so batch is bucketed too. Batch 8 was tried
+    # for admission bursts and OOM'd the llama3-8b@128-slot bench config
+    # (the transient prefill buffers tipped a ~15.6 GB HBM budget) —
+    # burst TTFT is instead bounded by the admission cap + chunked
+    # prefill (engine/scheduler.py).
     PREFILL_BATCHES = (1, 2, 4)
 
     def prefill_and_insert(self, slot: int, prompt_ids: list[int],
@@ -663,7 +667,6 @@ class InferenceEngine:
             raise EngineError(f"unsupported tpu.dtype {tpu_cfg.dtype!r}; "
                               f"expected one of {sorted(dtypes)}")
         dtype = dtypes[tpu_cfg.dtype]
-        tokenizer = get_tokenizer(tpu_cfg.tokenizer_path)
 
         if tpu_cfg.quantization not in (None, "int8"):
             raise EngineError(
@@ -708,6 +711,10 @@ class InferenceEngine:
             else:
                 params = init_params(config, jax.random.key(0), dtype,
                                      quantize=quant)
+        # Tokenizer after config resolution: the byte fallback must span
+        # the MODEL's vocab or sampled ids stream as silence (tokenizer.py).
+        tokenizer = get_tokenizer(tpu_cfg.tokenizer_path,
+                                  vocab_size=config.vocab_size)
         return cls(
             config, params, tokenizer, mesh=mesh,
             max_slots=tpu_cfg.max_batch_size,

@@ -74,21 +74,30 @@ class StreamDecoder:
 
 
 class ByteTokenizer(Tokenizer):
-    """ids 0-255 = raw bytes; 256 = BOS; 257 = EOS. vocab 258 (fits `tiny`)."""
+    """ids 0-255 = raw bytes; 256 = BOS; 257 = EOS; ids >= 258 decode to
+    byte (id % 256). vocab defaults to 258 (fits `tiny`).
+
+    The modulo mapping matters for models whose vocab exceeds 258 served
+    WITHOUT tokenizer files (benchmarks, smoke runs): a 128k-vocab model
+    samples ids >= 258 essentially always, and silently dropping them
+    (the old behavior) turns the entire stream into empty text deltas —
+    round 3's e2e bench measured exactly that silence (every client's
+    TTFT == wall time) before this fix. Construct with the model's
+    vocab_size so sampled ids are meaningful byte text."""
 
     BOS, EOS = 256, 257
 
-    def __init__(self) -> None:
+    def __init__(self, vocab_size: int = 258) -> None:
         self.bos_id = self.BOS
         self.eos_ids = frozenset({self.EOS})
-        self.vocab_size = 258
+        self.vocab_size = max(int(vocab_size), 258)
 
     def encode(self, text: str, *, bos: bool = True) -> list[int]:
         ids = list(text.encode("utf-8"))
         return ([self.BOS] + ids) if bos else ids
 
     def decode(self, ids: list[int]) -> str:
-        data = bytes(i for i in ids if i < 256)
+        data = bytes(i % 256 for i in ids if i not in (self.BOS, self.EOS))
         return data.decode("utf-8", errors="replace")
 
     def apply_chat_template(self, messages: list[dict[str, str]]) -> list[int]:
@@ -133,7 +142,10 @@ class HFTokenizer(Tokenizer):
         return self.encode("".join(parts), bos=True)
 
 
-def get_tokenizer(tokenizer_path: str | None) -> Tokenizer:
+def get_tokenizer(tokenizer_path: str | None,
+                  vocab_size: int = 258) -> Tokenizer:
+    """tokenizer_path -> HFTokenizer; else a ByteTokenizer sized to the
+    MODEL's vocab (so sampled ids stream as text, see ByteTokenizer)."""
     if tokenizer_path:
         return HFTokenizer(tokenizer_path)
-    return ByteTokenizer()
+    return ByteTokenizer(vocab_size)

@@ -70,6 +70,9 @@ class MoEConfig(ModelConfig):
 
     num_experts: int = 8
     num_experts_per_tok: int = 2
+    # Prefill token-dispatch capacity (models/moe.py); None = the module
+    # default. Set >= num_experts / num_experts_per_tok for zero drops.
+    moe_capacity_factor: float | None = None
 
 
 # Named presets; sizes from the public HF configs of each model family.
@@ -373,6 +376,7 @@ def _layer(
                 q[:, 0], cache.k, cache.v, layer, kv_valid,
                 k_scale=cache.k_scale if cache.quantized else None,
                 v_scale=cache.v_scale if cache.quantized else None,
+                window=config.sliding_window,
                 interpret=jax.default_backend() != "tpu")[:, None]
         else:
             def at_layer(arr):

@@ -88,12 +88,31 @@ def resolve_endpoint(addr: tuple[str, int]) -> tuple[str, int]:
             f"cannot resolve rendezvous host {host!r}: {exc}") from exc
 
 
+MAX_REGISTRY = 4096
+REGISTER_SKEW_S = 90.0
+
+
+def _register_sig_msg(key_hex: str, ts: float) -> bytes:
+    return json.dumps(["punch-register", key_hex, round(ts, 3)],
+                      sort_keys=True, separators=(",", ":")).encode()
+
+
 class PunchRendezvous:
     """The server-side endpoint: learns reflexive addresses, brokers
-    punches. Plain asyncio UDP speaking F_RAW frames."""
+    punches. Plain asyncio UDP speaking F_RAW frames.
+
+    Registrations are SIGNED with the provider's Ed25519 key (the same
+    identity the data plane pins): provider keys are public, so an
+    unsigned rendezvous would let anyone overwrite a provider's
+    reflexive address and deny NAT traversal to it — the same spoofing
+    class the DHT's signed announces close."""
 
     def __init__(self) -> None:
         self._registry: dict[str, tuple[tuple[str, int], float]] = {}
+        # replay fence: last accepted signed ts per key — a captured
+        # register datagram re-sent from another address must not move
+        # the record
+        self._last_ts: dict[str, float] = {}
         self._transport: asyncio.DatagramTransport | None = None
 
     async def start(self, host: str = "0.0.0.0", port: int = 0) -> None:
@@ -130,9 +149,21 @@ class PunchRendezvous:
         op = msg.get("op")
         if op == "register":
             key = str(msg.get("key", ""))[:128]
-            if key:
-                self._registry[key] = (addr, time.monotonic())
-                self._send(_msg("registered", addr=list(addr)), addr)
+            if key and self._verify_register(key, msg):
+                ts = float(msg.get("ts", 0))
+                if ts <= self._last_ts.get(key, 0.0):
+                    return  # replayed or out-of-order register
+                if len(self._registry) >= MAX_REGISTRY:
+                    now = time.monotonic()
+                    self._registry = {
+                        k: v for k, v in self._registry.items()
+                        if v[1] + ENTRY_TTL_S > now}
+                    self._last_ts = {k: t for k, t in self._last_ts.items()
+                                     if k in self._registry}
+                if len(self._registry) < MAX_REGISTRY:
+                    self._last_ts[key] = ts
+                    self._registry[key] = (addr, time.monotonic())
+                    self._send(_msg("registered", addr=list(addr)), addr)
         elif op == "request":
             key = str(msg.get("key", ""))
             entry = self._registry.get(key)
@@ -146,6 +177,20 @@ class PunchRendezvous:
             self._send(_msg("invite", addr=list(addr)), target_addr)
         # "punch"/"registered"/"peer"/"invite" arriving here are strays
 
+    @staticmethod
+    def _verify_register(key_hex: str, msg: dict) -> bool:
+        from symmetry_tpu.identity import Identity
+
+        try:
+            pub = bytes.fromhex(key_hex)
+            sig = bytes.fromhex(str(msg.get("sig", "")))
+            ts = float(msg.get("ts", 0))
+        except (ValueError, TypeError):
+            return False
+        if abs(time.time() - ts) > REGISTER_SKEW_S:
+            return False
+        return Identity.verify(_register_sig_msg(key_hex, ts), sig, pub)
+
 
 class ProviderPuncher:
     """Provider-side worker: keeps the provider registered at the
@@ -153,10 +198,11 @@ class ProviderPuncher:
     the stream port) and answers invites with punch bursts."""
 
     def __init__(self, raw_channel, rendezvous: tuple[str, int],
-                 key_hex: str) -> None:
+                 identity) -> None:
         self._raw = raw_channel
         self._rdv = resolve_endpoint(rendezvous)
-        self._key = key_hex
+        self._identity = identity
+        self._key = identity.public_hex
         self._task: asyncio.Task | None = None
         self.punched: int = 0  # invites answered (introspection/tests)
 
@@ -176,8 +222,13 @@ class ProviderPuncher:
         while True:
             now = time.monotonic()
             if now >= next_register:
-                if not self._raw.send(self._rdv[0], self._rdv[1],
-                                      _msg("register", key=self._key)):
+                ts = time.time()
+                sig = self._identity.sign(
+                    _register_sig_msg(self._key, ts)).hex()
+                if not self._raw.send(
+                        self._rdv[0], self._rdv[1],
+                        _msg("register", key=self._key,
+                             ts=round(ts, 3), sig=sig)):
                     logger.warning(
                         f"punch register send to {self._rdv} failed")
                 next_register = now + REGISTER_INTERVAL_S
@@ -192,7 +243,12 @@ class ProviderPuncher:
                 addr = msg.get("addr") or []
                 if len(addr) == 2:
                     self.punched += 1
-                    await self._burst(str(addr[0]), int(addr[1]))
+                    # burst concurrently: serial bursts (1.5 s each) would
+                    # stall invite handling for later clients past their
+                    # punch deadline
+                    task = asyncio.get_running_loop().create_task(
+                        self._burst(str(addr[0]), int(addr[1])))
+                    task.add_done_callback(lambda t: t.exception())
             # punches from clients need no reply: their arrival already
             # proves our pinhole is open, and ours open theirs
 

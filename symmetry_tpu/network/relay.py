@@ -102,13 +102,20 @@ class RelayedConnection(Connection):
         return f"relay://{self._relay_id}"
 
 
-async def await_ready(peer: Any, relay_id: str, timeout: float = 10.0) -> None:
-    """Consume messages until relayReady for `relay_id` (pre-splice)."""
-    async def _wait() -> None:
+async def await_ready(peer: Any, relay_id: str | None = None,
+                      timeout: float = 10.0) -> str:
+    """Consume messages until relayReady; returns the relay id.
+
+    With `relay_id` set (provider side) only that id completes the wait;
+    with None (client side, which learns the id FROM relayReady) the
+    first ready wins. The one shared implementation keeps both roles'
+    refusal handling identical."""
+    async def _wait() -> str:
         async for msg in peer:
             if msg.key == MessageKey.RELAY_READY:
-                if (msg.data or {}).get("id") == relay_id:
-                    return
+                got = str((msg.data or {}).get("id", ""))
+                if relay_id is None or got == relay_id:
+                    return got
             elif msg.key == MessageKey.RELAY_CLOSE:
                 raise ConnectionError("relay refused")
             elif msg.key == MessageKey.INFERENCE_ERROR:
@@ -116,4 +123,4 @@ async def await_ready(peer: Any, relay_id: str, timeout: float = 10.0) -> None:
                     (msg.data or {}).get("error", "relay failed"))
         raise ConnectionError("server closed during relay setup")
 
-    await asyncio.wait_for(_wait(), timeout)
+    return await asyncio.wait_for(_wait(), timeout)

@@ -58,12 +58,19 @@ MIN_CAPACITY = 4096
 def _kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, o_ref,
             m_scr, l_scr, acc_scr, *, scale: float, block_t: int,
             n_kv: int, group: int, quantized: bool,
+            window: int | None = None,
             ks_ref=None, vs_ref=None):
     del layer_ref  # consumed by the index_maps
     b = pl.program_id(0)
     t = pl.program_id(1)
     length = len_ref[b]
     n_blocks = (length + block_t - 1) // block_t
+    # Sliding window: keys below (length - window) are dead — blocks fully
+    # below it are skipped (their DMA too, via the index_map clamp; for
+    # t < first the fetched block belongs to `first` and must not be
+    # processed under this t, hence the compute gate below).
+    first = (jnp.maximum(length - window, 0) // block_t
+             if window is not None else 0)
     nq, D = q_ref.shape
     KB = n_kv * block_t
 
@@ -73,7 +80,7 @@ def _kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, o_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(t < n_blocks)
+    @pl.when((t >= first) & (t < n_blocks))
     def _():
         q = q_ref[:].astype(jnp.float32) * scale          # [nq, D]
         # Dequant scales multiply the K/V blocks in 3-D BEFORE flattening
@@ -94,6 +101,9 @@ def _kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, o_ref,
         kv_pos = t * block_t + col // n_kv
         # own-head (query row h ↔ kv head h // group) AND in-length
         keep = ((col % n_kv) == (row // group)) & (kv_pos < length)
+        if window is not None:
+            # decode q position == length - 1: window floor is length - w
+            keep &= kv_pos >= length - window
         s = jnp.where(keep, s, NEG_INF)
 
         m_old = m_scr[:, 0:1]
@@ -129,11 +139,14 @@ def supports(config, cache_capacity: int, backend: str) -> bool:
     """Static gate for routing decode attention through the kernel.
 
     Long-context capacities only: below MIN_CAPACITY the XLA einsum path
-    measured faster (its dequant/matmul fusion beats the kernel's grid
-    overhead when the whole capacity fits a few blocks)."""
+    measured as fast or faster (round-3 re-measure with fetch-fenced
+    timing: kernel 33.6 vs einsum 32.6 ms full-trunk at 640 — the step
+    there is convert-throughput-bound, not KV-traffic-bound, so block
+    skipping buys nothing). Sliding-window models route through the
+    kernel too: the window bounds the block range per slot (mistral at
+    8k capacity / 4k window reads half the blocks)."""
     D = config.dim_per_head
-    return (config.sliding_window is None
-            and D % 128 == 0
+    return (D % 128 == 0
             and backend == "tpu"
             and cache_capacity >= MIN_CAPACITY
             # decode_attention auto-picks a block from (512, 256, 128, 64),
@@ -142,7 +155,7 @@ def supports(config, cache_capacity: int, backend: str) -> bool:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("block_t", "interpret"))
+                   static_argnames=("block_t", "window", "interpret"))
 def decode_attention(
     q: jnp.ndarray,           # [B, n_q_heads, D] (single decode position)
     k_cache: jnp.ndarray,     # [L, B, T, K, D] FULL cache (bf16/f32 or int8)
@@ -153,6 +166,8 @@ def decode_attention(
     v_scale: jnp.ndarray | None = None,  # position minor — tile-friendly)
     *,
     block_t: int = DEFAULT_BLOCK_T,
+    window: int | None = None,  # sliding-window span (mistral); bounds the
+                                # per-slot block range below AND above
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Returns [B, n_q_heads, D] in q's dtype."""
@@ -176,10 +191,17 @@ def decode_attention(
     layer_arr = jnp.reshape(layer, (1,)).astype(jnp.int32)
 
     def clamp_t(b, t, len_ref, layer_ref):
-        # Last block holding any valid key for this slot; repeating its
-        # index for the tail iterations makes Pallas skip their DMAs.
+        # Clamp into the live block range for this slot: above the last
+        # occupied block, and (windowed models) below the first block the
+        # window can still see. Out-of-range iterations repeat a boundary
+        # index, so Pallas's revisit rule skips their DMAs; the kernel's
+        # compute gate skips their math.
         last = jnp.maximum((len_ref[b] + block_t - 1) // block_t - 1, 0)
-        return layer_ref[0], b, jnp.minimum(t, last), 0, 0
+        t_eff = jnp.minimum(t, last)
+        if window is not None:
+            first = jnp.maximum(len_ref[b] - window, 0) // block_t
+            t_eff = jnp.maximum(t_eff, first)
+        return layer_ref[0], b, t_eff, 0, 0
 
     q_spec = pl.BlockSpec((None, nq, D), lambda b, t, lr, yr: (b, 0, 0))
     kv_spec = pl.BlockSpec((None, None, block_t, K, D), clamp_t)
@@ -189,7 +211,8 @@ def decode_attention(
         pltpu.VMEM((nq, 128), jnp.float32),  # running denom (col 0)
         pltpu.VMEM((nq, max(D, 128)), jnp.float32),  # output accumulator
     ]
-    common = dict(scale=scale, block_t=block_t, n_kv=K, group=group)
+    common = dict(scale=scale, block_t=block_t, n_kv=K, group=group,
+                  window=window)
 
     if quantized:
         def clamp_t_scale(b, t, len_ref, layer_ref):

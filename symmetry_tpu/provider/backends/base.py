@@ -41,6 +41,11 @@ class StreamChunk:
     raw: str          # exact chunk forwarded to the client (SSE line / JSON line)
     text: str         # extracted completion delta ("" for control chunks)
     done: bool = False
+    # Tokens this chunk represents. Engine backends report the true count
+    # (a block-decode chunk carries many tokens); proxy backends leave 0
+    # and the provider falls back to chunk counting — the reference's
+    # accounting (one chunk ≈ one token, src/provider.ts:243-246).
+    tokens: int = 0
 
 
 class InferenceBackend(abc.ABC):

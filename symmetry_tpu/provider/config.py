@@ -49,6 +49,12 @@ class TpuConfig:
     prefill_buckets: tuple[int, ...] = (128, 512, 2048)
     prefill_chunk: int | None = 256    # chunked-prefill step; None disables
     decode_block: int = 8              # decode steps per device dispatch
+    # "process" (default, production): the engine runs in a host
+    # subprocess behind a pipe — its GIL-held device syncs would
+    # otherwise starve the provider's event loop and every stream's
+    # latency with it (engine/host.py). "inproc": same-process engine
+    # thread (tests, debugging).
+    engine_isolation: str = "process"
     pipeline_microbatches: int = 1     # GPipe microbatches (mesh stage > 1)
     checkpoint_path: str | None = None  # HF safetensors dir; None → random init
     tokenizer_path: str | None = None   # tokenizer.json; None → byte tokenizer

@@ -175,8 +175,7 @@ class SymmetryProvider:
         except (KeyError, ValueError) as exc:
             logger.error(f"natPunch disabled: {exc}")
             return
-        self._puncher = ProviderPuncher(raw_factory(), rdv,
-                                        self.identity.public_hex)
+        self._puncher = ProviderPuncher(raw_factory(), rdv, self.identity)
         self._puncher.start()
 
     async def _join_dht(self) -> None:
@@ -466,6 +465,7 @@ class SymmetryProvider:
                  "model": self.config.model_name},
             )
             n_chunks = 0
+            n_tokens = 0
             async for chunk in self.backend.stream(request):
                 if peer.closed:
                     # Mid-stream client death tolerated (src/provider.ts:242,253-254).
@@ -473,6 +473,10 @@ class SymmetryProvider:
                     break
                 if chunk.text:
                     completion_parts.append(chunk.text)
+                    # Engine backends report exact per-chunk token counts;
+                    # proxies leave 0 and we fall back to the reference's
+                    # one-chunk≈one-token accounting.
+                    n_tokens += chunk.tokens or 1
                     if first_token_s is None:
                         first_token_s = time.monotonic() - start
                         self.tracer.record("ttft", start, first_token_s,
@@ -485,12 +489,12 @@ class SymmetryProvider:
             if not peer.closed:
                 await peer.send(
                     MessageKey.INFERENCE_ENDED,
-                    {"chunks": n_chunks, "tokens": len(completion_parts)},
+                    {"chunks": n_chunks, "tokens": n_tokens},
                 )
-            self.metrics["tokens_out"] += len(completion_parts)
+            self.metrics["tokens_out"] += n_tokens
             self.tracer.record("inference", start, time.monotonic() - start,
                                request_id=request_id,
-                               tokens=len(completion_parts), chunks=n_chunks)
+                               tokens=n_tokens, chunks=n_chunks)
             # Data collection (reference: saveCompletion, src/provider.ts:277-297).
             peer_key = peer.remote_public_hex
             await self.collector.save(
@@ -499,7 +503,7 @@ class SymmetryProvider:
                 messages=messages,
                 completion=completion,
             )
-            await self._report_completion(data, len(completion_parts))
+            await self._report_completion(data, n_tokens)
         except BackendError as exc:
             self.metrics["errors"] += 1
             logger.error(f"backend error: {exc}")

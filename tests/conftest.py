@@ -24,3 +24,15 @@ jax.config.update("jax_platforms", "cpu")
 # matmul precision truncates f32 operands to bf16 passes, which swamps the
 # tolerances. Production serving uses bf16 params, where this is a no-op.
 jax.config.update("jax_default_matmul_precision", "highest")
+
+# Persistent compilation cache: every test that builds an engine re-traces
+# the same programs; caching compiled executables across tests AND runs is
+# the difference between an affordable suite and a >10-minute one. The env
+# vars propagate it to SUBPROCESSES (graft dryrun, engine hosts, multihost
+# workers); jax.config covers this already-imported process.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/symmetry-tpu-jax-test-cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)

@@ -87,8 +87,12 @@ class TestDecodeAttentionKernel:
         assert not supports(preset("llama3-8b"), 8192, "cpu")
         assert not supports(preset("llama3-8b"), 2048, "tpu")  # below crossover
         assert not supports(preset("tiny"), 8192, "tpu")       # D=16
+        # windowed models now route through the kernel (window-bounded
+        # block range); the capacity floor still applies
         sliding = dataclasses.replace(preset("mistral-7b"), sliding_window=4096)
-        assert not supports(sliding, 8192, "tpu")
+        assert supports(sliding, 8192, "tpu")
+        assert not supports(sliding, 2048, "tpu")
+        assert supports(preset("llama3-8b"), 4096 + 640, "tpu")  # 64-mult
 
 
 class TestModelIntegration:
@@ -147,3 +151,44 @@ class TestModelIntegration:
 
         np.testing.assert_allclose(decode(True), decode(False),
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestSlidingWindow:
+    """window= bounds the per-slot block range AND the mask — must match
+    gqa_attention's sliding_window semantics exactly."""
+
+    @pytest.mark.parametrize("window", [8, 24, 48, 200])
+    @pytest.mark.parametrize("block_t", [16, 32])
+    def test_matches_xla_sliding_reference(self, window, block_t):
+        q, k, v, lengths = make_case(seed=3)
+        got = decode_attention(q, k, v, jnp.int32(0), lengths,
+                               block_t=block_t, window=window,
+                               interpret=True)
+        positions = (lengths - 1)[:, None]
+        want = gqa_attention(q[:, None], k[0], v[0], positions, lengths,
+                             sliding_window=window)[:, 0]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_quantized_sliding(self):
+        q, k, v, lengths = make_case(seed=4)
+        kq, ksc = quantize_kv(k)
+        vq, vsc = quantize_kv(v)
+        ksc, vsc = to_minor(ksc), to_minor(vsc)
+        got = decode_attention(q, kq, vq, jnp.int32(1), lengths,
+                               k_scale=ksc, v_scale=vsc,
+                               block_t=16, window=24, interpret=True)
+        positions = (lengths - 1)[:, None]
+        want = gqa_attention(q[:, None], kq[1], vq[1], positions, lengths,
+                             sliding_window=24,
+                             k_scale=ksc[1], v_scale=vsc[1])[:, 0]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_window_larger_than_length_is_full_attention(self):
+        q, k, v, lengths = make_case(seed=5)
+        got = decode_attention(q, k, v, jnp.int32(0), lengths,
+                               block_t=16, window=10_000, interpret=True)
+        want = reference(q, k[0], v[0], lengths)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)

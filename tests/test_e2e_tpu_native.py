@@ -20,7 +20,7 @@ def run(coro):
     return asyncio.new_event_loop().run_until_complete(asyncio.wait_for(coro, 300))
 
 
-def tpu_config(server_key_hex):
+def tpu_config(server_key_hex, isolation):
     return ConfigManager(config={
         "name": "tpu-prov",
         "public": True,
@@ -30,18 +30,26 @@ def tpu_config(server_key_hex):
         "dataCollectionEnabled": False,
         "tpu": {"model_preset": "tiny", "dtype": "float32",
                 "max_batch_size": 4, "max_seq_len": 128,
-                "prefill_buckets": [32, 64]},
+                "prefill_buckets": [32, 64],
+                # "process" exercises the production engine-host pipe
+                # (engine/host.py); "inproc" the direct thread path.
+                "engine_isolation": isolation},
     })
 
 
-def test_tpu_native_full_flow():
+@pytest.mark.parametrize(
+    "isolation",
+    ["inproc",
+     # the host-subprocess path recompiles the engine in a fresh process
+     pytest.param("process", marks=pytest.mark.slow)])
+def test_tpu_native_full_flow(isolation):
     async def main():
         hub = MemoryTransport()
         server_ident = Identity.from_name("tpu-e2e-server")
         server = SymmetryServer(server_ident, hub, ping_interval_s=30.0)
         await server.start("mem://server")
 
-        cfg = tpu_config(server_ident.public_hex)
+        cfg = tpu_config(server_ident.public_hex, isolation)
         provider = SymmetryProvider(
             cfg, transport=hub,
             identity=Identity.from_name("tpu-prov"),

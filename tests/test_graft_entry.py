@@ -13,6 +13,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-process / heavy-compile; run with -m ""
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

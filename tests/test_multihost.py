@@ -14,6 +14,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-process / heavy-compile; run with -m ""
+
 
 def free_port() -> int:
     with socket.socket() as s:

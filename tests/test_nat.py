@@ -71,17 +71,18 @@ class TestHolePunch:
                 await got.put(frame)
                 await conn.send(b"pong:" + (frame or b""))
 
+            ident = Identity.from_name("punch-prov")
             provider_t = UdpTransport()
             listener = await provider_t.listen("udp://127.0.0.1:0",
                                                echo_handler)
             puncher = ProviderPuncher(listener.raw_channel(),
-                                      ("127.0.0.1", rdv.port), "prov-key")
+                                      ("127.0.0.1", rdv.port), ident)
             puncher.start()
             await asyncio.sleep(0.3)  # registration datagram lands
 
             client_t = UdpTransport()
             address = await punch_dial(client_t, ("127.0.0.1", rdv.port),
-                                       "prov-key")
+                                       ident.public_hex)
             assert address == listener.address
             assert puncher.punched == 1  # the invite produced a burst
 
@@ -89,6 +90,31 @@ class TestHolePunch:
             await conn.send(b"ping")
             assert await conn.recv() == b"pong:ping"
             await conn.close()
+
+            # forged (unsigned) registration must NOT move the record
+            import json as _json
+
+            from symmetry_tpu.network.natpunch import wrap_raw
+
+            evil = _json.dumps({"op": "register",
+                                "key": ident.public_hex}).encode()
+            rdv._on_datagram(wrap_raw(evil), ("10.9.9.9", 9999))
+            assert rdv._registry[ident.public_hex][0][0] == "127.0.0.1"
+
+            # and a REPLAYED (validly signed, old ts) register from a
+            # different address must not move it either
+            import time as _time
+
+            from symmetry_tpu.network.natpunch import _register_sig_msg
+
+            old_ts = rdv._last_ts[ident.public_hex]
+            replay = _json.dumps({
+                "op": "register", "key": ident.public_hex,
+                "ts": old_ts,
+                "sig": ident.sign(_register_sig_msg(
+                    ident.public_hex, old_ts)).hex()}).encode()
+            rdv._on_datagram(wrap_raw(replay), ("10.9.9.9", 9999))
+            assert rdv._registry[ident.public_hex][0][0] == "127.0.0.1"
 
             await puncher.stop()
             await listener.close()

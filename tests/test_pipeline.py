@@ -20,6 +20,8 @@ from symmetry_tpu.parallel.pipeline import (
     pipeline_forward_hidden,
 )
 
+pytestmark = pytest.mark.slow  # multi-process / heavy-compile; run with -m ""
+
 CFG = ModelConfig(vocab_size=256, hidden_size=64, num_layers=4, num_heads=4,
                   num_kv_heads=2, intermediate_size=96, rope_theta=10000.0,
                   max_position=128)

@@ -1,0 +1,107 @@
+"""Diagnose end-of-run event bunching in the serving path.
+
+bench --e2e measures every client's TTFT ≈ wall time: token events reach
+clients only when the run ends. This drives the REAL serving stack
+(server + provider subprocess + tpu_native engine host) with a handful of
+clients and prints each delta's arrival time per client, to localize
+where streaming stalls (host → provider → wire → client).
+
+Run: python tools/probe_streaming.py [--clients 4 --max-new 48]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from symmetry_tpu.client.client import SymmetryClient  # noqa: E402
+from symmetry_tpu.identity import Identity  # noqa: E402
+from symmetry_tpu.server.broker import SymmetryServer  # noqa: E402
+from symmetry_tpu.transport.tcp import TcpTransport  # noqa: E402
+
+
+async def main(args) -> None:
+    server_ident = Identity.from_name("probe-server")
+    server = SymmetryServer(server_ident, TcpTransport(),
+                            ping_interval_s=60.0)
+    await server.start("tcp://127.0.0.1:0")
+    model = f"{args.preset}:probe"
+    cfg = {
+        "name": "probe-prov", "public": True,
+        "serverKey": server_ident.public_hex,
+        "serverAddress": server.address,
+        "modelName": model, "apiProvider": "tpu_native",
+        "dataCollectionEnabled": False,
+        "maxConnections": args.clients + 4,
+        "listenHost": "127.0.0.1",
+        "privateSeed": hashlib.blake2b(b"probe-prov",
+                                       digest_size=32).hexdigest(),
+        "tpu": {"model_preset": args.preset, "dtype": "bfloat16",
+                "quantization": "int8", "kv_quantization": "int8",
+                "max_batch_size": args.slots, "max_seq_len": 384,
+                "prefill_buckets": [128], "decode_block": args.block},
+    }
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                     delete=False) as fh:
+        yaml.safe_dump(cfg, fh)
+        cfg_path = fh.name
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "symmetry_tpu.provider", "-c", cfg_path],
+        cwd=REPO, stderr=subprocess.STDOUT,
+        stdout=open("/tmp/probe_provider.log", "w"))
+    t_reg0 = time.monotonic()
+    while server.registry.select_provider(model) is None:
+        if proc.poll() is not None:
+            raise RuntimeError("provider died")
+        await asyncio.sleep(0.5)
+    print(f"provider ready after {time.monotonic() - t_reg0:.1f}s",
+          flush=True)
+
+    t0 = time.perf_counter()
+
+    async def one(i: int) -> None:
+        client = SymmetryClient(Identity.from_name(f"probe-cli-{i}"),
+                                TcpTransport())
+        details = await client.request_provider(
+            server.address, server_ident.public_key, model)
+        session = await client.connect(details)
+        stamps = []
+        async for delta in session.chat(
+                [{"role": "user", "content": "y" * 90}],
+                max_tokens=args.max_new, temperature=0.7, seed=i):
+            stamps.append((round(time.perf_counter() - t0, 2), len(delta)))
+        usage = session.last_usage
+        await session.close()
+        head = stamps[:6]
+        tail = stamps[-2:] if len(stamps) > 8 else []
+        print(f"client {i}: {len(stamps)} deltas, usage={usage}, "
+              f"arrivals {head}…{tail}", flush=True)
+
+    await asyncio.gather(*(one(i) for i in range(args.clients)))
+    print(f"wall: {time.perf_counter() - t0:.2f}s", flush=True)
+    proc.terminate()
+    proc.wait(timeout=20)
+    os.unlink(cfg_path)
+    await server.stop()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="llama3.2-1b")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--block", type=int, default=8)
+    ap.add_argument("--max-new", type=int, default=48)
+    asyncio.new_event_loop().run_until_complete(ap.parse_args() and main(
+        ap.parse_args()))
