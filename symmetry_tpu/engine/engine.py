@@ -309,6 +309,21 @@ class InferenceEngine:
                 rng=state.rng.at[slot].set(rng[row]),
             )
 
+        def insert_all(state: DecodeState, prefix: KVCache, slots,
+                       true_len, first_token, temp, top_p, top_k,
+                       rng) -> DecodeState:
+            """Install EVERY row of a coalesced prefill in ONE dispatch —
+            per-row insert calls each cost a host↔device round-trip
+            (~100 ms over a tunnel), which dominated burst-admission TTFT.
+            Pad rows carry the last real request's slot: re-inserting
+            identical data to the same slot is idempotent."""
+
+            def body(i, st):
+                return insert(st, prefix, i, slots[i], true_len,
+                              first_token, temp, top_p, top_k, rng)
+
+            return jax.lax.fori_loop(0, slots.shape[0], body, state)
+
         def chunk_step(params, tokens, cache, seq_len):
             """Extend a batch-1 prefix cache by one prompt chunk. Attention
             runs the continuation path (absolute-position masking against
@@ -389,8 +404,8 @@ class InferenceEngine:
             self._decode = jax.jit(decode_block, donate_argnums=(1,))
             self._chunk_step = jax.jit(chunk_step, donate_argnums=(2,))
             self._chunk_final = jax.jit(chunk_final, donate_argnums=(2,))
-        self._insert = jax.jit(
-            insert, donate_argnums=(0,),
+        self._insert_all = jax.jit(
+            insert_all, donate_argnums=(0,),
             out_shardings=state_shard)
 
     # ------------------------------------------------------------------
@@ -444,15 +459,26 @@ class InferenceEngine:
         top_ps = np.ones((batch,), np.float32)
         top_ks = np.zeros((batch,), np.int32)
         prefill_keys, decode_keys = [], []
+        slots_arr = np.zeros((batch,), np.int32)
         for i in range(batch):
-            # Pad rows replay the last request — harmless compute, never
-            # inserted.
-            _, ids, sampling = assignments[min(i, n_req - 1)]
+            # Pad rows replay the last request BIT-IDENTICALLY — same
+            # prompt, same slot, and (below) the same PRNG keys. They are
+            # inserted (insert_all covers every row), so anything short of
+            # an identical overwrite would corrupt the last real slot's
+            # state: a pad row with fresh entropy would sample a DIFFERENT
+            # first token and leave decode conditioned on a token the
+            # client never saw.
+            slot, ids, sampling = assignments[min(i, n_req - 1)]
+            slots_arr[i] = slot
             padded[i, :len(ids)] = ids
             lens[i] = len(ids)
             temps[i] = sampling.temperature
             top_ps[i] = sampling.top_p
             top_ks[i] = sampling.top_k
+            if i >= n_req:
+                prefill_keys.append(prefill_keys[n_req - 1])
+                decode_keys.append(decode_keys[n_req - 1])
+                continue
             if sampling.seed is not None:
                 key = jax.random.key(sampling.seed)
             else:
@@ -473,10 +499,11 @@ class InferenceEngine:
         toks, prefix = self._prefill(
             self.params, jnp.asarray(padded), lens_arr, temps_arr,
             top_ps_arr, top_ks_arr, jnp.stack(prefill_keys))
-        for i, (slot, _, _) in enumerate(assignments):
-            self.state = self._insert(
-                self.state, prefix, jnp.int32(i), jnp.int32(slot), lens_arr,
-                toks, temps_arr, top_ps_arr, top_ks_arr, decode_keys_arr)
+        # One dispatch installs every row; pad rows re-write the last
+        # real slot with bit-identical data (same prompt AND keys above).
+        self.state = self._insert_all(
+            self.state, prefix, jnp.asarray(slots_arr), lens_arr,
+            toks, temps_arr, top_ps_arr, top_ks_arr, decode_keys_arr)
         host_toks = np.asarray(toks)
         return [int(host_toks[i]) for i in range(n_req)]
 
@@ -539,8 +566,10 @@ class InferenceEngine:
             job.temp, job.top_p, job.top_k, job.prefill_key)
         job.done_chunks += 1
         job.cache = None  # old buffer was donated to chunk_final; poison reuse
-        self.state = self._insert(
-            self.state, cache, jnp.int32(0), jnp.int32(job.slot),
+        # same (batch=1, bucket) insert program the prefill warmup grid
+        # compiled — no chunk-specific insert compile
+        self.state = self._insert_all(
+            self.state, cache, jnp.asarray([job.slot], jnp.int32),
             jnp.asarray([job.true_len], jnp.int32), toks,
             job.temp, job.top_p, job.top_k, job.decode_key)
         return int(np.asarray(toks)[0])
@@ -582,10 +611,10 @@ class InferenceEngine:
                     jnp.ones((batch,), jnp.float32),
                     jnp.zeros((batch,), jnp.int32),
                     jax.random.split(jax.random.key(0), batch))
-                # Insert compiles per (batch, bucket) too; slot 0 with
-                # true_len 0 leaves the state semantically untouched.
-                self.state = self._insert(
-                    self.state, prefix, jnp.int32(0), jnp.int32(0),
+                # insert_all compiles per (batch, bucket) too; slot 0
+                # with true_len 0 leaves the state semantically untouched.
+                self.state = self._insert_all(
+                    self.state, prefix, jnp.zeros((batch,), jnp.int32),
                     jnp.zeros((batch,), jnp.int32), toks,
                     jnp.zeros((batch,), jnp.float32),
                     jnp.ones((batch,), jnp.float32),

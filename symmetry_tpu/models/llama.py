@@ -49,6 +49,11 @@ class ModelConfig:
     sliding_window: int | None = None    # mistral-v0.1 style local attention
     attention_bias: bool = False         # qwen2-style QKV projection biases
     max_position: int = 8192
+    # gemma family: gelu-tanh GeGLU, RMSNorm scale stored as (weight - 1),
+    # and embeddings multiplied by sqrt(hidden) at lookup
+    hidden_act: str = "silu"             # "silu" | "gelu_tanh"
+    norm_plus_one: bool = False
+    scale_embed: bool = False
 
     @property
     def dim_per_head(self) -> int:
@@ -115,6 +120,24 @@ PRESETS: dict[str, ModelConfig] = {
         vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
         num_kv_heads=8, intermediate_size=14336, rope_theta=1000000.0,
         num_experts=8, num_experts_per_tok=2,
+    ),
+    "gemma-7b": ModelConfig(
+        vocab_size=256000, hidden_size=3072, num_layers=28, num_heads=16,
+        num_kv_heads=16, intermediate_size=24576, head_dim=256,
+        rope_theta=10000.0, rms_eps=1e-6, tie_embeddings=True,
+        hidden_act="gelu_tanh", norm_plus_one=True, scale_embed=True,
+    ),
+    "gemma-2b": ModelConfig(
+        vocab_size=256000, hidden_size=2048, num_layers=18, num_heads=8,
+        num_kv_heads=1, intermediate_size=16384, head_dim=256,
+        rope_theta=10000.0, rms_eps=1e-6, tie_embeddings=True,
+        hidden_act="gelu_tanh", norm_plus_one=True, scale_embed=True,
+    ),
+    "tiny-gemma": ModelConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=128, head_dim=16,
+        rope_theta=10000.0, max_position=512, tie_embeddings=True,
+        hidden_act="gelu_tanh", norm_plus_one=True, scale_embed=True,
     ),
     "qwen2-7b": ModelConfig(
         vocab_size=152064, hidden_size=3584, num_layers=28, num_heads=28,
@@ -298,7 +321,7 @@ def _layer(
     B, S, E = h.shape
     D, nq, nkv = config.dim_per_head, config.num_heads, config.num_kv_heads
 
-    x = rms_norm(h, lp["attn_norm"], config.rms_eps)
+    x = rms_norm(h, _norm_w(lp["attn_norm"], config), config.rms_eps)
     q = qmatmul(x, lp["wq"])
     k = qmatmul(x, lp["wk"])
     v = qmatmul(x, lp["wv"])
@@ -390,15 +413,29 @@ def _layer(
                 v_scale=at_layer(cache.v_scale) if cache.quantized else None)
     h = h + qmatmul(attn.reshape(B, S, nq * D), lp["wo"])
 
-    x = rms_norm(h, lp["mlp_norm"], config.rms_eps)
+    x = rms_norm(h, _norm_w(lp["mlp_norm"], config), config.rms_eps)
     if "router" in lp:
         from symmetry_tpu.models.moe import moe_mlp
 
         h = h + moe_mlp(x, lp, config)
     else:
-        h = h + qmatmul(jax.nn.silu(qmatmul(x, lp["wg"]))
+        h = h + qmatmul(_act(qmatmul(x, lp["wg"]), config)
                         * qmatmul(x, lp["wu"]), lp["wd"])
     return h, cache
+
+
+def _act(x: jnp.ndarray, config: ModelConfig) -> jnp.ndarray:
+    """Gated-MLP activation: silu (llama/mistral/qwen) or tanh-approx gelu
+    (gemma's GeGLU)."""
+    if config.hidden_act == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def _norm_w(w: jnp.ndarray, config: ModelConfig) -> jnp.ndarray:
+    """Gemma stores RMSNorm scale as (weight - 1): the model applies
+    (1 + w). Kept as a runtime add (exact HF semantics, fuses away)."""
+    return w + 1.0 if config.norm_plus_one else w
 
 
 def forward_hidden(
@@ -457,11 +494,15 @@ def forward_hidden(
         raise ValueError(f"params carry {n_stacked} stacked layers but "
                          f"config.num_layers = {config.num_layers}")
     h = jnp.take(params["embed"], tokens, axis=0)
+    if config.scale_embed:
+        # gemma: embeddings scaled by sqrt(hidden) at lookup, normalizer
+        # cast to the activation dtype (HF modeling_gemma semantics)
+        h = h * jnp.asarray(config.hidden_size ** 0.5, h.dtype)
     h, new_cache = run_layers(params["layers"], h, cache, positions,
                               kv_valid, seq_lens, config,
                               use_flash=use_flash, use_ring=use_ring,
                               sp_mode=sp_mode)
-    h = rms_norm(h, params["final_norm"], config.rms_eps)
+    h = rms_norm(h, _norm_w(params["final_norm"], config), config.rms_eps)
     return h, new_cache._replace(lengths=kv_valid)
 
 
@@ -596,6 +637,15 @@ def config_from_hf(hf: dict[str, Any]) -> ModelConfig:
     """Build a ModelConfig from an HF config.json dict (llama/mistral/
     qwen2/mixtral shapes; mixtral's num_local_experts selects MoEConfig)."""
     arch = (hf.get("architectures") or [""])[0]
+    # Exact match: gemma-2/3 checkpoints (Gemma2ForCausalLM, ...) need
+    # logit softcapping, post-layer norms, and alternating local
+    # attention this decoder does not implement — loading them with
+    # gemma-1 semantics would silently generate garbage.
+    gemma = arch == "GemmaForCausalLM"
+    if arch.startswith("Gemma") and not gemma:
+        raise ValueError(
+            f"unsupported architecture {arch!r}: only first-generation "
+            f"GemmaForCausalLM semantics are implemented")
     # qwen2 configs carry a vestigial sliding_window alongside
     # use_sliding_window: false — honoring it would silently disable every
     # fast attention path (flash prefill, ring, the Pallas decode kernel).
@@ -631,10 +681,17 @@ def config_from_hf(hf: dict[str, Any]) -> ModelConfig:
         head_dim=hf.get("head_dim"),
         rope_theta=hf.get("rope_theta", 10000.0),
         rms_eps=hf.get("rms_norm_eps", 1e-5),
-        tie_embeddings=hf.get("tie_word_embeddings", False),
+        # gemma ties embeddings BY DEFAULT, so its config.json often omits
+        # the key entirely — defaulting it False would reject the checkpoint
+        tie_embeddings=hf.get("tie_word_embeddings", gemma),
         sliding_window=sliding,
         # older qwen2 configs carry no attention_bias key; the architecture
         # implies it (HF modeling_qwen2 hardcodes bias=True on q/k/v).
         attention_bias=hf.get("attention_bias", "Qwen2" in arch),
         max_position=hf.get("max_position_embeddings", 8192),
+        # gemma: GeGLU + (1+w) norms + scaled embeddings; hidden_activation
+        # ("gelu_pytorch_tanh") appears in newer configs, older ones imply it
+        hidden_act="gelu_tanh" if gemma else "silu",
+        norm_plus_one=gemma,
+        scale_embed=gemma,
     )

@@ -1,25 +1,26 @@
-"""Native int8 MXU matmul (W8A8) — EXPERIMENTAL, not routed by default.
+"""Native int8 MXU matmul (W8A8) — measured, NOT routed (see below).
 
-Engineering record of a measured dead end on v5e, kept because the
-arithmetic is correct (tests/test_qmm.py) and other TPU generations may
-change the verdict:
+The regime matters (all numbers measured on this v5e, fetch-fenced,
+carry-dependent loops — tools/probe_s8_mxu.py, tools/bisect_decode.py):
 
-  - Every XLA int8 dot form — mixed bf16×s8, dequant-materialize, s8×s8
-    with s32 accumulation — measures at the s8→float convert throughput
-    (~270–480 GB/s effective), while bf16×bf16 streams at ~820 GB/s
-    (tools/microbench_matmul.py, carry-dependent loop).
-  - Hypothesis: feeding the MXU s8×s8 tiles directly from a Pallas kernel
-    skips the convert. Microbenchmarks first showed ~590 GB/s, but that
-    was a loop-invariant-hoisting artifact; with the input made
-    carry-dependent the kernel measures ~258 GB/s (tools/probe_s8_mxu.py),
-    and routed into the real decode trunk it is ~50% SLOWER end-to-end
-    (48.5 vs 32.1 ms — tools/bisect_decode.py, BISECT_W8A8=1).
-  - Conclusion: Mosaic's s8 dot path on v5e is no faster than XLA's, and
-    the mixed dot in ops/quant.qmatmul stays the production path.
+  - DECODE (M ≈ slot count, ~128 rows): bandwidth-bound. Every int8 form
+    is convert-throughput-limited; this kernel measured ~50% SLOWER than
+    the XLA mixed dot in the full trunk (48.5 vs 32.1 ms). Decode stays
+    on ops/quant.qmatmul's mixed dot.
+  - PREFILL (M ≥ ~256 token rows): the kernel's s8×s8 MXU tiles measure
+    ~172 TFLOP/s in ISOLATION at M=512 (vs the convert-limited mixed
+    dot), but routed into the real prefill path the end-to-end group
+    time is identical (165.3 vs 167.6 ms) — prefill is not matmul-bound.
+    Since W8A8 adds per-row activation-quant noise for zero measured
+    gain, it is NOT routed; the mixed dot serves both regimes.
 
-The activation is quantized dynamically per row (per token/slot) to int8;
-the s32 tile products are rescaled in the kernel epilogue by
-(row activation scale × per-output-channel weight scale).
+Kept as a correct, tested building block (tests/test_qmm.py pins the
+arithmetic against a bit-exact integer reference in interpret mode) and
+as the measurement record — a future TPU generation or a genuinely
+matmul-bound workload may flip the verdict. The activation is quantized
+dynamically per row to int8; the s32 tile products are rescaled in the
+kernel epilogue by (row activation scale × per-output-channel weight
+scale).
 """
 
 from __future__ import annotations
@@ -31,12 +32,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Measured on v5e (tools/probe_s8_mxu.py): (bn=256, bk=512) and
-# (512, 1024) both hit the ~590 GB/s mode; smaller bn keeps more N-blocks
-# for the grid, which generalizes better to narrow layers.
+# Tile sizes measured on v5e (tools/probe_s8_mxu.py, M=512): smaller bn
+# keeps more N-blocks for the grid, which generalizes better to narrow
+# layers; (512, 1024) performs comparably at wide shapes.
 BLOCK_N = 256
 BLOCK_K = 512
-MIN_ROWS = 32  # below this the MXU is mostly idle; mixed dot wins
+MIN_ROWS = 32  # below this the MXU is mostly idle
 
 
 def _kernel(x_ref, w_ref, xs_ref, ws_ref, o_ref, acc_scr, *, n_k: int,

@@ -47,10 +47,12 @@ def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
     128k-vocab head that copy alone is >1 GB). Accumulates f32, applies the
     per-column scales, casts back to the activation dtype.
 
-    Measured alternatives, rejected: a native s8×s8 MXU Pallas kernel
-    (ops/qmm.py) made the full decode trunk ~50% SLOWER on v5e (48.5 vs
-    32.1 ms; tools/bisect_decode.py BISECT_W8A8) — this mixed dot is the
-    fastest int8 form XLA/Mosaic currently offers on this hardware.
+    Measured alternative, not routed: the native s8×s8 MXU kernel
+    (ops/qmm.py) is ~50% slower in-trunk at decode-sized M and exactly
+    NEUTRAL at prefill-sized M (165.3 vs 167.6 ms per coalesced prefill
+    group on-chip, despite winning isolated matmul microbenchmarks —
+    prefill is not matmul-bound). Since W8A8 would add activation-quant
+    noise for zero measured gain, the mixed dot serves both regimes.
     """
     if isinstance(w, QuantizedTensor):
         y = jax.lax.dot_general(

@@ -58,7 +58,10 @@ class TpuConfig:
     pipeline_microbatches: int = 1     # GPipe microbatches (mesh stage > 1)
     checkpoint_path: str | None = None  # HF safetensors dir; None → random init
     tokenizer_path: str | None = None   # tokenizer.json; None → byte tokenizer
-    model_family: str = "llama"         # models/registry key
+    # Informational: every supported family (llama 3.x, mistral, qwen2,
+    # mixtral-MoE, gemma) shares the decoder in models/llama.py, selected
+    # by ModelConfig flags; checkpoints self-describe via config.json.
+    model_family: str = "llama"
     model_preset: str | None = None     # e.g. "llama3-8b", "tiny" (tests)
     # Multi-host provider (SURVEY §7 stage 6): one logical provider backed
     # by N JAX processes. Keys: coordinator ("host:port"), num_processes,

@@ -533,3 +533,22 @@ class TestChunkedPrefill:
         got_text = "".join(ev.text for ev in results[0])
         assert got_text.rstrip("�") == want_text.rstrip("�")
         assert results[0][-1].done
+
+
+class TestCoalescedPadRows:
+    def test_pad_row_overwrite_is_identical(self, setup):
+        """A non-full coalesced batch pads by replaying the LAST request —
+        with the SAME PRNG keys, so the pad row's overwrite of that slot
+        is bit-identical. A fresh-entropy pad would sample a different
+        first token and leave decode conditioned on a token the client
+        never received (round-3 review finding)."""
+        cfg, params = setup
+        engine = make_engine(cfg, params, slots=4)
+        reqs = [(s, list(b"pad row check %d" % s),
+                 SamplingParams(temperature=0.9))  # unseeded + sampled
+                for s in range(3)]  # 3 requests -> batch pads to 4
+        firsts = engine.prefill_and_insert_many(reqs)
+        # the device state each slot will decode from must be exactly the
+        # token the caller returned to the stream
+        for (slot, _, _), first in zip(reqs, firsts):
+            assert int(engine.state.last_token[slot]) == first

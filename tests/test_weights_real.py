@@ -227,3 +227,65 @@ class TestHFTokenizerReal:
             cont = cont[:cut]
         want_text = tok.decode(cont)
         assert got_text.rstrip("�") == want_text.rstrip("�")
+
+
+class TestGemmaGolden:
+    """Gemma family: GeGLU + (1+w) RMSNorm + sqrt(hidden) embedding scale,
+    validated against transformers' GemmaForCausalLM the same way the
+    llama path is — independent implementation, same checkpoint."""
+
+    @pytest.fixture(scope="class")
+    def gemma_checkpoint(self, tmp_path_factory):
+        cfg = transformers.GemmaConfig(
+            vocab_size=256,
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            head_dim=16,
+            max_position_embeddings=128,
+            rms_norm_eps=1e-6,
+            rope_theta=10000.0,
+            tie_word_embeddings=True,
+            hidden_activation="gelu_pytorch_tanh",
+        )
+        torch.manual_seed(11)
+        model = transformers.GemmaForCausalLM(cfg)
+        model.eval()
+        path = tmp_path_factory.mktemp("gemma_ckpt")
+        model.save_pretrained(path, safe_serialization=True)
+        return str(path), model
+
+    def test_logits_match_transformers(self, gemma_checkpoint):
+        path, model = gemma_checkpoint
+        params, config = load_checkpoint(path, dtype=jnp.float32)
+        assert config.hidden_act == "gelu_tanh"
+        assert config.norm_plus_one and config.scale_embed
+        assert config.tie_embeddings
+
+        ids = np.array([[7, 201, 44, 13, 88, 156, 2, 99]], np.int32)
+        with torch.no_grad():
+            want = model(torch.from_numpy(ids).long()).logits.numpy()
+        cache = init_cache(config, 1, 32, jnp.float32)
+        got, _ = forward(params, config, jnp.asarray(ids), cache)
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=3e-4, atol=3e-4)
+
+    def test_greedy_continuation_matches(self, gemma_checkpoint):
+        path, model = gemma_checkpoint
+        params, config = load_checkpoint(path, dtype=jnp.float32)
+        prompt = [7, 201, 44, 13, 88]
+        cache = init_cache(config, 1, 32, jnp.float32)
+        logits, cache = forward(
+            params, config, jnp.asarray([prompt], jnp.int32), cache)
+        ours = [int(jnp.argmax(logits[0, -1]))]
+        for _ in range(5):
+            logits, cache = forward(
+                params, config, jnp.asarray([[ours[-1]]], jnp.int32), cache)
+            ours.append(int(jnp.argmax(logits[0, 0])))
+        with torch.no_grad():
+            out = model.generate(
+                torch.tensor([prompt]).long(), max_new_tokens=6,
+                do_sample=False, use_cache=True, pad_token_id=0)
+        assert ours == out[0, len(prompt):].tolist()

@@ -62,7 +62,8 @@ def pallas_matmul(x, w, *, bn=512, bk=1024, acc_dtype=jnp.int32):
 
 
 def main():
-    B, E, H = 128, 4096, 4 * 14336
+    import os
+    B, E, H = int(os.environ.get('PROBE_M', 128)), 4096, 4 * 14336
     ITERS = 20
 
     xq = jnp.ones((B, E), jnp.int8)
