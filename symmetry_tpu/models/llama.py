@@ -434,8 +434,13 @@ def _act(x: jnp.ndarray, config: ModelConfig) -> jnp.ndarray:
 
 def _norm_w(w: jnp.ndarray, config: ModelConfig) -> jnp.ndarray:
     """Gemma stores RMSNorm scale as (weight - 1): the model applies
-    (1 + w). Kept as a runtime add (exact HF semantics, fuses away)."""
-    return w + 1.0 if config.norm_plus_one else w
+    (1 + w). The add runs in float32 — HF GemmaRMSNorm computes
+    (1.0 + weight.float()), and doing it in a bf16 checkpoint dtype would
+    round the multiplier at every one of the model's norm sites. rms_norm
+    upcasts anyway, so this costs nothing."""
+    if not config.norm_plus_one:
+        return w
+    return w.astype(jnp.float32) + 1.0
 
 
 def forward_hidden(

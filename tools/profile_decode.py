@@ -36,6 +36,9 @@ def main():
     ap.add_argument("--force-kernel", action="store_true",
                     help="route decode attention through the Pallas ragged "
                          "kernel regardless of capacity (A/B the einsum)")
+    ap.add_argument("--force-einsum", action="store_true",
+                    help="disable the Pallas decode kernel (A/B at "
+                         "capacities where it is the default)")
     ap.add_argument("--occupancy", type=int, default=None,
                     help="per-slot cache occupancy for the trunk timing "
                          "(default: near capacity)")
@@ -44,6 +47,9 @@ def main():
     if args.force_kernel:
         from symmetry_tpu.ops import decode_attention as _da
         _da.MIN_CAPACITY = 0
+    if args.force_einsum:
+        from symmetry_tpu.ops import decode_attention as _da
+        _da.MIN_CAPACITY = 10**9
 
     from symmetry_tpu.models.llama import (
         forward_hidden, init_cache, init_params, logits_from_hidden, preset)
@@ -80,7 +86,8 @@ def main():
     L = cfg.num_layers
     print(f"trunk (all {L} layers):   {ms_trunk:8.2f} ms  "
           f"(B={B} T={T} occ={occ} kv={'int8' if kvq else 'bf16'}"
-          f"{' kernel' if args.force_kernel else ''})", flush=True)
+          f"{' kernel' if args.force_kernel else ''}"
+          f"{' einsum' if args.force_einsum else ''})", flush=True)
     if args.trunk_only:
         return
 
