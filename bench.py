@@ -351,14 +351,18 @@ def main() -> None:
     ap.add_argument("--kv-quant", default="int8", choices=("none", "int8"),
                     help="KV cache quantization")
     args = ap.parse_args()
+    user_block = args.block
     if args.block is None:
         args.block = 64 if (args.engine or args.smoke) else 16
 
     def engine_bench() -> dict:
+        # engine numbers are recorded at block 64; when the user didn't
+        # choose a block, the e2e-failure fallback must not inherit the
+        # serving default and measure an incomparable configuration
         return run_bench(args.preset, slots=args.slots, steps=args.steps,
                          prompt_len=args.prompt_len, max_seq=args.max_seq,
                          dtype_name=args.dtype, mesh_model=args.mesh_model,
-                         block=args.block,
+                         block=64 if user_block is None else user_block,
                          quant=None if args.quant == "none" else args.quant,
                          kv_quant=args.kv_quant == "int8")
 

@@ -49,6 +49,7 @@ class SymmetryServer:
         db_path: str = ":memory:",
         ping_interval_s: float = PING_INTERVAL_S,
         stale_after_s: float = STALE_AFTER_S,
+        punch_port: int | None = None,
     ) -> None:
         self.identity = identity
         self._transport = transport
@@ -56,6 +57,11 @@ class SymmetryServer:
         self._ping_interval = ping_interval_s
         self._stale_after = stale_after_s
         self._listener: Listener | None = None
+        # NAT rendezvous (network/natpunch.py): providers register their
+        # reflexive UDP address here; clients punch through it. None
+        # disables; 0 binds an ephemeral port (tests).
+        self._punch_port = punch_port
+        self._punch: Any = None
         self._provider_peers: dict[str, Peer] = {}  # peer_key hex → live peer
         # relay splices (NAT fallback, network/relay.py): relayId →
         # {"a": client peer, "b": provider peer | None (pre-accept)}
@@ -71,13 +77,35 @@ class SymmetryServer:
     async def start(self, address: str) -> None:
         self._listener = await self._transport.listen(address, self._on_connection)
         self._spawn(self._liveness_loop())
+        if self._punch_port is not None:
+            from symmetry_tpu.network.natpunch import PunchRendezvous
+
+            # Best-effort: a taken UDP port must cost NAT traversal, not
+            # the whole server (a second server on the same host would
+            # otherwise fail startup on the default punch port).
+            try:
+                self._punch = PunchRendezvous()
+                await self._punch.start(port=self._punch_port)
+                logger.info(
+                    f"punch rendezvous on udp port {self._punch.port}")
+            except OSError as exc:
+                self._punch = None
+                logger.warning(f"punch rendezvous disabled "
+                               f"(udp port {self._punch_port}): {exc}")
         logger.info(
             f"symmetry server listening on {self.address} "
             f"key={self.identity.public_hex}"
         )
 
+    @property
+    def punch_port(self) -> int | None:
+        return self._punch.port if self._punch is not None else None
+
     async def stop(self) -> None:
         self._stopped.set()
+        if self._punch is not None:
+            await self._punch.stop()
+            self._punch = None
         for task in list(self._tasks):
             task.cancel()
         for peer in list(self._provider_peers.values()):
@@ -340,6 +368,9 @@ async def main() -> None:
     parser.add_argument("--db", default=os.path.expanduser("~/.config/symmetry/server.db"))
     parser.add_argument("--seed-name", default=None,
                         help="derive a stable identity from this name")
+    parser.add_argument("--punch-port", type=int, default=4849,
+                        help="UDP port for the NAT-punch rendezvous "
+                             "(-1 disables)")
     args = parser.parse_args()
 
     from symmetry_tpu.transport import transport_for
@@ -350,7 +381,9 @@ async def main() -> None:
     if args.db != ":memory:":
         os.makedirs(os.path.dirname(args.db), exist_ok=True)
     address = f"{args.scheme}://{args.host}:{args.port}"
-    server = SymmetryServer(identity, transport_for(address), db_path=args.db)
+    server = SymmetryServer(
+        identity, transport_for(address), db_path=args.db,
+        punch_port=None if args.punch_port < 0 else args.punch_port)
     await server.start(address)
     print(f"serverKey: {identity.public_hex}", flush=True)
     try:

@@ -222,3 +222,49 @@ class TestRelayFallback:
             await server.stop()
 
         run(main())
+
+
+class TestServerHostedRendezvous:
+    def test_server_starts_punch_rendezvous(self):
+        """The routing server hosts the punch rendezvous (punch_port=0
+        binds ephemeral); a signed register round-trips against it."""
+        async def main():
+            import time as _time
+
+            from symmetry_tpu.network.natpunch import (
+                _msg, _register_sig_msg, unwrap_raw)
+
+            hub = MemoryTransport()
+            ident = Identity.from_name("rdv-server")
+            server = SymmetryServer(ident, hub, ping_interval_s=30.0,
+                                    punch_port=0)
+            await server.start("mem://server")
+            assert server.punch_port
+
+            prov = Identity.from_name("rdv-prov")
+            loop = asyncio.get_running_loop()
+            inbox: asyncio.Queue = asyncio.Queue()
+
+            class _P(asyncio.DatagramProtocol):
+                def datagram_received(self, data, addr):
+                    inbox.put_nowait(data)
+
+            transport, _ = await loop.create_datagram_endpoint(
+                _P, local_addr=("127.0.0.1", 0))
+            ts = _time.time()
+            payload = _msg("register", key=prov.public_hex,
+                           ts=round(ts, 3),
+                           sig=prov.sign(_register_sig_msg(
+                               prov.public_hex, ts)).hex())
+            from symmetry_tpu.network.natpunch import wrap_raw
+
+            transport.sendto(wrap_raw(payload),
+                             ("127.0.0.1", server.punch_port))
+            reply = unwrap_raw(await asyncio.wait_for(inbox.get(), 5))
+            import json as _json
+
+            assert _json.loads(reply)["op"] == "registered"
+            transport.close()
+            await server.stop()
+
+        run(main())

@@ -33,12 +33,13 @@ def main():
                          "upcast-materialized by XLA, int8 would not beat "
                          "bf16 here")
     ap.add_argument("--trunk-only", action="store_true")
-    ap.add_argument("--force-kernel", action="store_true",
-                    help="route decode attention through the Pallas ragged "
-                         "kernel regardless of capacity (A/B the einsum)")
-    ap.add_argument("--force-einsum", action="store_true",
-                    help="disable the Pallas decode kernel (A/B at "
-                         "capacities where it is the default)")
+    force = ap.add_mutually_exclusive_group()
+    force.add_argument("--force-kernel", action="store_true",
+                       help="route decode attention through the Pallas "
+                            "ragged kernel regardless of capacity")
+    force.add_argument("--force-einsum", action="store_true",
+                       help="disable the Pallas decode kernel (A/B at "
+                            "capacities where it is the default)")
     ap.add_argument("--occupancy", type=int, default=None,
                     help="per-slot cache occupancy for the trunk timing "
                          "(default: near capacity)")
