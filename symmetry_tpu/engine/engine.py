@@ -710,14 +710,45 @@ class InferenceEngine:
         rules = _stage_rules(mesh)
 
         if tpu_cfg.checkpoint_path:
-            from symmetry_tpu.engine.weights import load_checkpoint
+            from symmetry_tpu.engine.weights import (
+                load_checkpoint, load_warm_cache, save_warm_cache)
+            from symmetry_tpu.utils.logging import logger
 
-            params, config = load_checkpoint(
-                tpu_cfg.checkpoint_path, mesh=mesh, rules=rules, dtype=dtype)
-            if quant:
-                from symmetry_tpu.models.llama import quantize_params
+            # Warm restart (SURVEY §5.4): the finished tree — stacked,
+            # transposed, quantized — is cached beside the checkpoint on
+            # first load; restarts mmap it straight to device.
+            warm = None
+            # Single-process only, for BOTH directions: on a multi-host
+            # mesh, a cache present on some hosts but not others would
+            # send processes down divergent load paths and hang the first
+            # cross-host collective.
+            use_warm = (getattr(tpu_cfg, "warm_cache", True)
+                        and jax.process_count() == 1)
+            if use_warm:
+                try:
+                    warm = load_warm_cache(
+                        tpu_cfg.checkpoint_path, dtype=dtype,
+                        quantize=quant, mesh=mesh, rules=rules)
+                except Exception as exc:  # noqa: BLE001 — cache is advisory
+                    logger.warning(f"warm cache unreadable, cold load: {exc}")
+            if warm is not None:
+                params, config = warm
+                logger.info("weights loaded from warm cache")
+            else:
+                params, config = load_checkpoint(
+                    tpu_cfg.checkpoint_path, mesh=mesh, rules=rules,
+                    dtype=dtype)
+                if quant:
+                    from symmetry_tpu.models.llama import quantize_params
 
-                params = quantize_params(params)
+                    params = quantize_params(params)
+                if use_warm:
+                    try:
+                        save_warm_cache(tpu_cfg.checkpoint_path, params,
+                                        config, dtype=dtype, quantize=quant)
+                        logger.info("warm weight cache written")
+                    except Exception as exc:  # noqa: BLE001
+                        logger.warning(f"warm cache not written: {exc}")
         else:
             config = preset(tpu_cfg.model_preset or "tiny")
             if mesh is not None:

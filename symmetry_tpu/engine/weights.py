@@ -355,3 +355,213 @@ def save_checkpoint(path: str, params: dict, config: ModelConfig) -> None:
         hf_cfg["num_experts_per_tok"] = config.num_experts_per_tok
     with open(os.path.join(path, "config.json"), "w", encoding="utf-8") as fh:
         json.dump(hf_cfg, fh, indent=2)
+
+
+# ---------------------------------------------------------------------------
+# Warm restart cache (SURVEY §5.4: orbax-style cached sharded weights)
+#
+# Loading a big checkpoint costs safetensors streaming + HF-layout
+# transposition + layer stacking + (for int8 serving) quantization of
+# every matmul weight. All of it is deterministic in (checkpoint, dtype,
+# quantize), so the first load persists the FINISHED param tree — stacked
+# layers, our layout, already quantized — and every restart after that is
+# a flat mmap read straight to device. No transposes, no quantize pass.
+
+_WARM_DIR = ".symmetry_warm"
+_WARM_VERSION = 1
+
+
+def _warm_path(checkpoint_path: str, dtype, quantize: bool) -> str:
+    tag = f"v{_WARM_VERSION}-{jnp.dtype(dtype).name}-{'int8' if quantize else 'dense'}"
+    return os.path.join(checkpoint_path, _WARM_DIR, tag)
+
+
+def _flatten_params(params: dict, prefix: str = "") -> Iterator[tuple[str, Any]]:
+    from symmetry_tpu.ops.quant import QuantizedTensor
+
+    for name, child in sorted(params.items()):
+        path = f"{prefix}{name}"
+        if isinstance(child, dict):
+            yield from _flatten_params(child, path + "/")
+        elif isinstance(child, QuantizedTensor):
+            yield path + ":q", child.q
+            yield path + ":scale", child.scale
+        else:
+            yield path, child
+
+
+def _checkpoint_fingerprint(checkpoint_path: str) -> list[list]:
+    """(name, mtime, size) of every source file the cache derives from —
+    recorded at save, verified at load, so an overwritten checkpoint can
+    never be silently served from a stale cache."""
+    out = []
+    for fname in sorted(os.listdir(checkpoint_path)):
+        if fname.endswith(".safetensors") or fname in (
+                "config.json", "model.safetensors.index.json"):
+            st = os.stat(os.path.join(checkpoint_path, fname))
+            out.append([fname, round(st.st_mtime, 3), st.st_size])
+    return out
+
+
+# Host-RAM guard for the cache WRITE: save_file needs the whole tree as
+# host arrays at once. Int8-quantized 70B is ~35 GB — fine on TPU hosts —
+# but an operator can cap or disable via this env var.
+_WARM_MAX_BYTES = int(float(os.environ.get(
+    "SYMMETRY_WARM_CACHE_MAX_GB", "64")) * 1e9)
+
+
+def save_warm_cache(checkpoint_path: str, params: dict, config: ModelConfig,
+                    *, dtype, quantize: bool) -> None:
+    """Persist a finished param tree next to its checkpoint (best effort —
+    failure to cache must never fail serving). bfloat16 leaves are stored
+    as uint16 views with the dtype recorded, so the file has no
+    non-numpy-native dtypes. The write is ATOMIC (temp dir + rename): a
+    crash mid-save must leave no half-cache a later load could trip on."""
+    import dataclasses
+    import shutil
+    import tempfile
+
+    from safetensors.numpy import save_file
+
+    total = sum(int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+                for _, leaf in _flatten_params(params))
+    if total > _WARM_MAX_BYTES:
+        raise RuntimeError(
+            f"param tree is {total/1e9:.1f} GB > "
+            f"SYMMETRY_WARM_CACHE_MAX_GB; not caching")
+
+    out_dir = _warm_path(checkpoint_path, dtype, quantize)
+    tensors: dict[str, np.ndarray] = {}
+    dtypes: dict[str, str] = {}
+    for name, leaf in _flatten_params(params):
+        host = np.asarray(jax.device_get(leaf))
+        dtypes[name] = str(leaf.dtype)
+        if host.dtype.name not in ("float32", "float16", "int8", "int32",
+                                   "uint16"):
+            if host.dtype.itemsize != 2:
+                # the uint16-view trick is only shape-preserving for
+                # 2-byte dtypes; anything else must fail loudly here,
+                # not corrupt shapes at load
+                raise RuntimeError(
+                    f"unsupported warm-cache dtype {host.dtype} for {name}")
+            host = host.view(np.uint16)  # bfloat16 and friends
+        tensors[name] = np.ascontiguousarray(host)
+    os.makedirs(os.path.dirname(out_dir), exist_ok=True)
+    tmp_dir = tempfile.mkdtemp(dir=os.path.dirname(out_dir))
+    try:
+        save_file(tensors, os.path.join(tmp_dir, "params.safetensors"))
+        meta = {
+            "version": _WARM_VERSION,
+            "config_class": type(config).__name__,
+            "config": dataclasses.asdict(config),
+            "dtypes": dtypes,
+            "fingerprint": _checkpoint_fingerprint(checkpoint_path),
+        }
+        with open(os.path.join(tmp_dir, "meta.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(meta, fh)
+        if os.path.exists(out_dir):
+            shutil.rmtree(out_dir)
+        os.rename(tmp_dir, out_dir)
+    except BaseException:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        raise
+
+
+def load_warm_cache(checkpoint_path: str, *, dtype, quantize: bool,
+                    mesh=None, rules=None) -> tuple[dict, ModelConfig] | None:
+    """Load a warm cache written by save_warm_cache; None when absent or
+    unreadable (callers fall back to the full checkpoint load). Sharded
+    meshes read per-device slices via make_array_from_callback, exactly
+    like the cold path — each host only touches its own shards."""
+    from symmetry_tpu.models.llama import ModelConfig as MC
+    from symmetry_tpu.models.llama import MoEConfig
+    from symmetry_tpu.ops.quant import QuantizedTensor
+
+    out_dir = _warm_path(checkpoint_path, dtype, quantize)
+    meta_path = os.path.join(out_dir, "meta.json")
+    st_path = os.path.join(out_dir, "params.safetensors")
+    if not (os.path.exists(meta_path) and os.path.exists(st_path)):
+        return None
+    try:
+        with open(meta_path, "r", encoding="utf-8") as fh:
+            meta = json.load(fh)
+        if meta.get("version") != _WARM_VERSION:
+            return None
+        if meta.get("fingerprint") != _checkpoint_fingerprint(
+                checkpoint_path):
+            return None  # checkpoint changed since the cache was written
+        cls = MoEConfig if meta["config_class"] == "MoEConfig" else MC
+        config = cls(**meta["config"])
+    except (ValueError, TypeError, KeyError, OSError):
+        return None
+
+    from safetensors import safe_open
+
+    import ml_dtypes
+
+    try:
+        handle = safe_open(st_path, framework="np")
+    except Exception:  # noqa: BLE001 — truncated/corrupt file → cold load
+        return None
+    dtypes = meta["dtypes"]
+
+    if mesh is not None:
+        from symmetry_tpu.models.llama import (
+            param_logical_axes, quantized_logical_axes)
+
+        axes = param_logical_axes(config)
+        if quantize:
+            axes = quantized_logical_axes(axes)
+        shardings = shardings_for(axes, mesh, rules)
+    else:
+        dev = jax.devices()[0]
+        shardings = None  # single device: whole-array reads
+
+    def leaf_sharding(path_parts):
+        node = shardings
+        for part in path_parts:
+            node = node[part] if isinstance(node, dict) else getattr(
+                node, part)
+        return node
+
+    def read_leaf(name: str):
+        want = np.dtype(ml_dtypes.bfloat16) if dtypes[name] == "bfloat16" \
+            else np.dtype(dtypes[name])
+        sl = handle.get_slice(name)
+
+        def read(index):
+            arr = sl[_norm_index(index, len(sl.get_shape()))]
+            if arr.dtype == np.uint16 and want != np.uint16:
+                arr = arr.view(want)
+            return arr
+
+        shape = tuple(sl.get_shape())
+        if mesh is not None:
+            parts = name.replace(":", "/").split("/")
+            sharding = leaf_sharding(parts)
+        else:
+            sharding = jax.sharding.SingleDeviceSharding(dev)
+        return jax.make_array_from_callback(shape, sharding, read)
+
+    # rebuild the nested tree; ":q"/":scale" pairs fold into
+    # QuantizedTensor leaves
+    params: dict = {}
+    pending_quant: dict[str, dict] = {}
+    for name in handle.keys():
+        arr = read_leaf(name)
+        if ":" in name:
+            base, _, part = name.partition(":")
+            pending_quant.setdefault(base, {})[part] = arr
+        else:
+            _tree_set(params, name.split("/"), arr)
+    for base, parts in pending_quant.items():
+        _tree_set(params, base.split("/"),
+                  QuantizedTensor(q=parts["q"], scale=parts["scale"]))
+    return params, config
+
+
+def _tree_set(tree: dict, parts: list[str], value) -> None:
+    for p in parts[:-1]:
+        tree = tree.setdefault(p, {})
+    tree[parts[-1]] = value

@@ -59,8 +59,15 @@ def shardings_for(
     rules: dict[str, str | None] | None = None,
 ) -> Any:
     """Pytree of NamedShardings matching a pytree of logical-axes tuples."""
+    from symmetry_tpu.ops.quant import QuantizedTensor
+
+    # A logical-axes LEAF is a plain tuple of axis names. QuantizedTensor
+    # is also a tuple (NamedTuple) but is a CONTAINER here — its q/scale
+    # fields each hold their own axes tuple — so it must be recursed into,
+    # not handed to logical_to_spec whole.
     return jax.tree.map(
         lambda axes: NamedSharding(mesh, logical_to_spec(axes, rules)),
         logical_axes,
-        is_leaf=lambda x: isinstance(x, tuple),
+        is_leaf=lambda x: (isinstance(x, tuple)
+                           and not isinstance(x, QuantizedTensor)),
     )

@@ -57,6 +57,10 @@ class TpuConfig:
     engine_isolation: str = "process"
     pipeline_microbatches: int = 1     # GPipe microbatches (mesh stage > 1)
     checkpoint_path: str | None = None  # HF safetensors dir; None → random init
+    # Cache the finished (stacked/transposed/quantized) param tree beside
+    # the checkpoint on first load; restarts skip the whole conversion
+    # (engine/weights.py save_warm_cache). SURVEY §5.4 warm restart.
+    warm_cache: bool = True
     tokenizer_path: str | None = None   # tokenizer.json; None → byte tokenizer
     # Informational: every supported family (llama 3.x, mistral, qwen2,
     # mixtral-MoE, gemma) shares the decoder in models/llama.py, selected
