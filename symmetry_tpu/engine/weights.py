@@ -558,6 +558,10 @@ def load_warm_cache(checkpoint_path: str, *, dtype, quantize: bool,
     for base, parts in pending_quant.items():
         _tree_set(params, base.split("/"),
                   QuantizedTensor(q=parts["q"], scale=parts["scale"]))
+    # every callback has run by now (make_array_from_callback is
+    # synchronous) — release the fd/mmap of the multi-GB cache file
+    if hasattr(handle, "__exit__"):
+        handle.__exit__(None, None, None)
     return params, config
 
 

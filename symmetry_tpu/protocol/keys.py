@@ -36,6 +36,8 @@ class MessageKey:
     CHALLENGE_RESPONSE = "challengeResponse"  # signed challenge reply (both directions)
     TOKEN_CHUNK = "tokenChunk"                # structured streamed tokens (engine-native)
     INFERENCE_ERROR = "inferenceError"        # structured mid-stream failure
+    INFERENCE_CANCEL = "inferenceCancel"      # client aborts one in-flight
+                                              # request by its requestId
     DRAIN = "drain"                           # graceful shutdown: stop accepting, finish in-flight
     METRICS = "metrics"                       # provider → server load metrics (tok/s, queue depth)
     PROVIDER_LIST = "providerList"            # server → client available models
