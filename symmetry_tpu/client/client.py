@@ -101,12 +101,26 @@ class ProviderSession:
                     continue
                 req_id = str(data.get("requestId", ""))
                 q = self._queues.get(req_id)
-                if q is None and not req_id and len(self._queues) == 1:
-                    # version skew: a pre-multiplexing provider echoes no
-                    # requestId — with exactly one request in flight the
-                    # stream is unambiguous, so route it there instead of
-                    # hanging the caller forever
-                    q = next(iter(self._queues.values()))
+                if q is None and not req_id and self._queues:
+                    if len(self._queues) == 1:
+                        # version skew: a pre-multiplexing provider echoes
+                        # no requestId — with exactly one request in
+                        # flight the stream is unambiguous, so route it
+                        # there instead of hanging the caller forever
+                        q = next(iter(self._queues.values()))
+                    else:
+                        # multiple requests in flight against an id-less
+                        # provider: attribution is impossible — fail them
+                        # all loudly rather than dropping chunks and
+                        # deadlocking every caller on queue.get()
+                        logger.error(
+                            "provider echoes no requestId but multiple "
+                            "requests are in flight; failing them — use "
+                            "one chat at a time with this provider")
+                        for pending_q in self._queues.values():
+                            pending_q.put_nowait(None)
+                        self._queues.clear()
+                        continue
                 if q is not None:
                     q.put_nowait(msg)
                 elif msg.key in (MessageKey.INFERENCE,

@@ -548,20 +548,24 @@ def load_warm_cache(checkpoint_path: str, *, dtype, quantize: bool,
     # QuantizedTensor leaves
     params: dict = {}
     pending_quant: dict[str, dict] = {}
-    for name in handle.keys():
-        arr = read_leaf(name)
-        if ":" in name:
-            base, _, part = name.partition(":")
-            pending_quant.setdefault(base, {})[part] = arr
-        else:
-            _tree_set(params, name.split("/"), arr)
+    try:
+        for name in handle.keys():
+            arr = read_leaf(name)
+            if ":" in name:
+                base, _, part = name.partition(":")
+                pending_quant.setdefault(base, {})[part] = arr
+            else:
+                _tree_set(params, name.split("/"), arr)
+    finally:
+        # every callback has run by now (make_array_from_callback is
+        # synchronous) — release the fd/mmap of the multi-GB cache file
+        # on EVERY path, including a failed read (the caller falls back
+        # to the cold load and must not hold a stale mapping)
+        if hasattr(handle, "__exit__"):
+            handle.__exit__(None, None, None)
     for base, parts in pending_quant.items():
         _tree_set(params, base.split("/"),
                   QuantizedTensor(q=parts["q"], scale=parts["scale"]))
-    # every callback has run by now (make_array_from_callback is
-    # synchronous) — release the fd/mmap of the multi-GB cache file
-    if hasattr(handle, "__exit__"):
-        handle.__exit__(None, None, None)
     return params, config
 
 

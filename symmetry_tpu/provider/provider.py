@@ -405,12 +405,24 @@ class SymmetryProvider:
                 elif msg.key == MessageKey.INFERENCE:
                     data = msg.data or {}
                     req_id = data.get("requestId")
-                    if req_id and (len(self._inference_tasks)
-                                   >= self.config.get(
-                                       "maxConcurrentRequests", 64)):
+                    peer_load = sum(1 for (pid, _) in self._inference_tasks
+                                    if pid == id(peer))
+                    if req_id and (id(peer), str(req_id)) in                             self._inference_tasks:
+                        # duplicate id: accepting it would overwrite the
+                        # task entry (bypassing the cap below, orphaning
+                        # the first task's cancel handle) and interleave
+                        # two streams into one client queue
+                        await peer.send(MessageKey.INFERENCE_ERROR, {
+                            "error": "duplicate requestId",
+                            "requestId": req_id})
+                    elif req_id and peer_load >= self.config.get(
+                            "maxConcurrentRequests", 32):
                         # multiplexing removed the implicit one-per-peer
-                        # serialization; an explicit cap replaces it so a
-                        # request flood cannot spawn unbounded tasks
+                        # serialization; an explicit PER-PEER cap replaces
+                        # it so one client's request flood cannot spawn
+                        # unbounded tasks (other peers are unaffected —
+                        # their aggregate is already bounded by
+                        # maxConnections × this cap)
                         await peer.send(MessageKey.INFERENCE_ERROR, {
                             "error": "too many concurrent requests",
                             "requestId": req_id})

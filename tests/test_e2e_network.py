@@ -306,3 +306,52 @@ def test_concurrent_chats_one_session_multiplex():
         await server.stop()
 
     run(main())
+
+
+def test_per_peer_concurrency_cap():
+    """One peer's request flood is rejected past maxConcurrentRequests;
+    other peers are unaffected."""
+    async def main():
+        hub = MemoryTransport()
+        server_ident = Identity.from_name("cap-server")
+        server = SymmetryServer(server_ident, hub, ping_interval_s=30.0)
+        await server.start("mem://server")
+        cfg = make_config(server_ident.public_hex, name="cap-prov",
+                          model="echo-model")
+        cfg._config["maxConcurrentRequests"] = 2
+        # slow backend: streams stay in flight while the flood arrives, so
+        # rejections are GUARANTEED (a fast echo could drain between
+        # sends and pass this test without exercising the cap)
+        from tests.test_failover import SlowBackend
+
+        provider = SymmetryProvider(cfg, transport=hub,
+                                    identity=Identity.from_name("cap-prov"),
+                                    backend=SlowBackend(delay=0.05, n=10),
+                                    server_address="mem://server")
+        await provider.start("mem://cap-prov")
+        await provider.wait_registered()
+        client = SymmetryClient(Identity.from_name("cap-cli"), hub)
+        details = await client.request_provider(
+            "mem://server", server_ident.public_key, "echo-model")
+        session = await client.connect(details)
+        results = await asyncio.gather(
+            *(session.chat_text([{"role": "user", "content": f"r{i}"}])
+              for i in range(6)),
+            return_exceptions=True)
+        ok = [r for r in results if isinstance(r, str)]
+        rejected = [r for r in results if isinstance(r, Exception)]
+        assert ok, results  # some complete
+        assert rejected, results  # and the cap actually fired
+        assert all("too many concurrent" in str(r) for r in rejected)
+        # a SECOND peer still works even while the first is flooding
+        client2 = SymmetryClient(Identity.from_name("cap-cli2"), hub)
+        other = await client2.connect(await client2.request_provider(
+            "mem://server", server_ident.public_key, "echo-model"))
+        assert await other.chat_text(
+            [{"role": "user", "content": "hello"}])
+        await other.close()
+        await session.close()
+        await provider.stop(drain_timeout_s=2)
+        await server.stop()
+
+    run(main())
