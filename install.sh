@@ -51,7 +51,7 @@ tpu:
   max_batch_size: 16
   max_seq_len: 2048
   prefill_buckets: [128, 512, 2048]
-  decode_block: 8
+  decode_block: 16
   # checkpoint_path: /path/to/hf/safetensors/dir
   # tokenizer_path: /path/to/tokenizer.json
 EOF

@@ -48,7 +48,10 @@ class TpuConfig:
     max_seq_len: int = 2048            # KV capacity per slot
     prefill_buckets: tuple[int, ...] = (128, 512, 2048)
     prefill_chunk: int | None = 256    # chunked-prefill step; None disables
-    decode_block: int = 8              # decode steps per device dispatch
+    # Decode steps per device dispatch. 16 measured throughput-equal to
+    # 64 at the llama3-8b/128-slot point (double-buffered dispatch hides
+    # the round-trips) with ~2x lower TTFT and inter-chunk latency.
+    decode_block: int = 16
     # "process" (default, production): the engine runs in a host
     # subprocess behind a pipe — its GIL-held device syncs would
     # otherwise starve the provider's event loop and every stream's
