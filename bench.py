@@ -23,6 +23,10 @@ import sys
 import time
 
 
+def _rnd(x, nd: int = 3):
+    return round(x, nd) if isinstance(x, (int, float)) else x
+
+
 def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
               max_seq: int, dtype_name: str, mesh_model: int,
               block: int = 1, quant: str | None = None,
@@ -179,7 +183,17 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 "w", suffix=".yaml", delete=False) as fh:
             yaml.safe_dump(cfg, fh)
             cfg_path = fh.name
-        log_path = os.environ.get("BENCH_PROVIDER_LOG", os.devnull)
+        # Provider log is ALWAYS captured (round-3 verdict #1: a 6-line
+        # log could not explain a 2x-outlier capture); the tail is echoed
+        # to stderr after the run. Per-run file — a fixed path would be
+        # clobbered by a concurrent bench on the same machine.
+        log_path = os.environ.get("BENCH_PROVIDER_LOG")
+        if not log_path:
+            with tempfile.NamedTemporaryFile(
+                    "w", prefix="bench_provider_", suffix=".log",
+                    delete=False) as lf:
+                log_path = lf.name
+        print(f"[bench] provider log: {log_path}", file=sys.stderr)
         log_fh = open(log_path, "w")
         proc = subprocess.Popen(
             [sys.executable, "-m", "symmetry_tpu.provider", "-c", cfg_path],
@@ -189,7 +203,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
         # Engine build + warmup runs in the provider process (minutes for
         # 8B: weight init + XLA compiles); none of it counts toward the
         # measured window. Registration marks readiness.
-        deadline = _time.monotonic() + 1800
+        t_start = _time.monotonic()
+        deadline = t_start + 1800
         while server.registry.select_provider(model_name) is None:
             if proc.poll() is not None:
                 raise RuntimeError(
@@ -197,6 +212,10 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             if _time.monotonic() > deadline:
                 raise TimeoutError("provider never registered")
             await asyncio.sleep(1.0)
+        startup_s = _time.monotonic() - t_start
+        print(f"[bench] provider registered after {startup_s:.0f}s "
+              f"(weight init + XLA compile + warmup; excluded from the "
+              f"measured window)", file=sys.stderr)
 
         prompt = "x" * prompt_chars
 
@@ -231,11 +250,29 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                     "tokens": tokens, "t_first": t_first or t_done,
                     "t_done": t_done, "stamps": stamps}
 
+        engine_stats: dict | None = None
         try:
             t0 = _time.perf_counter()
             results = await asyncio.gather(
                 *(one_client(i) for i in range(clients)))
             elapsed = _time.perf_counter() - t0
+            # Engine-side breakdown (scheduler phase counters, engine TTFT,
+            # admission dispatch + block-interval percentiles) — fetched
+            # while the provider is still up, so the capture can attribute
+            # a slow run to engine vs relay/wire.
+            try:
+                stats_client = SymmetryClient(
+                    Identity.from_name("bench-stats"), TcpTransport())
+                details = await stats_client.request_provider(
+                    server.address, server_ident.public_key, model_name)
+                stats_session = await stats_client.connect(details)
+                try:
+                    engine_stats = (await stats_session.stats()).get("engine")
+                finally:
+                    await stats_session.close()
+            except Exception as exc:  # noqa: BLE001 — diagnostics only
+                print(f"[bench] engine stats fetch failed: {exc!r}",
+                      file=sys.stderr)
         finally:
             proc.terminate()
             try:
@@ -289,12 +326,77 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
         if kv_quant:
             dtype_label += "+kv8"
 
+        # ------------------------------------------------------------------
+        # Per-phase breakdown (round-3 verdict #1): the capture must carry
+        # its own explanation. Ramp = burst start → every client streaming;
+        # steady = every client live; tail = first completion → last.
+        ramp_s = t1 - t0
+        steady_s = max(t2 - t1, 0.0)
+        tail_s = max(elapsed - (t2 - t0), 0.0)
+        phases = {
+            "startup_s": round(startup_s, 1),
+            "ramp_s": round(ramp_s, 2),
+            "steady_s": round(steady_s, 2),
+            "tail_s": round(tail_s, 2),
+        }
+        print(f"[bench] phases: startup {startup_s:.0f}s (excluded) | "
+              f"ramp {ramp_s:.1f}s (admission of {clients} prompts) | "
+              f"steady {steady_s:.1f}s @ "
+              f"{steady_tok_s and round(steady_tok_s) or '?'} tok/s | "
+              f"tail {tail_s:.1f}s", file=sys.stderr)
+
+        diag: dict = {}
+        if engine_stats:
+            ttft_h = engine_stats.get("engine_ttft_s") or {}
+            admit_h = engine_stats.get("admit_dispatch_s") or {}
+            ival_h = engine_stats.get("block_interval_s") or {}
+            diag = {
+                "engine_ttft_p50_s": _rnd(ttft_h.get("p50")),
+                "engine_ttft_p99_s": _rnd(ttft_h.get("p99")),
+                "admit_dispatches": engine_stats.get("admit_dispatches"),
+                "admit_dispatch_p99_s": _rnd(admit_h.get("p99")),
+                "admit_total_s": _rnd(engine_stats.get("admit_s")),
+                "block_interval_p50_s": _rnd(ival_h.get("p50")),
+                "block_interval_p99_s": _rnd(ival_h.get("p99")),
+                "block_syncs": engine_stats.get("block_syncs"),
+                "sync_total_s": _rnd(engine_stats.get("sync_s")),
+            }
+            print(
+                "[bench] engine: "
+                f"ttft p50/p99 {diag['engine_ttft_p50_s']}/"
+                f"{diag['engine_ttft_p99_s']}s | "
+                f"{diag['admit_dispatches']} admit dispatches "
+                f"(p99 {diag['admit_dispatch_p99_s']}s, "
+                f"total {diag['admit_total_s']}s) | "
+                f"block interval p50/p99 {diag['block_interval_p50_s']}/"
+                f"{diag['block_interval_p99_s']}s over "
+                f"{diag['block_syncs']} blocks",
+                file=sys.stderr)
+            # The attribution that mattered in round 3: wire TTFT far above
+            # engine TTFT means the stall is relay/wire/client-loop, not
+            # admission.
+            wire_p50 = pct(ttfts, 0.50)
+            eng_p50 = ttft_h.get("p50")
+            if eng_p50 and wire_p50 > 2.0 * eng_p50 + 1.0:
+                print(f"[bench] WARNING: wire TTFT p50 {wire_p50:.1f}s >> "
+                      f"engine TTFT p50 {eng_p50:.1f}s — the gap is in the "
+                      f"relay/wire/client loop, not the engine",
+                      file=sys.stderr)
+        try:
+            with open(log_path) as lf:
+                tail_lines = lf.readlines()[-8:]
+            print("[bench] provider log tail:", file=sys.stderr)
+            for ln in tail_lines:
+                print(f"  {ln.rstrip()}", file=sys.stderr)
+        except OSError:
+            pass
+
         return {
             "metric": f"e2e serving tok/s ({preset_name} {dtype_label}, "
                       f"{clients} streaming clients over TCP"
                       + (f" @ {stagger_s}s stagger" if stagger_s else
                          " (burst)")
-                      + f", {slots} slots, block {block}, "
+                      + f", {max_new} tok/req, {slots} slots, block {block}, "
                         f"provider subprocess, 1 tpu dev)",
             "value": round(tok_s, 1),
             "unit": "tok/s",
@@ -310,6 +412,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                                    if steady_tok_s else None),
             "inter_chunk_gap_p99_s": (round(gap_p99, 3)
                                       if gap_p99 is not None else None),
+            "phases": phases,
+            **({"engine": diag} if diag else {}),
         }
 
     return asyncio.new_event_loop().run_until_complete(main())
@@ -333,10 +437,16 @@ def main() -> None:
     ap.add_argument("--stagger", type=float, default=0.0,
                     help="seconds between client arrivals (--e2e); 0 = "
                          "thundering-herd burst, the worst-case TTFT")
-    ap.add_argument("--max-new", type=int, default=256,
-                    help="tokens per client request (--e2e)")
+    ap.add_argument("--max-new", type=int, default=512,
+                    help="tokens per client request (--e2e). 512 keeps the "
+                         "decode phase dominant over the admission ramp, so "
+                         "the aggregate number measures serving throughput "
+                         "rather than mostly ramp (round-3 verdict #1)")
     ap.add_argument("--prompt-len", type=int, default=128)
-    ap.add_argument("--max-seq", type=int, default=640)
+    ap.add_argument("--max-seq", type=int, default=704,
+                    help="KV capacity per slot; 704 = 128-token bucket + "
+                         "512 new tokens + 2 decode blocks of lookahead "
+                         "headroom (the scheduler's capacity guard)")
     ap.add_argument("--dtype", default="bfloat16",
                     choices=("bfloat16", "float32"))
     ap.add_argument("--mesh-model", type=int, default=1,

@@ -237,12 +237,21 @@ class ProviderSession:
         self._check_usable()
         self._ensure_reader()
         async with self._stats_lock:
+            # The reader may have exited while we awaited the lock — its
+            # single None sentinel would be eaten by the drain below and
+            # the get() would hang forever on a closed session.
+            self._check_usable()
             # a previously-timed-out stats() may have left its reply
             # queued; drain so this call gets ITS OWN snapshot
             while not self._stats_q.empty():
-                self._stats_q.get_nowait()
+                if self._stats_q.get_nowait() is None:
+                    raise ProviderGoneError("provider closed connection")
             await self._peer.send(MessageKey.METRICS)
-            data = await self._stats_q.get()
+            try:
+                data = await asyncio.wait_for(self._stats_q.get(), 30.0)
+            except asyncio.TimeoutError:
+                raise ProviderGoneError(
+                    "no stats reply within 30s") from None
             if data is None:
                 raise ProviderGoneError("provider closed during stats query")
             return data

@@ -139,6 +139,7 @@ class InferenceEngine:
         kv_quant: bool = False,
         pipeline_microbatches: int = 1,
         prefill_chunk: int | None = 256,
+        prefill_token_budget: int | None = None,
     ) -> None:
         self.config = config
         self.params = params
@@ -175,6 +176,11 @@ class InferenceEngine:
         if prefill_chunk is not None and prefill_chunk < 1:
             raise EngineError("prefill_chunk must be >= 1 (or None)")
         self.prefill_chunk = prefill_chunk
+        self.prefill_token_budget = (prefill_token_budget
+                                     if prefill_token_budget is not None
+                                     else self.PREFILL_TOKEN_BUDGET)
+        if self.prefill_token_budget < 1:
+            raise EngineError("prefill_token_budget must be >= 1")
 
         c = config
 
@@ -420,12 +426,27 @@ class InferenceEngine:
             f"bucket ({self.prefill_buckets[-1]})")
 
     # Coalesced-prefill batch sizes: one compiled prefill program per
-    # (batch, bucket) pair, so batch is bucketed too. Batch 8 was tried
-    # for admission bursts and OOM'd the llama3-8b@128-slot bench config
-    # (the transient prefill buffers tipped a ~15.6 GB HBM budget) —
-    # burst TTFT is instead bounded by the admission cap + chunked
-    # prefill (engine/scheduler.py).
-    PREFILL_BATCHES = (1, 2, 4)
+    # (batch, bucket) pair, so batch is bucketed too. The batch width is
+    # gated PER BUCKET by a token budget (batch × bucket ≤ budget): wide
+    # batches at the small buckets — a 128-client burst of 128-token
+    # prompts is 8 dispatches at batch 16 instead of 32 at batch 4, the
+    # direct driver of burst TTFT — while the big buckets stay narrow so
+    # the transient prefill buffers never tip the HBM budget (round-2's
+    # flat batch-8-at-every-bucket attempt OOM'd the llama3-8b@128-slot
+    # config; batch 4 × 2048 tokens was the peak, not batch 8 × 128).
+    PREFILL_BATCHES = (1, 2, 4, 8, 16)
+    PREFILL_TOKEN_BUDGET = 2048
+
+    def prefill_batches_for(self, bucket: int) -> tuple[int, ...]:
+        """Allowed coalesced-prefill batch sizes at `bucket` (ascending,
+        always contains 1). Capped by max_slots: a batch wider than the
+        slot count could be SELECTED at runtime (next-largest padding) but
+        is never compiled by warmup — the resulting mid-traffic XLA
+        compile is the exact stall warmup exists to prevent."""
+        budget = max(self.prefill_token_budget, bucket)
+        return tuple(b for b in self.PREFILL_BATCHES
+                     if b * bucket <= budget
+                     and (b == 1 or b <= self.max_slots))
 
     def prefill_and_insert(self, slot: int, prompt_ids: list[int],
                            sampling: SamplingParams) -> int:
@@ -436,22 +457,26 @@ class InferenceEngine:
     def prefill_and_insert_many(
         self, assignments: list[tuple[int, list[int], SamplingParams]],
     ) -> list[int]:
-        """Prefill several prompts in ONE device dispatch and install each
-        in its slot; returns their first tokens. Coalescing matters because
-        each dispatch pays a host↔device round-trip: admitting a burst of
-        arrivals one-by-one serializes that cost into the last request's
-        TTFT (SURVEY §7 hard-part 3)."""
+        """Prefill several prompts in as few device dispatches as the
+        bucket's batch budget allows and install each in its slot; returns
+        their first tokens. Coalescing matters because each dispatch pays
+        a host↔device round-trip: admitting a burst of arrivals one-by-one
+        serializes that cost into the last request's TTFT (SURVEY §7
+        hard-part 3). A group wider than the bucket's largest allowed
+        batch is split into consecutive dispatches."""
         if not assignments:
             return []
         if any(len(ids) == 0 for _, ids, _ in assignments):
             raise EngineError("empty prompt")
         n_req = len(assignments)
-        if n_req > self.PREFILL_BATCHES[-1]:
-            raise EngineError(
-                f"at most {self.PREFILL_BATCHES[-1]} prompts per coalesced "
-                f"prefill")
-        batch = next(b for b in self.PREFILL_BATCHES if b >= n_req)
         bucket = max(self.bucket_for(len(ids)) for _, ids, _ in assignments)
+        allowed = self.prefill_batches_for(bucket)
+        if n_req > allowed[-1]:
+            return [tok
+                    for start in range(0, n_req, allowed[-1])
+                    for tok in self.prefill_and_insert_many(
+                        assignments[start:start + allowed[-1]])]
+        batch = next(b for b in allowed if b >= n_req)
 
         padded = np.zeros((batch, bucket), np.int32)
         lens = np.zeros((batch,), np.int32)
@@ -600,10 +625,10 @@ class InferenceEngine:
         Call before the first insert — warmup advances device state with
         garbage that is only harmless on an empty cache."""
         self.state, _ = self._decode(self.params, self.state)
-        for batch in self.PREFILL_BATCHES:
-            if batch > self.max_slots:
-                continue
-            for bucket in self.prefill_buckets:
+        for bucket in self.prefill_buckets:
+            for batch in self.prefill_batches_for(bucket):
+                if batch > self.max_slots:
+                    continue
                 toks, prefix = self._prefill(
                     self.params, jnp.zeros((batch, bucket), jnp.int32),
                     jnp.ones((batch,), jnp.int32),
@@ -785,4 +810,6 @@ class InferenceEngine:
             kv_quant=tpu_cfg.kv_quantization == "int8",
             pipeline_microbatches=tpu_cfg.pipeline_microbatches,
             prefill_chunk=getattr(tpu_cfg, "prefill_chunk", 256),
+            prefill_token_budget=getattr(tpu_cfg, "prefill_token_budget",
+                                         None),
         )

@@ -57,7 +57,18 @@ class EngineHost:
     # ------------------------------------------------------------- lifecycle
 
     def start(self) -> None:
+        import time
+
+        from symmetry_tpu.utils.compile_cache import enable_compile_cache
+
+        # Persistent XLA compile cache (round-3 verdict #4): without it
+        # every host start recompiles the full serving grid (~90 s of the
+        # observed 94 s startup); with it a config-identical restart
+        # compiles ~nothing.
+        cache_dir = enable_compile_cache(self._config.tpu)
+        t0 = time.perf_counter()
         self._engine = InferenceEngine.from_tpu_config(self._config.tpu)
+        t_build = time.perf_counter() - t0
         sched_engine = self._engine
         mh = self._config.tpu.multihost
         if mh and mh.get("num_processes", 1) > 1:
@@ -70,15 +81,23 @@ class EngineHost:
             self._command_loop = CommandLoop(self._engine,
                                              is_coordinator=True)
             sched_engine = MultihostEngine(self._command_loop)
+        t1 = time.perf_counter()
         sched_engine.warmup()
+        t_warmup = time.perf_counter() - t1
         self._scheduler = Scheduler(sched_engine)
         self._scheduler.start()
         self._write({"op": "ready",
                      "model": self._config.model_name,
                      "slots": self._engine.max_slots,
-                     "max_seq_len": self._engine.max_seq_len})
+                     "max_seq_len": self._engine.max_seq_len,
+                     "build_s": round(t_build, 1),
+                     "warmup_s": round(t_warmup, 1)})
+        # Startup breakdown to stderr: a slow start must carry its own
+        # explanation in the provider log (round-3 verdict #1).
         logger.info(f"engine host ready: model={self._config.model_name} "
-                    f"slots={self._engine.max_slots}")
+                    f"slots={self._engine.max_slots} "
+                    f"build={t_build:.1f}s warmup={t_warmup:.1f}s "
+                    f"compile_cache={cache_dir or 'off'}")
 
     def serve_forever(self) -> int:
         self.start()
@@ -99,7 +118,9 @@ class EngineHost:
                 if req_id in self._reported:  # only live requests; a late
                     self._cancelled.add(req_id)  # cancel must not leak ids
             elif op == "stats":
-                m = dict(self._scheduler.metrics)
+                stats = getattr(self._scheduler, "stats", None)
+                m = stats() if stats is not None else dict(
+                    self._scheduler.metrics)
                 m["op"] = "stats"
                 # liveness of the engine thread — the wedged-decode-loop
                 # signal the provider's health loop needs (SURVEY §5.3)

@@ -92,7 +92,27 @@ class Scheduler:
         self._thread: threading.Thread | None = None
         self._stopping = threading.Event()
         self.metrics = {"requests": 0, "tokens": 0, "evictions": 0,
-                        "steps": 0, "peak_occupancy": 0}
+                        "steps": 0, "peak_occupancy": 0,
+                        # Per-phase wall accounting (round-3 verdict: a
+                        # benchmark capture must carry its own explanation):
+                        # admission prefill dispatches, chunked-prefill
+                        # advances, decode-block syncs — each phase's count
+                        # and cumulative seconds, read via stats().
+                        "admit_dispatches": 0, "admit_s": 0.0,
+                        "chunk_dispatches": 0, "chunk_s": 0.0,
+                        "block_syncs": 0, "sync_s": 0.0}
+        from symmetry_tpu.utils.trace import Histogram
+
+        # Engine-side latency distributions: TTFT as the scheduler saw it
+        # (enqueue → first sampled token), admission dispatch wall, and the
+        # interval between consecutive decode-block syncs while streams are
+        # active (the engine-side bound on any client's inter-chunk gap —
+        # if the client measures seconds and this says milliseconds, the
+        # stall is in the relay/wire, not the engine).
+        self._ttft_hist = Histogram()
+        self._admit_hist = Histogram()
+        self._interval_hist = Histogram()
+        self._last_sync_done: float | None = None
 
     # ------------------------------------------------------------- lifecycle
 
@@ -121,6 +141,15 @@ class Scheduler:
     @property
     def occupancy(self) -> int:
         return len(self._slots)
+
+    def stats(self) -> dict[str, Any]:
+        """Counters + engine-side latency percentiles (host stats op)."""
+        out: dict[str, Any] = dict(self.metrics)
+        out["occupancy"] = len(self._slots)
+        out["engine_ttft_s"] = self._ttft_hist.to_dict()
+        out["admit_dispatch_s"] = self._admit_hist.to_dict()
+        out["block_interval_s"] = self._interval_hist.to_dict()
+        return out
 
     # ------------------------------------------------------------- the loop
 
@@ -159,6 +188,9 @@ class Scheduler:
         while True:
             drained = self._admit_new()
             if not self._slots and pending is None and not self._prefill_jobs:
+                # Idle boundary: the next block interval would span the
+                # idle wait, which is not a serving stall.
+                self._last_sync_done = None
                 if self._stopping.is_set() and drained:
                     return
                 # Idle: block until work arrives (no busy spin). Engines
@@ -207,7 +239,14 @@ class Scheduler:
         """Sync one decode block to host and stream its tokens out."""
         import numpy as np
 
+        t0 = time.perf_counter()
         toks = np.asarray(device_toks)  # blocks on THIS block only
+        t1 = time.perf_counter()
+        self.metrics["block_syncs"] += 1
+        self.metrics["sync_s"] += t1 - t0
+        if self._last_sync_done is not None:
+            self._interval_hist.observe(t1 - self._last_sync_done)
+        self._last_sync_done = t1
         K = toks.shape[0]
         for slot, active in snapshot.items():
             if self._slots.get(slot) is not active:
@@ -252,13 +291,21 @@ class Scheduler:
         round-trips would otherwise serialize into the tail TTFT. `carry`
         is an already-popped request admitted ahead of the queue.
 
-        While streams are active, at most `admit_groups_per_block` groups
-        are placed per call: an admission burst (each group = one prefill
-        dispatch) would otherwise freeze every active stream for the whole
-        burst. With nothing active there is nobody to stall — drain freely."""
+        While streams are active, at most `admit_groups_per_block` prefill
+        DEVICE DISPATCHES are spent per call (a group spanning buckets
+        costs one per bucket chunk): an admission burst would otherwise
+        freeze every active stream for the whole burst. With nothing
+        active there is nobody to stall — drain freely."""
         many = getattr(self.engine, "prefill_and_insert_many", None)
-        batch_cap = (max(getattr(self.engine, "PREFILL_BATCHES", (1,)))
-                     if many is not None else 1)
+        batches_for = getattr(self.engine, "prefill_batches_for", None)
+        if many is None:
+            batch_cap = 1
+        elif batches_for is not None:
+            # Widest batch ANY bucket allows (the smallest bucket's cap);
+            # _place_group re-partitions by bucket before dispatching.
+            batch_cap = max(batches_for(self.engine.prefill_buckets[0]))
+        else:
+            batch_cap = max(getattr(self.engine, "PREFILL_BATCHES", (1,)))
         groups_left = (self._admit_groups
                        if (self._slots or self._prefill_jobs) else None)
         while self._free:
@@ -285,16 +332,21 @@ class Scheduler:
                 group.append((self._free.pop(), item))
             if not group:
                 return self._inbox.empty()
-            self._place_group(group)
+            done = self._place_group(group)
             if groups_left is not None:
-                groups_left -= 1
+                # Budgeted by DEVICE DISPATCH, not by group: a group that
+                # spans buckets (or exceeds a bucket's batch cap) costs
+                # several dispatches, and each one stalls active streams.
+                groups_left -= max(done, 1)
         if carry is not None:
             # No free slot took it (all busy): back to the queue rather
             # than dropping the request.
             self._inbox.put(carry)
         return self._inbox.empty()
 
-    def _place_group(self, group: list[tuple[int, GenRequest]]) -> None:
+    def _place_group(self, group: list[tuple[int, GenRequest]]) -> int:
+        """Admit `group`; returns the number of prefill DEVICE DISPATCHES
+        performed (the unit the per-block admission budget counts)."""
         # Requests the engine would reject (e.g. prompt beyond the largest
         # bucket) must fail individually, not poison the whole batch.
         wants_chunked = getattr(self.engine, "wants_chunked", None)
@@ -320,26 +372,55 @@ class Scheduler:
                 continue
             ready.append((slot, req))
         if not ready:
-            return
-        try:
-            if len(ready) > 1:
-                firsts = self.engine.prefill_and_insert_many(
-                    [(slot, req.prompt_ids, req.sampling)
-                     for slot, req in ready])
-            else:
-                slot0, req0 = ready[0]
-                firsts = [self.engine.prefill_and_insert(
-                    slot0, req0.prompt_ids, req0.sampling)]
-        except Exception as exc:  # noqa: BLE001 — engine errors → stream error
-            for slot, req in ready:
-                self._free.append(slot)
-                log.error(f"prefill failed for request {req.id}: {exc}")
-                self._emit_cb(req, TokenEvent(
-                    text="", token_id=None, done=True, finish_reason="error",
-                    error=str(exc)))
-            return
-        for (slot, req), first in zip(ready, firsts):
-            self._activate(slot, req, first)
+            return 0
+        # Partition by prefill bucket: the engine dispatches one coalesced
+        # prefill per bucket, and mixing a long prompt into a short-prompt
+        # group would drag every member into the long prompt's bucket
+        # (batch × big-bucket = the exact transient the per-bucket batch
+        # budget exists to bound). Each bucket subgroup is further split
+        # to the bucket's batch cap HERE (not inside the engine) so every
+        # device dispatch is individually counted and timed — the
+        # admission budget and the admit metrics both depend on it.
+        by_bucket: dict[int, list[tuple[int, GenRequest]]] = {}
+        for slot, req in ready:
+            by_bucket.setdefault(
+                self.engine.bucket_for(len(req.prompt_ids)), []).append(
+                    (slot, req))
+        batches_for = getattr(self.engine, "prefill_batches_for", None)
+        n_dispatches = 0
+        for bucket, subgroup in by_bucket.items():
+            cap = (max(batches_for(bucket)) if batches_for is not None
+                   else len(subgroup))
+            for start in range(0, len(subgroup), cap):
+                sub = subgroup[start:start + cap]
+                t0 = time.perf_counter()
+                try:
+                    if len(sub) > 1:
+                        firsts = self.engine.prefill_and_insert_many(
+                            [(slot, req.prompt_ids, req.sampling)
+                             for slot, req in sub])
+                    else:
+                        slot0, req0 = sub[0]
+                        firsts = [self.engine.prefill_and_insert(
+                            slot0, req0.prompt_ids, req0.sampling)]
+                except Exception as exc:  # noqa: BLE001 — engine errors → stream error
+                    n_dispatches += 1  # a failed dispatch still cost time
+                    for slot, req in sub:
+                        self._free.append(slot)
+                        log.error(
+                            f"prefill failed for request {req.id}: {exc}")
+                        self._emit_cb(req, TokenEvent(
+                            text="", token_id=None, done=True,
+                            finish_reason="error", error=str(exc)))
+                    continue
+                dt = time.perf_counter() - t0
+                n_dispatches += 1
+                self.metrics["admit_dispatches"] += 1
+                self.metrics["admit_s"] += dt
+                self._admit_hist.observe(dt)
+                for (slot, req), first in zip(sub, firsts):
+                    self._activate(slot, req, first)
+        return n_dispatches
 
     def _advance_prefills(self) -> None:
         """Run up to `prefill_chunks_per_block` prompt chunks, FIFO (the
@@ -358,6 +439,7 @@ class Scheduler:
                     text="", token_id=None, done=True,
                     finish_reason="cancelled"))
                 continue
+            t0 = time.perf_counter()
             try:
                 first = self.engine.advance_chunked_prefill(job)
             except Exception as exc:  # noqa: BLE001 — fail one, not all
@@ -368,6 +450,8 @@ class Scheduler:
                     text="", token_id=None, done=True, finish_reason="error",
                     error=str(exc)))
                 continue
+            self.metrics["chunk_dispatches"] += 1
+            self.metrics["chunk_s"] += time.perf_counter() - t0
             budget -= 1
             if first is not None:
                 self._prefill_jobs.pop(0)
@@ -377,6 +461,7 @@ class Scheduler:
         active = _ActiveSlot(req=req, decoder=self.engine.tokenizer.stream_decoder(),
                              prompt_len=len(req.prompt_ids))
         active.first_token_at = time.monotonic()
+        self._ttft_hist.observe(active.first_token_at - req.enqueued_at)
         self._slots[slot] = active
         self.metrics["peak_occupancy"] = max(self.metrics["peak_occupancy"],
                                              len(self._slots))

@@ -57,7 +57,7 @@ class TpuNativeBackend(InferenceBackend):
         self._started = False
         self._host_dead = False
         self._engine_alive = True  # host-reported scheduler liveness
-        self._stats_event: asyncio.Event | None = None
+        self._stats_waiters: list[asyncio.Future] = []
 
     @property
     def _process_mode(self) -> bool:
@@ -85,8 +85,11 @@ class TpuNativeBackend(InferenceBackend):
         self._started = True
 
     async def _start_inproc(self) -> None:
+        from symmetry_tpu.utils.compile_cache import enable_compile_cache
+
         tpu_cfg = self._config.tpu
         mh = tpu_cfg.multihost
+        enable_compile_cache(tpu_cfg)
 
         def build() -> InferenceEngine:
             return InferenceEngine.from_tpu_config(tpu_cfg)
@@ -155,11 +158,13 @@ class TpuNativeBackend(InferenceBackend):
             except ValueError:
                 continue
             if msg.get("op") == "stats":
-                # health probe reply: the host's stdin thread answered and
-                # reports whether the engine thread is still alive
+                # stats reply: liveness for the health loop + the full
+                # scheduler breakdown for engine_stats() consumers
                 self._engine_alive = bool(msg.get("engine_alive", True))
-                if self._stats_event is not None:
-                    self._stats_event.set()
+                waiters, self._stats_waiters = self._stats_waiters, []
+                for w in waiters:
+                    if not w.done():
+                        w.set_result(msg)
                 continue
             if msg.get("op") != "event":
                 continue
@@ -209,6 +214,41 @@ class TpuNativeBackend(InferenceBackend):
             self._scheduler = None
             self._engine = None
 
+    async def _probe_host_stats(self, timeout: float = 10.0) -> dict | None:
+        """One fresh stats round-trip to the host; None on timeout/failure
+        (a fire-and-forget probe would return the PREVIOUS probe's answer,
+        delaying wedge detection by a health-loop period)."""
+        import contextlib
+
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._stats_waiters.append(fut)
+        try:
+            with contextlib.suppress(ConnectionError, OSError):
+                await self._host_send({"op": "stats"})
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return None
+        finally:
+            if fut in self._stats_waiters:
+                self._stats_waiters.remove(fut)
+
+    async def engine_stats(self) -> dict | None:
+        """The scheduler's serving breakdown (counters, engine-side TTFT,
+        admission dispatch and block-interval percentiles) — surfaced
+        through provider METRICS so a benchmark capture can attribute
+        stalls to engine vs relay/wire (round-3 verdict #1/#3)."""
+        if self._proc is not None:
+            if self._host_dead or self._proc.returncode is not None:
+                return None
+            msg = await self._probe_host_stats()
+            if msg is None:
+                return None
+            return {k: v for k, v in msg.items() if k != "op"}
+        if self._scheduler is None:
+            return None
+        stats = getattr(self._scheduler, "stats", None)
+        return stats() if stats is not None else dict(self._scheduler.metrics)
+
     async def healthy(self) -> bool:
         """Engine liveness: a wedged decode loop must fail this (SURVEY §5.3
         — an engine wedge unregisters the provider). In process mode the
@@ -217,20 +257,8 @@ class TpuNativeBackend(InferenceBackend):
         if self._proc is not None:
             if self._host_dead or self._proc.returncode is not None:
                 return False
-            import contextlib
-
-            # Await the FRESH reply (a fire-and-forget probe would return
-            # the previous probe's answer, delaying wedge detection by a
-            # health-loop period). No reply within 10s = unhealthy.
-            self._stats_event = asyncio.Event()
-            with contextlib.suppress(ConnectionError, OSError):
-                await self._host_send({"op": "stats"})
-            try:
-                await asyncio.wait_for(self._stats_event.wait(), 10)
-            except asyncio.TimeoutError:
+            if await self._probe_host_stats() is None:
                 return False
-            finally:
-                self._stats_event = None
             return self._engine_alive
         if self._engine is None or self._scheduler is None:
             return False

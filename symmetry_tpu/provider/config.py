@@ -48,6 +48,9 @@ class TpuConfig:
     max_seq_len: int = 2048            # KV capacity per slot
     prefill_buckets: tuple[int, ...] = (128, 512, 2048)
     prefill_chunk: int | None = 256    # chunked-prefill step; None disables
+    # Coalesced-prefill width cap per bucket: batch × bucket ≤ budget
+    # (engine.prefill_batches_for). None → engine default (2048 tokens).
+    prefill_token_budget: int | None = None
     # Decode steps per device dispatch. 16 measured throughput-equal to
     # 64 at the llama3-8b/128-slot point (double-buffered dispatch hides
     # the round-trips) with ~2x lower TTFT and inter-chunk latency.
@@ -64,6 +67,10 @@ class TpuConfig:
     # the checkpoint on first load; restarts skip the whole conversion
     # (engine/weights.py save_warm_cache). SURVEY §5.4 warm restart.
     warm_cache: bool = True
+    # Persistent XLA compilation cache (utils/compile_cache.py): True →
+    # ~/.cache/symmetry_tpu/xla, a string → that directory, False → off.
+    # A config-identical engine restart then compiles ~nothing.
+    compile_cache: Any = True
     tokenizer_path: str | None = None   # tokenizer.json; None → byte tokenizer
     # Informational: every supported family (llama 3.x, mistral, qwen2,
     # mixtral-MoE, gemma) shares the decoder in models/llama.py, selected

@@ -452,8 +452,17 @@ class SymmetryProvider:
                     await peer.send(MessageKey.PONG)
                 elif msg.key == MessageKey.METRICS:
                     # Clients may query the serving snapshot (tok/s, TTFT
-                    # percentiles) — same payload the server receives.
-                    await peer.send(MessageKey.METRICS, self.stats())
+                    # percentiles) — same payload the server receives —
+                    # plus the engine scheduler's own breakdown when the
+                    # backend exposes one (tpu_native.engine_stats), so a
+                    # wire-side stall can be attributed engine vs relay.
+                    payload = self.stats()
+                    engine_stats = getattr(self.backend, "engine_stats",
+                                           None)
+                    if engine_stats is not None:
+                        with contextlib.suppress(Exception):
+                            payload["engine"] = await engine_stats()
+                    await peer.send(MessageKey.METRICS, payload)
                 elif msg.key == MessageKey.LEAVE:
                     break
         finally:

@@ -1,0 +1,94 @@
+"""Coalesced-prefill batch sweep at the bench point (round-3 verdict #2).
+
+Builds the north-star engine config (llama3-8b int8+kv8, 128 slots @ 640
+ctx, 128-token bucket) and times one prefill+insert dispatch at every
+allowed batch width, plus the compile cost of each (batch, bucket) grid
+point. The output answers: how many dispatches does a 128-prompt burst
+need, and what does each cost?
+
+Run on the real chip:  python tools/sweep_prefill.py
+Smoke (CPU, tiny):     python tools/sweep_prefill.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--preset", default="llama3-8b")
+    ap.add_argument("--slots", type=int, default=128)
+    ap.add_argument("--max-seq", type=int, default=640)
+    ap.add_argument("--bucket", type=int, default=128)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
+        args.preset, args.slots, args.max_seq, args.bucket = "tiny", 8, 64, 16
+
+    import jax.numpy as jnp
+
+    from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
+    from symmetry_tpu.engine.tokenizer import ByteTokenizer
+    from symmetry_tpu.models import init_params, preset
+    from symmetry_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    quant = not args.smoke
+    config = preset(args.preset)
+    dtype = jnp.float32 if args.smoke else jnp.bfloat16
+    t0 = time.perf_counter()
+    params = init_params(config, jax.random.key(0), dtype, quantize=quant)
+    print(f"param init: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    engine = InferenceEngine(
+        config, params, ByteTokenizer(), max_slots=args.slots,
+        max_seq_len=args.max_seq, prefill_buckets=(args.bucket,),
+        cache_dtype=dtype, decode_block=16, kv_quant=quant)
+
+    prompt = [p % 200 for p in range(1, args.bucket - 8)]
+    rows = []
+    for batch in engine.prefill_batches_for(args.bucket):
+        if batch > args.slots:
+            continue
+        # First call compiles (prefill + insert for this batch width).
+        t0 = time.perf_counter()
+        engine.prefill_and_insert_many(
+            [(s, prompt, SamplingParams(temperature=0.7, seed=s))
+             for s in range(batch)])
+        compile_s = time.perf_counter() - t0
+        times = []
+        for r in range(args.repeats):
+            t0 = time.perf_counter()
+            engine.prefill_and_insert_many(
+                [(s, prompt, SamplingParams(temperature=0.7, seed=s))
+                 for s in range(batch)])
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        rows.append({
+            "batch": batch,
+            "dispatch_s": round(best, 3),
+            "per_prompt_s": round(best / batch, 4),
+            "compile_s": round(compile_s, 1),
+            "dispatches_for_128": -(-128 // batch),
+            "ramp_s_for_128": round(best * (-(-128 // batch)), 1),
+        })
+        print(json.dumps(rows[-1]), file=sys.stderr)
+
+    print(json.dumps({"preset": args.preset, "bucket": args.bucket,
+                      "slots": args.slots, "sweep": rows}))
+
+
+if __name__ == "__main__":
+    main()
