@@ -200,34 +200,33 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             cwd=os.path.dirname(os.path.abspath(__file__)),
             stdout=log_fh, stderr=subprocess.STDOUT)
 
-        # Engine build + warmup runs in the provider process (minutes for
-        # 8B: weight init + XLA compiles); none of it counts toward the
-        # measured window. Registration marks readiness.
-        t_start = _time.monotonic()
-        deadline = t_start + 1800
-        while server.registry.select_provider(model_name) is None:
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"provider process exited rc={proc.returncode}")
-            if _time.monotonic() > deadline:
-                raise TimeoutError("provider never registered")
-            await asyncio.sleep(1.0)
-        startup_s = _time.monotonic() - t_start
-        print(f"[bench] provider registered after {startup_s:.0f}s "
-              f"(weight init + XLA compile + warmup; excluded from the "
-              f"measured window)", file=sys.stderr)
 
         prompt = "x" * prompt_chars
+        # All sessions handshake BEFORE any chat is sent (barrier below):
+        # the burst then measures the SERVING path against truly
+        # simultaneous arrivals — the worst case for admission — instead
+        # of smearing 128 Noise handshakes into the ramp, which both
+        # inflated TTFT with connection setup and made the measurement
+        # sensitive to handshake scheduling variance (round-4 finding:
+        # identical engine work, 6.2-9.2 s wire ramp across runs).
+        ready = asyncio.Event()
+        all_connected = asyncio.Event()
+        connected = 0
 
         async def one_client(i: int) -> dict:
             # stagger_s > 0 = steady-operation arrival pattern (one client
             # every stagger_s); 0 = thundering herd (worst-case TTFT)
-            await asyncio.sleep(i * stagger_s)
+            nonlocal connected
             client = SymmetryClient(Identity.from_name(f"bench-cli-{i}"),
                                     TcpTransport())
             details = await client.request_provider(
                 server.address, server_ident.public_key, model_name)
             session = await client.connect(details)
+            connected += 1
+            if connected == clients:
+                all_connected.set()
+            await ready.wait()
+            await asyncio.sleep(i * stagger_s)
             t_send = _time.perf_counter()
             t_first = None
             chars = 0
@@ -251,10 +250,42 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                     "t_done": t_done, "stamps": stamps}
 
         engine_stats: dict | None = None
+        provider_stats: dict | None = None
         try:
+            # Engine build + warmup runs in the provider process (minutes
+            # for 8B cold: weight init + XLA compiles); none of it counts
+            # toward the measured window. Registration marks readiness.
+            # Inside the try/finally: a never-registering provider must
+            # not leak the subprocess or the temp config.
+            t_start = _time.monotonic()
+            deadline = t_start + 1800
+            while server.registry.select_provider(model_name) is None:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"provider process exited rc={proc.returncode}")
+                if _time.monotonic() > deadline:
+                    raise TimeoutError("provider never registered")
+                await asyncio.sleep(1.0)
+            startup_s = _time.monotonic() - t_start
+            print(f"[bench] provider registered after {startup_s:.0f}s "
+                  f"(weight init + XLA compile + warmup; excluded from "
+                  f"the measured window)", file=sys.stderr)
+            tasks = [asyncio.ensure_future(one_client(i))
+                     for i in range(clients)]
+            # Release the burst only once every session is connected; a
+            # wedged/failed connection surfaces through the gather below.
+            t_connect0 = _time.perf_counter()
+            done_any = asyncio.ensure_future(
+                asyncio.wait(tasks, return_when=asyncio.FIRST_EXCEPTION))
+            await asyncio.wait(
+                [asyncio.ensure_future(all_connected.wait()), done_any],
+                timeout=120, return_when=asyncio.FIRST_COMPLETED)
+            connect_s = _time.perf_counter() - t_connect0
+            print(f"[bench] {connected}/{clients} sessions connected in "
+                  f"{connect_s:.1f}s; releasing the burst", file=sys.stderr)
             t0 = _time.perf_counter()
-            results = await asyncio.gather(
-                *(one_client(i) for i in range(clients)))
+            ready.set()
+            results = await asyncio.gather(*tasks)
             elapsed = _time.perf_counter() - t0
             # Engine-side breakdown (scheduler phase counters, engine TTFT,
             # admission dispatch + block-interval percentiles) — fetched
@@ -267,7 +298,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                     server.address, server_ident.public_key, model_name)
                 stats_session = await stats_client.connect(details)
                 try:
-                    engine_stats = (await stats_session.stats()).get("engine")
+                    provider_stats = await stats_session.stats()
+                    engine_stats = provider_stats.get("engine")
                 finally:
                     await stats_session.close()
             except Exception as exc:  # noqa: BLE001 — diagnostics only
@@ -347,10 +379,18 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
 
         diag: dict = {}
         if engine_stats:
+            # Three TTFT vantage points bracket any stall: engine (first
+            # sampled token), provider (first chunk leaving the backend
+            # for the wire), client (first delta received). engine ≈
+            # provider << client → the stall is wire/client-loop;
+            # provider >> engine → the host→provider relay.
+            prov_ttft = (provider_stats or {}).get("ttft_s") or {}
             ttft_h = engine_stats.get("engine_ttft_s") or {}
             admit_h = engine_stats.get("admit_dispatch_s") or {}
             ival_h = engine_stats.get("block_interval_s") or {}
             diag = {
+                "provider_ttft_p50_s": _rnd(prov_ttft.get("p50")),
+                "provider_ttft_p99_s": _rnd(prov_ttft.get("p99")),
                 "engine_ttft_p50_s": _rnd(ttft_h.get("p50")),
                 "engine_ttft_p99_s": _rnd(ttft_h.get("p99")),
                 "admit_dispatches": engine_stats.get("admit_dispatches"),
@@ -364,7 +404,9 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             print(
                 "[bench] engine: "
                 f"ttft p50/p99 {diag['engine_ttft_p50_s']}/"
-                f"{diag['engine_ttft_p99_s']}s | "
+                f"{diag['engine_ttft_p99_s']}s | provider ttft p50/p99 "
+                f"{diag['provider_ttft_p50_s']}/"
+                f"{diag['provider_ttft_p99_s']}s | "
                 f"{diag['admit_dispatches']} admit dispatches "
                 f"(p99 {diag['admit_dispatch_p99_s']}s, "
                 f"total {diag['admit_total_s']}s) | "
@@ -419,6 +461,142 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
     return asyncio.new_event_loop().run_until_complete(main())
 
 
+def run_proxy(*, clients: int, max_new: int, token_delay_s: float) -> dict:
+    """The PR1 REFERENCE POINT (BASELINE config 1): the reference's own
+    architecture — P2P glue proxying to an external OpenAI-compatible
+    HTTP server (reference hot loop: src/provider.ts:240-258). An in-repo
+    fake Ollama (tools/fake_ollama.py) stands in for the backend emitting
+    instantly, so the measured number is the proxy path's own throughput
+    ceiling and per-chunk overhead — the baseline the tpu_native numbers
+    are compared against."""
+    import asyncio
+    import hashlib
+    import os
+    import statistics
+    import subprocess
+    import sys
+    import tempfile
+    import time as _time
+
+    import yaml
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from fake_ollama import start_server
+
+    from symmetry_tpu.client.client import SymmetryClient
+    from symmetry_tpu.identity import Identity
+    from symmetry_tpu.server.broker import SymmetryServer
+    from symmetry_tpu.transport.tcp import TcpTransport
+
+    model_name = "llama3:8b"
+    server_ident = Identity.from_name("bench-proxy-server")
+
+    async def main() -> dict:
+        backend_runner, backend_port = await start_server(
+            "127.0.0.1", 0, token_delay_s)
+        server = SymmetryServer(server_ident, TcpTransport(),
+                                ping_interval_s=60.0)
+        await server.start("tcp://127.0.0.1:0")
+        cfg = {
+            "name": "bench-proxy-prov",
+            "public": True,
+            "serverKey": server_ident.public_hex,
+            "serverAddress": server.address,
+            "modelName": model_name,
+            "apiProvider": "ollama",
+            "apiProtocol": "http",
+            "apiHostname": "127.0.0.1",
+            "apiPort": backend_port,
+            "apiPath": "/v1/chat/completions",
+            "dataCollectionEnabled": False,
+            "maxConnections": clients + 8,
+            "listenHost": "127.0.0.1",
+            "privateSeed": hashlib.blake2b(
+                b"bench-proxy-seed", digest_size=32).hexdigest(),
+        }
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".yaml", delete=False) as fh:
+            yaml.safe_dump(cfg, fh)
+            cfg_path = fh.name
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "symmetry_tpu.provider", "-c", cfg_path],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+        async def one_client(i: int) -> dict:
+            client = SymmetryClient(
+                Identity.from_name(f"bench-proxy-cli-{i}"), TcpTransport())
+            details = await client.request_provider(
+                server.address, server_ident.public_key, model_name)
+            session = await client.connect(details)
+            t_send = _time.perf_counter()
+            t_first = None
+            chunks = 0
+            try:
+                async for delta in session.chat(
+                        [{"role": "user", "content": "benchmark prompt"}],
+                        max_tokens=max_new):
+                    now = _time.perf_counter()
+                    if t_first is None and delta:
+                        t_first = now
+                    chunks += 1
+            finally:
+                await session.close()
+            t_done = _time.perf_counter()
+            return {"ttft": (t_first or t_done) - t_send,
+                    "e2e": t_done - t_send, "chunks": chunks}
+
+        try:
+            # Registration wait inside the same try/finally that owns the
+            # teardown: a never-registering provider must not leak the
+            # subprocess, the temp config (it holds privateSeed), the
+            # routing server, or the fake backend.
+            deadline = _time.monotonic() + 120
+            while server.registry.select_provider(model_name) is None:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"proxy provider exited rc={proc.returncode}")
+                if _time.monotonic() > deadline:
+                    raise TimeoutError("proxy provider never registered")
+                await asyncio.sleep(0.5)
+            t0 = _time.perf_counter()
+            results = await asyncio.gather(
+                *(one_client(i) for i in range(clients)))
+            elapsed = _time.perf_counter() - t0
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            os.unlink(cfg_path)
+            await server.stop()
+            await backend_runner.cleanup()
+
+        chunks = sum(r["chunks"] for r in results)
+        ttfts = sorted(r["ttft"] for r in results)
+        tok_s = chunks / elapsed
+        return {
+            "metric": f"proxy-path serving tok/s (reference architecture: "
+                      f"fake-Ollama SSE backend, {clients} streaming "
+                      f"clients over TCP, provider subprocess)",
+            "value": round(tok_s, 1),
+            "unit": "tok/s",
+            "vs_baseline": round(tok_s / 2000.0, 3),
+            "ttft_p50_s": round(ttfts[len(ttfts) // 2], 4),
+            "ttft_p99_s": round(ttfts[min(len(ttfts) - 1,
+                                          int(0.99 * len(ttfts)))], 4),
+            "mean_e2e_s": round(statistics.mean(r["e2e"] for r in results), 3),
+            "chunks_streamed": chunks,
+            "per_chunk_overhead_ms": round(
+                1e3 * clients * elapsed / max(chunks, 1), 3),
+            "wall_s": round(elapsed, 2),
+        }
+
+    return asyncio.new_event_loop().run_until_complete(main())
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -429,6 +607,11 @@ def main() -> None:
                          "the DEFAULT when no mode flag is given)")
     ap.add_argument("--engine", action="store_true",
                     help="engine-only decode loop (no serving stack)")
+    ap.add_argument("--proxy", action="store_true",
+                    help="PR1 reference point: proxy backend against an "
+                         "in-repo fake-Ollama SSE server (no TPU)")
+    ap.add_argument("--proxy-delay", type=float, default=0.0,
+                    help="fake backend's per-chunk delay seconds (--proxy)")
     ap.add_argument("--preset", default="llama3-8b")
     ap.add_argument("--slots", type=int, default=128)
     ap.add_argument("--steps", type=int, default=192)
@@ -443,10 +626,15 @@ def main() -> None:
                          "the aggregate number measures serving throughput "
                          "rather than mostly ramp (round-3 verdict #1)")
     ap.add_argument("--prompt-len", type=int, default=128)
-    ap.add_argument("--max-seq", type=int, default=704,
-                    help="KV capacity per slot; 704 = 128-token bucket + "
+    ap.add_argument("--max-seq", type=int, default=672,
+                    help="KV capacity per slot; 672 = 128-token bucket + "
                          "512 new tokens + 2 decode blocks of lookahead "
-                         "headroom (the scheduler's capacity guard)")
+                         "(the scheduler's capacity guard), and the "
+                         "largest capacity that leaves the 128-slot "
+                         "llama3-8b config comfortable HBM slack for "
+                         "concurrent prefill transients (704 tripped a "
+                         "marginal RESOURCE_EXHAUSTED under a fully "
+                         "simultaneous 128-burst)")
     ap.add_argument("--dtype", default="bfloat16",
                     choices=("bfloat16", "float32"))
     ap.add_argument("--mesh-model", type=int, default=1,
@@ -487,6 +675,9 @@ def main() -> None:
                            block=2)
     elif args.engine:
         result = engine_bench()
+    elif args.proxy:
+        result = run_proxy(clients=args.clients, max_new=args.max_new,
+                           token_delay_s=args.proxy_delay)
     else:
         # Default = the north-star serving measurement (round-2 verdict
         # item 1: wire tok/s + TTFT percentiles). If the serving stack

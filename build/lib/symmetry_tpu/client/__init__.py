@@ -1,0 +1,3 @@
+from symmetry_tpu.client.client import SymmetryClient
+
+__all__ = ["SymmetryClient"]

@@ -1,0 +1,500 @@
+"""The Symmetry client: request a provider from the server, stream completions.
+
+The reference's client was refactored out of the repo (the test still imports
+`SymmetryClient` from ../src/client — __test__/cli.test.ts:1 — which no longer
+exists; SURVEY §0.1). This is its re-creation against our wire protocol:
+
+    client = SymmetryClient(identity, transport)
+    details = await client.request_provider(server_addr, server_key, "llama3:8b")
+    async with await client.connect(details) as session:
+        async for delta in session.chat([{"role": "user", "content": "hi"}]):
+            print(delta, end="")
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator
+
+from symmetry_tpu.identity import Identity
+from symmetry_tpu.network.peer import Peer
+from symmetry_tpu.protocol.keys import MessageKey
+from symmetry_tpu.provider.backends.proxy import (
+    get_chat_data_from_provider,
+    safe_parse_stream_response,
+)
+from symmetry_tpu.transport.base import Transport
+from symmetry_tpu.utils.logging import logger
+
+
+class ClientError(RuntimeError):
+    pass
+
+
+class ProviderGoneError(ClientError):
+    """The assigned provider died or closed mid-stream — the retryable
+    failure class. Request-level errors (bad messages, invalid session)
+    stay plain ClientError: replaying those on another provider would
+    burn the pool on a deterministically-bad request."""
+
+
+@dataclass(slots=True)
+class ProviderDetails:
+    peer_key: str
+    address: str | None
+    model_name: str
+    session_token: dict | None = None
+    session_id: str | None = None
+    data_collection: bool = False
+    provider_dialect: str = "openai"  # chunk format hint for delta extraction
+    raw: dict = field(default_factory=dict)
+
+
+@dataclass(slots=True)
+class ChatRestart:
+    """Failover marker: a new provider took over and generation restarted —
+    everything streamed before this event must be discarded."""
+
+    attempt: int
+    provider_key: str
+
+
+class ProviderSession:
+    """A live connection to one provider.
+
+    Requests are MULTIPLEXED: every chat carries a requestId the provider
+    echoes on each stream message, and one reader task routes messages to
+    per-request queues — so concurrent chat() calls on a single session
+    interleave correctly (the round-2 verdict's per-session-serialization
+    limit, rooted in the reference's id-less wire, src/provider.ts:195).
+    An abandoned stream is cancelled provider-side (inferenceCancel) and
+    its stragglers dropped, instead of desyncing the whole session."""
+
+    def __init__(self, peer: Peer, details: ProviderDetails) -> None:
+        self._peer = peer
+        self._details = details
+        # Usage of the last completed chat, from inferenceEnded:
+        # {"tokens": N, "chunks": M} (engine backends count exact tokens).
+        self.last_usage: dict | None = None
+        self._queues: dict[str, asyncio.Queue] = {}
+        self._stats_q: asyncio.Queue = asyncio.Queue()
+        self._stats_lock = asyncio.Lock()
+        self._reader: asyncio.Task | None = None
+        self._closed = False
+
+    def _ensure_reader(self) -> None:
+        if self._reader is None:
+            self._reader = asyncio.get_running_loop().create_task(
+                self._read_loop())
+
+    async def _read_loop(self) -> None:
+        """Single reader: routes stream messages by requestId."""
+        try:
+            while True:
+                msg = await self._peer.recv()
+                if msg is None:
+                    break
+                data = msg.data or {}
+                if msg.key == MessageKey.METRICS:
+                    self._stats_q.put_nowait(data)
+                    continue
+                req_id = str(data.get("requestId", ""))
+                q = self._queues.get(req_id)
+                if q is None and not req_id and self._queues:
+                    if len(self._queues) == 1:
+                        # version skew: a pre-multiplexing provider echoes
+                        # no requestId — with exactly one request in
+                        # flight the stream is unambiguous, so route it
+                        # there instead of hanging the caller forever
+                        q = next(iter(self._queues.values()))
+                    else:
+                        # multiple requests in flight against an id-less
+                        # provider: attribution is impossible — fail them
+                        # all loudly rather than dropping chunks and
+                        # deadlocking every caller on queue.get()
+                        logger.error(
+                            "provider echoes no requestId but multiple "
+                            "requests are in flight; failing them — use "
+                            "one chat at a time with this provider")
+                        for pending_q in self._queues.values():
+                            pending_q.put_nowait(None)
+                        self._queues.clear()
+                        continue
+                if q is not None:
+                    q.put_nowait(msg)
+                elif msg.key in (MessageKey.INFERENCE,
+                                 MessageKey.TOKEN_CHUNK,
+                                 MessageKey.INFERENCE_ENDED,
+                                 MessageKey.INFERENCE_ERROR):
+                    # straggler of an abandoned (cancelled) request — drop
+                    logger.debug(f"client: dropping stray {msg.key!r} "
+                                 f"for request {req_id or '?'}")
+                else:
+                    logger.debug(f"client: ignoring key {msg.key!r}")
+        finally:
+            self._closed = True
+            for q in self._queues.values():
+                q.put_nowait(None)  # wire gone
+            self._stats_q.put_nowait(None)
+
+    async def __aenter__(self) -> "ProviderSession":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    async def new_conversation(self) -> None:
+        await self._peer.send(MessageKey.NEW_CONVERSATION)
+
+    async def chat(
+        self,
+        messages: list[dict[str, str]],
+        *,
+        max_tokens: int | None = None,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        top_k: int | None = None,
+        seed: int | None = None,
+    ) -> AsyncIterator[str]:
+        """Send one inference request; yield text deltas as they stream.
+        Safe to call concurrently on one session (requestId multiplexing)."""
+        import uuid as _uuid
+
+        self._check_usable()
+        req_id = _uuid.uuid4().hex[:16]
+        payload: dict[str, Any] = {"key": "inference", "messages": messages,
+                                   "requestId": req_id}
+        if self._details.session_token is not None:
+            payload["sessionToken"] = self._details.session_token
+        for k, v in (("max_tokens", max_tokens), ("temperature", temperature),
+                     ("top_p", top_p), ("top_k", top_k), ("seed", seed)):
+            if v is not None:
+                payload[k] = v
+        self._ensure_reader()
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[req_id] = queue
+        ended = False
+        try:
+            await self._peer.send(MessageKey.INFERENCE, payload)
+            dialect = self._details.provider_dialect
+            while True:
+                msg = await queue.get()
+                if msg is None:
+                    ended = True  # wire gone; nothing left to misroute
+                    raise ProviderGoneError(
+                        "provider closed connection mid-stream")
+                if msg.key == MessageKey.INFERENCE:
+                    # stream-start marker; carries the backend dialect
+                    dialect = (msg.data or {}).get("provider", dialect)
+                elif msg.key == MessageKey.TOKEN_CHUNK:
+                    raw = (msg.data or {}).get("raw", "")
+                    parsed = safe_parse_stream_response(raw)
+                    if parsed is None:
+                        continue
+                    delta = get_chat_data_from_provider(dialect, parsed)
+                    if delta:
+                        yield delta
+                elif msg.key == MessageKey.INFERENCE_ENDED:
+                    ended = True
+                    data = msg.data or {}
+                    if data.get("cancelled"):
+                        # provider-side cancellation (shutdown/drain): a
+                        # truncated stream must look like provider death —
+                        # retryable — not a normal completion
+                        raise ProviderGoneError(
+                            "provider cancelled the stream")
+                    self.last_usage = data
+                    return
+                elif msg.key == MessageKey.INFERENCE_ERROR:
+                    ended = True
+                    raise ClientError(
+                        (msg.data or {}).get("error", "inference failed"))
+        finally:
+            self._queues.pop(req_id, None)
+            if not ended and not self._peer.closed:
+                # Abandoned mid-stream: cancel provider-side (frees the
+                # engine slot); any stragglers are dropped by the reader.
+                try:
+                    await self._peer.send(MessageKey.INFERENCE_CANCEL,
+                                          {"requestId": req_id})
+                except (ConnectionError, OSError):
+                    pass
+
+    def _check_usable(self) -> None:
+        if self._closed:
+            raise ProviderGoneError("session is closed")
+
+    async def chat_text(self, messages: list[dict[str, str]], **kw) -> str:
+        return "".join([d async for d in self.chat(messages, **kw)])
+
+    async def stats(self) -> dict:
+        """Query the provider's serving metrics snapshot (tok/s, TTFT/e2e
+        percentiles, occupancy).
+
+        Runs through the shared reader; concurrent with chats, serialized
+        only against other stats calls (metrics replies carry no id)."""
+        self._check_usable()
+        self._ensure_reader()
+        async with self._stats_lock:
+            # The reader may have exited while we awaited the lock — its
+            # single None sentinel would be eaten by the drain below and
+            # the get() would hang forever on a closed session.
+            self._check_usable()
+            # a previously-timed-out stats() may have left its reply
+            # queued; drain so this call gets ITS OWN snapshot
+            while not self._stats_q.empty():
+                if self._stats_q.get_nowait() is None:
+                    raise ProviderGoneError("provider closed connection")
+            await self._peer.send(MessageKey.METRICS)
+            try:
+                data = await asyncio.wait_for(self._stats_q.get(), 30.0)
+            except asyncio.TimeoutError:
+                raise ProviderGoneError(
+                    "no stats reply within 30s") from None
+            if data is None:
+                raise ProviderGoneError("provider closed during stats query")
+            return data
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._reader is not None:
+            self._reader.cancel()
+        if not self._peer.closed:
+            try:
+                await self._peer.send(MessageKey.LEAVE)
+            except (ConnectionError, OSError):
+                pass
+        await self._peer.close()
+
+
+class SymmetryClient:
+    def __init__(self, identity: Identity | None = None,
+                 transport: Transport | None = None) -> None:
+        self.identity = identity or Identity.generate()
+        if transport is None:
+            from symmetry_tpu.transport.tcp import TcpTransport
+
+            transport = TcpTransport()  # CLI passes transport_for(server)
+        self._transport = transport
+
+    async def request_provider(
+        self, server_address: str, server_key: bytes, model_name: str | None = None,
+        timeout: float = 10.0, exclude: list[str] | None = None,
+    ) -> ProviderDetails:
+        """Ask the server for a provider assignment (requestProvider →
+        providerDetails, reference keys src/constants.ts:16,14). `exclude`
+        lists peer keys the server must not hand back (failover re-request
+        after a provider died)."""
+        conn = await self._transport.dial(server_address)
+        peer = await Peer.connect(
+            conn, self.identity, initiator=True, expected_remote_key=server_key
+        )
+        try:
+            req: dict[str, Any] = {"modelName": model_name}
+            if exclude:
+                req["excludePeers"] = list(exclude)
+            await peer.send(MessageKey.REQUEST_PROVIDER, req)
+            msg = await asyncio.wait_for(peer.recv(), timeout)
+            if msg is None or msg.key != MessageKey.PROVIDER_DETAILS:
+                raise ClientError(f"unexpected server reply: {msg and msg.key}")
+            data = msg.data or {}
+            if "error" in data:
+                raise ClientError(data["error"])
+            prov = data.get("provider") or {}
+            return ProviderDetails(
+                peer_key=prov.get("peerKey", ""),
+                address=prov.get("address"),
+                model_name=prov.get("modelName", model_name or ""),
+                session_token=data.get("sessionToken"),
+                session_id=data.get("sessionId"),
+                data_collection=bool(prov.get("dataCollectionEnabled", False)),
+                raw=data,
+            )
+        finally:
+            await peer.close()
+
+    async def list_models(self, server_address: str, server_key: bytes,
+                          timeout: float = 10.0) -> list[dict]:
+        conn = await self._transport.dial(server_address)
+        peer = await Peer.connect(
+            conn, self.identity, initiator=True, expected_remote_key=server_key
+        )
+        try:
+            await peer.send(MessageKey.PROVIDER_LIST)
+            msg = await asyncio.wait_for(peer.recv(), timeout)
+            return (msg.data or {}).get("models", []) if msg else []
+        finally:
+            await peer.close()
+
+    async def chat_failover(
+        self,
+        server_address: str,
+        server_key: bytes,
+        model_name: str,
+        messages: list[dict[str, str]],
+        *,
+        attempts: int = 3,
+        **chat_kw,
+    ) -> AsyncIterator[str | "ChatRestart"]:
+        """Streaming chat with provider failover.
+
+        If the assigned provider dies before the stream completes, the
+        server is asked for a FRESH provider (the dead one excluded — its
+        sessions were invalidated server-side) and generation restarts.
+        A restart yields a ChatRestart sentinel first: text streamed from
+        the dead provider is void and consumers must discard it (a
+        half-finished completion cannot be resumed token-exactly on
+        another node). chat_text_failover does that bookkeeping for you.
+        """
+        dead: list[str] = []
+        last_exc: Exception | None = None
+        for attempt in range(attempts):
+            try:
+                details = await self.request_provider(
+                    server_address, server_key, model_name, exclude=dead)
+            except ClientError as exc:
+                last_exc = exc
+                break  # no provider left to fail over to
+            if attempt > 0:
+                yield ChatRestart(attempt=attempt,
+                                  provider_key=details.peer_key)
+            try:
+                # relay_via: a NAT-only provider (direct dial fails, the
+                # server splice works) is serviceable, not dead
+                session = await self.connect(
+                    details, relay_via=(server_address, server_key))
+            except (ClientError, ConnectionError, OSError) as exc:
+                last_exc = exc
+                if details.peer_key:
+                    dead.append(details.peer_key)
+                continue
+            try:
+                async for delta in session.chat(messages, **chat_kw):
+                    yield delta
+                return
+            except (ProviderGoneError, ConnectionError, OSError) as exc:
+                # Only provider-death failures fail over. A request-level
+                # ClientError (bad messages, rejected params) propagates:
+                # replaying it elsewhere would fail identically while
+                # blacklisting healthy providers.
+                last_exc = exc
+                if details.peer_key:
+                    dead.append(details.peer_key)
+            finally:
+                await session.close()
+        raise ClientError(
+            f"chat failed after {attempts} provider attempt(s): {last_exc}")
+
+    async def chat_text_failover(self, server_address: str, server_key: bytes,
+                                 model_name: str,
+                                 messages: list[dict[str, str]],
+                                 **kw) -> str:
+        """chat_failover collected to a final string (restart-aware)."""
+        parts: list[str] = []
+        async for item in self.chat_failover(server_address, server_key,
+                                             model_name, messages, **kw):
+            if isinstance(item, ChatRestart):
+                parts.clear()  # the dead provider's partial text is void
+            else:
+                parts.append(item)
+        return "".join(parts)
+
+    async def connect(self, details: ProviderDetails,
+                      *, relay_via: tuple[str, bytes] | None = None
+                      ) -> ProviderSession:
+        """Dial a provider directly, pinning its key from providerDetails.
+
+        With `relay_via=(server_address, server_key)`, a failed direct
+        dial falls back to the server-spliced relay (network/relay.py) —
+        the reference's behind-NAT reachability leg."""
+        if not details.address and relay_via is None:
+            raise ClientError("provider has no dialable address")
+        expected = bytes.fromhex(details.peer_key) if details.peer_key else None
+        conn = None
+        if details.address:
+            try:
+                conn = await self._transport.dial(details.address)
+            except (ConnectionError, OSError) as exc:
+                if relay_via is None:
+                    raise
+                logger.info(f"direct dial {details.address} failed ({exc}); "
+                            f"falling back to relay")
+        if conn is None:
+            assert relay_via is not None
+            if not details.peer_key:
+                raise ClientError("relay requires the provider's key")
+            conn = await self.connect_relay(relay_via[0], relay_via[1],
+                                            details.peer_key)
+        peer = await Peer.connect(
+            conn, self.identity, initiator=True, expected_remote_key=expected
+        )
+        return ProviderSession(peer, details)
+
+    async def connect_relay(self, server_address: str, server_key: bytes,
+                            provider_key_hex: str):
+        """Open a server-spliced relay channel to a provider (the Noise
+        handshake with the provider then runs THROUGH it — the server
+        carries only ciphertext)."""
+        from symmetry_tpu.network.relay import RelayedConnection, await_ready
+
+        conn = await self._transport.dial(server_address)
+        server_peer = await Peer.connect(
+            conn, self.identity, initiator=True,
+            expected_remote_key=server_key)
+        try:
+            await server_peer.send(MessageKey.RELAY_CONNECT,
+                                   {"providerKey": provider_key_hex})
+            # the relayId arrives in relayReady (shared wait helper —
+            # one refusal-handling implementation for both roles)
+            relay_id = await await_ready(server_peer)
+        except ConnectionError as exc:
+            await server_peer.close()
+            raise ClientError(str(exc)) from exc
+        except BaseException:
+            # failed setup must not leak the dialed server connection —
+            # failover retries would accumulate sockets
+            await server_peer.close()
+            raise
+        return RelayedConnection(server_peer, relay_id)
+
+    async def connect_direct(self, address: str, provider_key: bytes | None = None,
+                             model_name: str = "") -> ProviderSession:
+        """Direct connection to a known (possibly private) provider."""
+        details = ProviderDetails(
+            peer_key=provider_key.hex() if provider_key else "",
+            address=address,
+            model_name=model_name,
+        )
+        return await self.connect(details)
+
+    async def discover(self, provider_key: bytes,
+                       bootstrap: list[str]) -> ProviderDetails:
+        """Decentralized discovery: resolve a provider by public key over
+        the Kademlia DHT (network/dht.py) — no central server involved.
+        Topic = discovery_key(provider_key), the reference's hyperswarm
+        topic semantics. Raises ClientError when nobody has announced."""
+        from symmetry_tpu.identity import discovery_key
+        from symmetry_tpu.network.dht import DHTNode, parse_host_port
+
+        try:
+            boot = [parse_host_port(e) for e in bootstrap]
+        except ValueError as exc:
+            raise ClientError(str(exc)) from None
+        node = DHTNode()
+        await node.start("0.0.0.0", 0, bootstrap=boot)
+        try:
+            peers = await node.lookup(discovery_key(provider_key))
+        finally:
+            await node.stop()
+        want = provider_key.hex()
+        for peer in peers:
+            if peer.get("publicKey") == want and peer.get("address"):
+                return ProviderDetails(
+                    peer_key=want,
+                    address=peer["address"],
+                    model_name=peer.get("modelName", ""),
+                    raw=peer,
+                )
+        raise ClientError(
+            f"provider {want[:12]}… not found on the DHT")

@@ -1,0 +1,9 @@
+"""The TPU inference engine — the half of the system the reference never had.
+
+The reference delegated all compute to an external OpenAI-compatible HTTP
+server (reference: src/provider.ts:210-214). This package replaces that leg
+with an in-process JAX/XLA engine: HF safetensors stream straight onto a
+pjit-sharded mesh (weights.py), prefill/decode run as jitted pure functions
+over a slot-based KV cache, and a continuous-batching scheduler turns slots
+into per-request token streams (SURVEY §7 stages 4-5).
+"""

@@ -1,0 +1,815 @@
+"""The serving engine: jitted prefill / insert / decode over a slot batch.
+
+Shape discipline (SURVEY §7 hard-part 1 — continuous batching under jit
+without recompile storms):
+
+  - PREFILL runs at batch 1, prompt padded to one of a few fixed buckets
+    (tpu.prefill_buckets) — one compiled program per bucket, ever.
+  - INSERT copies the prefilled KV prefix into slot `i` of the shared decode
+    cache with dynamic_update_slice — shapes static, slot index dynamic.
+  - DECODE advances ALL slots one token per step at a fixed [B, 1] shape;
+    per-slot raggedness lives in position/length arrays, not shapes.
+
+All three are donated-state jits: the decode cache (the big HBM tenant) is
+updated in place, never copied. Sampling controls are per-slot device arrays
+so one compiled step serves mixed greedy/sampled requests.
+
+The engine is synchronous and single-threaded by design — the asyncio bridge
+lives in the scheduler (scheduler.py), mirroring how the reference keeps all
+concurrency in one event loop (SURVEY §5.2).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from symmetry_tpu.models.llama import (
+    KVCache,
+    ModelConfig,
+    cache_logical_axes,
+    forward_hidden,
+    init_cache,
+    init_params,
+    logits_from_hidden,
+    preset,
+)
+
+
+from symmetry_tpu.ops.sampling import sample_tokens
+from symmetry_tpu.parallel.mesh import MeshSpec, build_mesh
+from symmetry_tpu.parallel.sharding import shardings_for
+from symmetry_tpu.engine.tokenizer import Tokenizer, get_tokenizer
+
+
+def _stage_rules(mesh):
+    """PIPELINE_RULES when the mesh has an active stage axis, else None —
+    the ONE place pipeline-mode detection lives (constructor, jit builder,
+    and from_tpu_config all route through it)."""
+    if mesh is not None and dict(mesh.shape).get("stage", 1) > 1:
+        from symmetry_tpu.parallel.pipeline import PIPELINE_RULES
+
+        return PIPELINE_RULES
+    return None
+
+
+class EngineError(RuntimeError):
+    pass
+
+
+class DecodeState(NamedTuple):
+    """Everything the decode step needs, all static-shape device arrays."""
+
+    cache: KVCache            # [L, B, T, K, D] x2 + lengths [B]
+    last_token: jnp.ndarray   # [B] int32 — token to feed next step
+    temperature: jnp.ndarray  # [B] float32
+    top_p: jnp.ndarray        # [B] float32
+    top_k: jnp.ndarray        # [B] int32
+    rng: jax.Array            # [B] PRNG keys — one stream PER SLOT, seeded
+                              # at insert: a seeded request reproduces its
+                              # whole completion and no slot's sampling is
+                              # perturbed by other traffic
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.0
+    top_p: float = 1.0
+    top_k: int = 0
+    seed: int | None = None
+
+    @classmethod
+    def from_request(cls, req: Any) -> "SamplingParams":
+        return cls(
+            temperature=req.temperature if req.temperature is not None else 0.0,
+            top_p=req.top_p if req.top_p is not None else 1.0,
+            top_k=getattr(req, "top_k", None) or 0,
+            seed=req.seed,
+        )
+
+
+@dataclass
+class ChunkedPrefill:
+    """An in-progress chunked prefill: one prompt's KV prefix being built
+    chunk-by-chunk so long-prompt admission never stalls active decode
+    streams for more than ~one chunk (round-2 verdict: a 2048-bucket
+    prefill froze every stream for ~0.6 s)."""
+
+    slot: int
+    ids: np.ndarray           # [1, n_chunks * C] padded prompt
+    true_len: int
+    n_chunks: int
+    cache: Any                # batch-1 prefix KVCache (bucket capacity)
+    temp: jnp.ndarray         # [1]
+    top_p: jnp.ndarray        # [1]
+    top_k: jnp.ndarray        # [1]
+    prefill_key: jax.Array    # [1] PRNG for the first-token sample
+    decode_key: jax.Array     # [1] PRNG stream carried into decode
+    done_chunks: int = 0
+
+    @property
+    def remaining_chunks(self) -> int:
+        return self.n_chunks - self.done_chunks
+
+
+class InferenceEngine:
+    """Owns params + decode state; exposes prefill/insert/decode primitives.
+
+    Thread-safety: NOT thread-safe; exactly one thread (the scheduler's
+    engine thread) may call the mutating methods.
+    """
+
+    def __init__(
+        self,
+        config: ModelConfig,
+        params: Any,
+        tokenizer: Tokenizer,
+        *,
+        mesh=None,
+        max_slots: int = 8,
+        max_seq_len: int = 2048,
+        prefill_buckets: tuple[int, ...] = (128, 512, 2048),
+        cache_dtype=jnp.bfloat16,
+        decode_block: int = 1,
+        kv_quant: bool = False,
+        pipeline_microbatches: int = 1,
+        prefill_chunk: int | None = 256,
+        prefill_token_budget: int | None = None,
+    ) -> None:
+        self.config = config
+        self.params = params
+        self.tokenizer = tokenizer
+        self.mesh = mesh
+        # Pipeline-parallel serving (parallel/pipeline.py): a stage axis of
+        # size > 1 routes prefill AND decode through the staged microbatch
+        # schedule; params/cache must be stage-sharded (PIPELINE_RULES).
+        self._rules = _stage_rules(mesh)
+        self.pipeline = self._rules is not None
+        if self.pipeline and max_slots % pipeline_microbatches:
+            raise EngineError(
+                f"max_slots {max_slots} must divide into "
+                f"{pipeline_microbatches} pipeline microbatches")
+        if pipeline_microbatches > 1 and not self.pipeline:
+            raise EngineError(
+                "pipeline_microbatches > 1 requires a mesh with a stage "
+                "axis > 1 — the setting would otherwise be silently inert")
+        self.pipeline_microbatches = pipeline_microbatches
+        self.max_slots = max_slots
+        self.max_seq_len = max_seq_len
+        self.prefill_buckets = tuple(sorted(b for b in prefill_buckets
+                                            if b <= max_seq_len))
+        if not self.prefill_buckets:
+            raise EngineError("no prefill bucket fits within max_seq_len")
+        self.cache_dtype = cache_dtype
+        self.kv_quant = kv_quant
+        if decode_block < 1:
+            raise EngineError("decode_block must be >= 1")
+        # Prompts that leave less than decode_block headroom finish right
+        # after their first token (scheduler admission check), so buckets up
+        # to max_seq_len are allowed — they just can't decode far.
+        self.decode_block = decode_block
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise EngineError("prefill_chunk must be >= 1 (or None)")
+        self.prefill_chunk = prefill_chunk
+        self.prefill_token_budget = (prefill_token_budget
+                                     if prefill_token_budget is not None
+                                     else self.PREFILL_TOKEN_BUDGET)
+        if self.prefill_token_budget < 1:
+            raise EngineError("prefill_token_budget must be >= 1")
+
+        c = config
+
+        if mesh is not None:
+            rules = self._rules
+            cax = cache_logical_axes(quantized=kv_quant)
+            rep = jax.NamedSharding(mesh, jax.sharding.PartitionSpec())
+            sc = (shardings_for(cax.k_scale, mesh, rules)
+                  if kv_quant else None)
+            self._cache_shardings = KVCache(
+                k=shardings_for(cax.k, mesh, rules),
+                v=shardings_for(cax.v, mesh, rules),
+                # lengths stays REPLICATED (O(slots) int32): the host reads
+                # individual slots, and on a multi-process data axis a
+                # batch-sharded slot may live on another host.
+                lengths=rep,
+                k_scale=sc, v_scale=sc,
+            )
+            self._state_shardings = DecodeState(
+                cache=self._cache_shardings, last_token=rep, temperature=rep,
+                top_p=rep, top_k=rep, rng=rep)
+        else:
+            self._cache_shardings = None
+            self._state_shardings = None
+
+        def _init_state() -> DecodeState:
+            return DecodeState(
+                cache=init_cache(c, max_slots, max_seq_len, cache_dtype,
+                                 quantized=kv_quant),
+                last_token=jnp.zeros((max_slots,), jnp.int32),
+                temperature=jnp.zeros((max_slots,), jnp.float32),
+                top_p=jnp.ones((max_slots,), jnp.float32),
+                top_k=jnp.zeros((max_slots,), jnp.int32),
+                rng=jax.random.split(jax.random.key(0), max_slots),
+            )
+
+        if self._state_shardings is not None:
+            # Initial placement must match the jits' out_shardings exactly
+            # (donated-buffer aliasing on the first insert), and must work
+            # when the mesh spans processes — jit-with-out_shardings creates
+            # the global arrays in place; device_put of host values cannot
+            # address other hosts' devices.
+            self.state = jax.jit(_init_state,
+                                 out_shardings=self._state_shardings)()
+        else:
+            self.state = _init_state()
+
+        self._base_key = jax.random.key(
+            int.from_bytes(os.urandom(4), "little"))
+        self._requests_served = 0
+
+        self._build_jits()
+
+    # ------------------------------------------------------------------
+    # Jitted primitives
+
+    def _build_jits(self) -> None:
+        cfg = self.config
+
+        def trunk(params, tokens, cache, seq_lens=None, prefill_flash=False):
+            """forward_hidden, routed through the pipeline schedule when a
+            stage axis is active (params/cache are stage-sharded then)."""
+            if self.pipeline:
+                from symmetry_tpu.parallel.pipeline import (
+                    pipeline_forward_hidden)
+
+                n_micro = (self.pipeline_microbatches
+                           if tokens.shape[0] == self.max_slots else 1)
+                return pipeline_forward_hidden(
+                    params, cfg, tokens, cache, self.mesh,
+                    seq_lens=seq_lens, n_microbatches=n_micro,
+                    prefill_flash=prefill_flash)
+            return forward_hidden(params, cfg, tokens, cache,
+                                  seq_lens=seq_lens,
+                                  prefill_flash=prefill_flash)
+
+        def prefill(params, tokens, true_len, temp, top_p, top_k, rng):
+            """tokens [N, Sb] padded; returns (first tokens [N], prefix KV).
+
+            N > 1 is COALESCED prefill (scheduler batches concurrent
+            arrivals into one dispatch — each dispatch costs a full
+            host↔device round-trip, so admission bursts would otherwise
+            serialize into p99 TTFT)."""
+            N, S = tokens.shape
+            cache = init_cache(cfg, N, S, self.cache_dtype,
+                               quantized=self.kv_quant)
+            h, cache = trunk(params, tokens, cache,
+                             seq_lens=true_len, prefill_flash=True)
+            # Project ONLY the last valid position through the LM head —
+            # head cost is per-position × vocab, and padded positions are
+            # garbage anyway.
+            h_last = jnp.take_along_axis(
+                h, (true_len - 1)[:, None, None].astype(jnp.int32),
+                axis=1)  # [N, 1, E]
+            last = logits_from_hidden(params, cfg, h_last)[:, 0]  # [N, V]
+            toks = sample_tokens(last, rng, temp, top_p, top_k)  # [N] keys
+            return toks, cache
+
+        def insert(state: DecodeState, prefix: KVCache, row, slot, true_len,
+                   first_token, temp, top_p, top_k, rng) -> DecodeState:
+            """Copy row `row` of a batch-N prefilled prefix into decode
+            slot `slot` (scalars arrive as [N] arrays, indexed by row)."""
+
+            def place(big, small_batch):
+                # big [L,B,T,...] <- small_batch[:, row] at [:, slot, 0]
+                # (KV payloads are rank 5, scale planes rank 4)
+                sizes = (small_batch.shape[0], 1) + small_batch.shape[2:]
+                src = (0, row) + (0,) * (small_batch.ndim - 2)
+                small = jax.lax.dynamic_slice(small_batch, src, sizes)
+                start = (0, slot, 0) + (0,) * (big.ndim - 3)
+                return jax.lax.dynamic_update_slice(
+                    big, small.astype(big.dtype), start)
+
+            cache = state.cache._replace(
+                k=place(state.cache.k, prefix.k),
+                v=place(state.cache.v, prefix.v),
+                # The first sampled token's KV is not here yet: the next
+                # decode step writes it at position true_len.
+                lengths=state.cache.lengths.at[slot].set(true_len[row]),
+                **({"k_scale": place(state.cache.k_scale, prefix.k_scale),
+                    "v_scale": place(state.cache.v_scale, prefix.v_scale)}
+                   if self.kv_quant else {}),
+            )
+            return DecodeState(
+                cache=cache,
+                last_token=state.last_token.at[slot].set(first_token[row]),
+                temperature=state.temperature.at[slot].set(temp[row]),
+                top_p=state.top_p.at[slot].set(top_p[row]),
+                top_k=state.top_k.at[slot].set(top_k[row]),
+                # The request's own PRNG stream continues into decode: a
+                # seeded request reproduces its whole completion.
+                rng=state.rng.at[slot].set(rng[row]),
+            )
+
+        def insert_all(state: DecodeState, prefix: KVCache, slots,
+                       true_len, first_token, temp, top_p, top_k,
+                       rng) -> DecodeState:
+            """Install EVERY row of a coalesced prefill in ONE dispatch —
+            per-row insert calls each cost a host↔device round-trip
+            (~100 ms over a tunnel), which dominated burst-admission TTFT.
+            Pad rows carry the last real request's slot: re-inserting
+            identical data to the same slot is idempotent."""
+
+            def body(i, st):
+                return insert(st, prefix, i, slots[i], true_len,
+                              first_token, temp, top_p, top_k, rng)
+
+            return jax.lax.fori_loop(0, slots.shape[0], body, state)
+
+        def chunk_step(params, tokens, cache, seq_len):
+            """Extend a batch-1 prefix cache by one prompt chunk. Attention
+            runs the continuation path (absolute-position masking against
+            the cache written by earlier chunks) — prefill_flash's
+            empty-cache contract doesn't hold past chunk 0."""
+            _, cache = trunk(params, tokens, cache, seq_lens=seq_len)
+            return cache
+
+        def chunk_final(params, tokens, cache, seq_len, last_idx,
+                        temp, top_p, top_k, rng):
+            """Last chunk: also project the final valid position and sample
+            the first token (mirrors `prefill`'s tail)."""
+            h, cache = trunk(params, tokens, cache, seq_lens=seq_len)
+            h_last = jnp.take_along_axis(
+                h, last_idx[:, None, None].astype(jnp.int32), axis=1)
+            last = logits_from_hidden(params, cfg, h_last)[:, 0]
+            toks = sample_tokens(last, rng, temp, top_p, top_k)
+            return toks, cache
+
+        def decode_one(state: DecodeState, params):
+            """Advance every slot one token."""
+            h, cache = trunk(params, state.last_token[:, None], state.cache)
+            logits = logits_from_hidden(params, cfg, h)
+            split = jax.vmap(lambda k: jax.random.split(k, 2))(state.rng)
+            rng, step_key = split[:, 0], split[:, 1]
+            toks = sample_tokens(logits[:, 0], step_key, state.temperature,
+                                 state.top_p, state.top_k)
+            return DecodeState(
+                cache=cache, last_token=toks, temperature=state.temperature,
+                top_p=state.top_p, top_k=state.top_k, rng=rng,
+            ), toks
+
+        def decode_block(params, state: DecodeState):
+            """K decode steps in ONE dispatch. Host→device round-trips cost
+            ~100ms here (remote chip); amortizing them K× is the difference
+            between ~80 and >1000 tok/s aggregate (SURVEY §7 hard-part 3:
+            streaming latency discipline). Returns (state, tokens [K, B])."""
+            return jax.lax.scan(
+                lambda s, _: decode_one(s, params), state, None,
+                length=self.decode_block)
+
+        state_shard = self._state_shardings
+        if self.mesh is not None:
+            # Host-read outputs (sampled tokens) must be fully replicated —
+            # on a multi-process mesh np.asarray of a sharded global array
+            # is not addressable. The prefill KV prefix keeps the cache's
+            # kv_heads-on-model sharding; its batch dim (1) stays unsharded.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(self.mesh, P())
+            # Same rules as the decode cache, minus the batch axis (the
+            # prefix has batch 1) — derived from the shared rules table so
+            # the layouts can't silently diverge (parallel/sharding.py).
+            from symmetry_tpu.parallel.sharding import DEFAULT_RULES
+
+            base_rules = self._rules or DEFAULT_RULES
+            cax = cache_logical_axes(quantized=self.kv_quant)
+            prefix_rules = {**base_rules, "batch": None}
+            psc = (shardings_for(cax.k_scale, self.mesh, prefix_rules)
+                   if self.kv_quant else None)
+            prefix_shard = KVCache(
+                k=shardings_for(cax.k, self.mesh, prefix_rules),
+                v=shardings_for(cax.v, self.mesh, prefix_rules),
+                lengths=rep,
+                k_scale=psc, v_scale=psc,
+            )
+            self._prefix_shard = prefix_shard
+            self._prefill = jax.jit(prefill,
+                                    out_shardings=(rep, prefix_shard))
+            self._decode = jax.jit(decode_block, donate_argnums=(1,),
+                                   out_shardings=(state_shard, rep))
+            self._chunk_step = jax.jit(chunk_step, donate_argnums=(2,),
+                                       out_shardings=prefix_shard)
+            self._chunk_final = jax.jit(chunk_final, donate_argnums=(2,),
+                                        out_shardings=(rep, prefix_shard))
+        else:
+            self._prefill = jax.jit(prefill)
+            self._decode = jax.jit(decode_block, donate_argnums=(1,))
+            self._chunk_step = jax.jit(chunk_step, donate_argnums=(2,))
+            self._chunk_final = jax.jit(chunk_final, donate_argnums=(2,))
+        self._insert_all = jax.jit(
+            insert_all, donate_argnums=(0,),
+            out_shardings=state_shard)
+
+    # ------------------------------------------------------------------
+    # Host-side API (called by the scheduler's engine thread)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.prefill_buckets:
+            if prompt_len <= b:
+                return b
+        raise EngineError(
+            f"prompt of {prompt_len} tokens exceeds the largest prefill "
+            f"bucket ({self.prefill_buckets[-1]})")
+
+    # Coalesced-prefill batch sizes: one compiled prefill program per
+    # (batch, bucket) pair, so batch is bucketed too. The batch width is
+    # gated PER BUCKET by a token budget (batch × bucket ≤ budget): wide
+    # batches at the small buckets — a 128-client burst of 128-token
+    # prompts is 8 dispatches at batch 16 instead of 32 at batch 4, the
+    # direct driver of burst TTFT — while the big buckets stay narrow so
+    # the transient prefill buffers never tip the HBM budget (round-2's
+    # flat batch-8-at-every-bucket attempt OOM'd the llama3-8b@128-slot
+    # config; batch 4 × 2048 tokens was the peak, not batch 8 × 128).
+    PREFILL_BATCHES = (1, 2, 4, 8, 16)
+    PREFILL_TOKEN_BUDGET = 2048
+
+    def prefill_batches_for(self, bucket: int) -> tuple[int, ...]:
+        """Allowed coalesced-prefill batch sizes at `bucket` (ascending,
+        always contains 1). Capped by max_slots: a batch wider than the
+        slot count could be SELECTED at runtime (next-largest padding) but
+        is never compiled by warmup — the resulting mid-traffic XLA
+        compile is the exact stall warmup exists to prevent."""
+        budget = max(self.prefill_token_budget, bucket)
+        return tuple(b for b in self.PREFILL_BATCHES
+                     if b * bucket <= budget
+                     and (b == 1 or b <= self.max_slots))
+
+    def prefill_and_insert(self, slot: int, prompt_ids: list[int],
+                           sampling: SamplingParams) -> int:
+        """Prefill a prompt and install it in `slot`; returns first token."""
+        return self.prefill_and_insert_many(
+            [(slot, prompt_ids, sampling)])[0]
+
+    def prefill_and_insert_many(
+        self, assignments: list[tuple[int, list[int], SamplingParams]],
+    ) -> list[int]:
+        """Prefill several prompts in as few device dispatches as the
+        bucket's batch budget allows and install each in its slot; returns
+        their first tokens. Coalescing matters because each dispatch pays
+        a host↔device round-trip: admitting a burst of arrivals one-by-one
+        serializes that cost into the last request's TTFT (SURVEY §7
+        hard-part 3). A group wider than the bucket's largest allowed
+        batch is split into consecutive dispatches."""
+        if not assignments:
+            return []
+        if any(len(ids) == 0 for _, ids, _ in assignments):
+            raise EngineError("empty prompt")
+        n_req = len(assignments)
+        bucket = max(self.bucket_for(len(ids)) for _, ids, _ in assignments)
+        allowed = self.prefill_batches_for(bucket)
+        if n_req > allowed[-1]:
+            return [tok
+                    for start in range(0, n_req, allowed[-1])
+                    for tok in self.prefill_and_insert_many(
+                        assignments[start:start + allowed[-1]])]
+        batch = next(b for b in allowed if b >= n_req)
+
+        padded = np.zeros((batch, bucket), np.int32)
+        lens = np.zeros((batch,), np.int32)
+        temps = np.zeros((batch,), np.float32)
+        top_ps = np.ones((batch,), np.float32)
+        top_ks = np.zeros((batch,), np.int32)
+        prefill_keys, decode_keys = [], []
+        slots_arr = np.zeros((batch,), np.int32)
+        for i in range(batch):
+            # Pad rows replay the last request BIT-IDENTICALLY — same
+            # prompt, same slot, and (below) the same PRNG keys. They are
+            # inserted (insert_all covers every row), so anything short of
+            # an identical overwrite would corrupt the last real slot's
+            # state: a pad row with fresh entropy would sample a DIFFERENT
+            # first token and leave decode conditioned on a token the
+            # client never saw.
+            slot, ids, sampling = assignments[min(i, n_req - 1)]
+            slots_arr[i] = slot
+            padded[i, :len(ids)] = ids
+            lens[i] = len(ids)
+            temps[i] = sampling.temperature
+            top_ps[i] = sampling.top_p
+            top_ks[i] = sampling.top_k
+            if i >= n_req:
+                prefill_keys.append(prefill_keys[n_req - 1])
+                decode_keys.append(decode_keys[n_req - 1])
+                continue
+            if sampling.seed is not None:
+                key = jax.random.key(sampling.seed)
+            else:
+                # Per-request entropy: a fixed per-slot key would make the
+                # same unseeded prompt sample identically every time.
+                self._requests_served += 1
+                key = jax.random.fold_in(self._base_key,
+                                         self._requests_served)
+            pk, dk = jax.random.split(key)
+            prefill_keys.append(pk)
+            decode_keys.append(dk)
+
+        lens_arr = jnp.asarray(lens)
+        temps_arr = jnp.asarray(temps)
+        top_ps_arr = jnp.asarray(top_ps)
+        top_ks_arr = jnp.asarray(top_ks)
+        decode_keys_arr = jnp.stack(decode_keys)
+        toks, prefix = self._prefill(
+            self.params, jnp.asarray(padded), lens_arr, temps_arr,
+            top_ps_arr, top_ks_arr, jnp.stack(prefill_keys))
+        # One dispatch installs every row; pad rows re-write the last
+        # real slot with bit-identical data (same prompt AND keys above).
+        self.state = self._insert_all(
+            self.state, prefix, jnp.asarray(slots_arr), lens_arr,
+            toks, temps_arr, top_ps_arr, top_ks_arr, decode_keys_arr)
+        host_toks = np.asarray(toks)
+        return [int(host_toks[i]) for i in range(n_req)]
+
+    # ------------------------------------------------------------------
+    # Chunked prefill (long prompts, interleaved with decode blocks)
+
+    def wants_chunked(self, prompt_len: int) -> bool:
+        """True when this prompt should prefill chunk-by-chunk: more than
+        one chunk long (a single-chunk prompt IS one dispatch already)."""
+        return (self.prefill_chunk is not None
+                and prompt_len > self.prefill_chunk)
+
+    def start_chunked_prefill(self, slot: int, prompt_ids: list[int],
+                              sampling: SamplingParams) -> ChunkedPrefill:
+        """Begin a chunked prefill for `slot`; drive it to completion with
+        advance_chunked_prefill (one device dispatch per call)."""
+        if not prompt_ids:
+            raise EngineError("empty prompt")
+        C = self.prefill_chunk
+        assert C is not None
+        true_len = len(prompt_ids)
+        bucket = self.bucket_for(true_len)  # validates length; cache size
+        n_chunks = -(-true_len // C)
+        padded = np.zeros((1, n_chunks * C), np.int32)
+        padded[0, :true_len] = prompt_ids
+
+        if sampling.seed is not None:
+            key = jax.random.key(sampling.seed)
+        else:
+            self._requests_served += 1
+            key = jax.random.fold_in(self._base_key, self._requests_served)
+        pk, dk = jax.random.split(key)
+
+        cache = self._new_prefix_cache(bucket)
+        return ChunkedPrefill(
+            slot=slot, ids=padded, true_len=true_len, n_chunks=n_chunks,
+            cache=cache,
+            temp=jnp.asarray([sampling.temperature], jnp.float32),
+            top_p=jnp.asarray([sampling.top_p], jnp.float32),
+            top_k=jnp.asarray([sampling.top_k], jnp.int32),
+            prefill_key=pk[None], decode_key=dk[None],
+        )
+
+    def advance_chunked_prefill(self, job: ChunkedPrefill) -> int | None:
+        """Run ONE chunk; returns the first sampled token when the prompt
+        is complete (the slot is then live), else None."""
+        C = self.prefill_chunk
+        c0 = job.done_chunks * C
+        chunk = jnp.asarray(job.ids[:, c0:c0 + C])
+        valid = jnp.asarray([min(C, job.true_len - c0)], jnp.int32)
+        last = job.done_chunks == job.n_chunks - 1
+        if not last:
+            job.cache = self._chunk_step(self.params, chunk, job.cache,
+                                         valid)
+            job.done_chunks += 1
+            return None
+        last_idx = jnp.asarray([job.true_len - 1 - c0], jnp.int32)
+        toks, cache = self._chunk_final(
+            self.params, chunk, job.cache, valid, last_idx,
+            job.temp, job.top_p, job.top_k, job.prefill_key)
+        job.done_chunks += 1
+        job.cache = None  # old buffer was donated to chunk_final; poison reuse
+        # same (batch=1, bucket) insert program the prefill warmup grid
+        # compiled — no chunk-specific insert compile
+        self.state = self._insert_all(
+            self.state, cache, jnp.asarray([job.slot], jnp.int32),
+            jnp.asarray([job.true_len], jnp.int32), toks,
+            job.temp, job.top_p, job.top_k, job.decode_key)
+        return int(np.asarray(toks)[0])
+
+    def _new_prefix_cache(self, capacity: int):
+        """Fresh batch-1 prefix cache, created sharded-in-place (jit with
+        out_shardings) so multi-process meshes work like _init_state."""
+        c = self.config
+
+        def make():
+            return init_cache(c, 1, capacity, self.cache_dtype,
+                              quantized=self.kv_quant)
+
+        if self.mesh is not None:
+            return jax.jit(make, out_shardings=self._prefix_shard)()
+        return jax.jit(make)()
+
+    def release_slot(self, slot: int) -> None:
+        """A finished slot's cache lane is garbage until reuse (insert
+        resets it); nothing to do device-side — the hook exists so the
+        scheduler's slot lifecycle has a single engine-visible seam."""
+
+    def warmup(self) -> None:
+        """Compile every serving program before traffic: decode, and the
+        full (PREFILL_BATCHES × prefill_buckets) prefill/insert grid. A
+        fresh XLA compile mid-traffic (~30 s on a real chip) would stall
+        every active stream — the first coalesced burst must not pay it.
+        Call before the first insert — warmup advances device state with
+        garbage that is only harmless on an empty cache."""
+        self.state, _ = self._decode(self.params, self.state)
+        for bucket in self.prefill_buckets:
+            for batch in self.prefill_batches_for(bucket):
+                if batch > self.max_slots:
+                    continue
+                toks, prefix = self._prefill(
+                    self.params, jnp.zeros((batch, bucket), jnp.int32),
+                    jnp.ones((batch,), jnp.int32),
+                    jnp.zeros((batch,), jnp.float32),
+                    jnp.ones((batch,), jnp.float32),
+                    jnp.zeros((batch,), jnp.int32),
+                    jax.random.split(jax.random.key(0), batch))
+                # insert_all compiles per (batch, bucket) too; slot 0
+                # with true_len 0 leaves the state semantically untouched.
+                self.state = self._insert_all(
+                    self.state, prefix, jnp.zeros((batch,), jnp.int32),
+                    jnp.zeros((batch,), jnp.int32), toks,
+                    jnp.zeros((batch,), jnp.float32),
+                    jnp.ones((batch,), jnp.float32),
+                    jnp.zeros((batch,), jnp.int32),
+                    jax.random.split(jax.random.key(0), batch))
+        # Chunked-prefill programs: one (step, final) pair per bucket that
+        # can hold a multi-chunk prompt. A mid-traffic compile would be the
+        # exact stall chunking exists to prevent.
+        C = self.prefill_chunk
+        if C is not None:
+            one = jnp.ones((1,), jnp.int32)
+            for bucket in self.prefill_buckets:
+                if bucket <= C:
+                    continue
+                cache = self._new_prefix_cache(bucket)
+                cache = self._chunk_step(
+                    self.params, jnp.zeros((1, C), jnp.int32), cache, one)
+                toks, cache = self._chunk_final(
+                    self.params, jnp.zeros((1, C), jnp.int32), cache, one,
+                    jnp.zeros((1,), jnp.int32),
+                    jnp.zeros((1,), jnp.float32), jnp.ones((1,), jnp.float32),
+                    jnp.zeros((1,), jnp.int32),
+                    jax.random.split(jax.random.key(0), 1))
+                # batch-1 insert at this bucket already compiled above
+
+    def decode_steps_dispatch(self) -> jax.Array:
+        """Dispatch one decode block WITHOUT syncing: returns the [K, B]
+        device token array as a future. JAX async dispatch lets the caller
+        enqueue block N+1 and only then block on block N's tokens, so the
+        host-side work (transfer, detokenize, emit) overlaps block N+1's
+        device execution (SURVEY §7 hard-part 3: double-buffered token
+        fetch)."""
+        self.state, toks = self._decode(self.params, self.state)
+        return toks
+
+    def decode_steps(self) -> np.ndarray:
+        """decode_block tokens for every slot; host gets [K, B] int32."""
+        return np.asarray(self.decode_steps_dispatch())
+
+    def decode_step(self) -> np.ndarray:
+        """One decode step [B] (requires decode_block == 1; tests/bench)."""
+        assert self.decode_block == 1, "decode_step needs decode_block=1"
+        return self.decode_steps()[0]
+
+    def slot_length(self, slot: int) -> int:
+        return int(self.state.cache.lengths[slot])
+
+    @property
+    def slot_capacity(self) -> int:
+        return self.max_seq_len
+
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_tpu_config(cls, tpu_cfg: Any, *, platform_devices=None
+                        ) -> "InferenceEngine":
+        """Build from a provider.yaml `tpu:` section (provider/config.py).
+
+        With `tpu.multihost` set, joins the jax.distributed job first and
+        builds the hybrid DCN×ICI mesh over the GLOBAL device set — every
+        process (rank 0 and workers) constructs the engine identically.
+        """
+        mesh_spec = MeshSpec.from_dict(tpu_cfg.mesh)
+        if tpu_cfg.multihost:
+            from symmetry_tpu.parallel.multihost import (
+                build_multihost_mesh, init_distributed)
+
+            mh = tpu_cfg.multihost
+            init_distributed(mh["coordinator"], mh["num_processes"],
+                             mh.get("process_id", 0))
+            mesh = build_multihost_mesh(mesh_spec, mh.get("dcn_data", 1))
+        else:
+            devices = platform_devices or jax.devices()
+            mesh = build_mesh(mesh_spec, devices) if mesh_spec.size > 1 else None
+
+        dtypes = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+                  "float16": jnp.float16}
+        if tpu_cfg.dtype not in dtypes:
+            raise EngineError(f"unsupported tpu.dtype {tpu_cfg.dtype!r}; "
+                              f"expected one of {sorted(dtypes)}")
+        dtype = dtypes[tpu_cfg.dtype]
+
+        if tpu_cfg.quantization not in (None, "int8"):
+            raise EngineError(
+                f"unsupported tpu.quantization {tpu_cfg.quantization!r}")
+        if tpu_cfg.kv_quantization not in (None, "int8"):
+            raise EngineError(
+                f"unsupported tpu.kv_quantization {tpu_cfg.kv_quantization!r}")
+        quant = tpu_cfg.quantization == "int8"
+
+        # Pipeline mode (mesh stage > 1): params shard their layer dim over
+        # the stage axis instead of replicating it.
+        rules = _stage_rules(mesh)
+
+        if tpu_cfg.checkpoint_path:
+            from symmetry_tpu.engine.weights import (
+                load_checkpoint, load_warm_cache, save_warm_cache)
+            from symmetry_tpu.utils.logging import logger
+
+            # Warm restart (SURVEY §5.4): the finished tree — stacked,
+            # transposed, quantized — is cached beside the checkpoint on
+            # first load; restarts mmap it straight to device.
+            warm = None
+            # Single-process only, for BOTH directions: on a multi-host
+            # mesh, a cache present on some hosts but not others would
+            # send processes down divergent load paths and hang the first
+            # cross-host collective.
+            use_warm = (getattr(tpu_cfg, "warm_cache", True)
+                        and jax.process_count() == 1)
+            if use_warm:
+                try:
+                    warm = load_warm_cache(
+                        tpu_cfg.checkpoint_path, dtype=dtype,
+                        quantize=quant, mesh=mesh, rules=rules)
+                except Exception as exc:  # noqa: BLE001 — cache is advisory
+                    logger.warning(f"warm cache unreadable, cold load: {exc}")
+            if warm is not None:
+                params, config = warm
+                logger.info("weights loaded from warm cache")
+            else:
+                params, config = load_checkpoint(
+                    tpu_cfg.checkpoint_path, mesh=mesh, rules=rules,
+                    dtype=dtype)
+                if quant:
+                    from symmetry_tpu.models.llama import quantize_params
+
+                    params = quantize_params(params)
+                if use_warm:
+                    try:
+                        save_warm_cache(tpu_cfg.checkpoint_path, params,
+                                        config, dtype=dtype, quantize=quant)
+                        logger.info("warm weight cache written")
+                    except Exception as exc:  # noqa: BLE001
+                        logger.warning(f"warm cache not written: {exc}")
+        else:
+            config = preset(tpu_cfg.model_preset or "tiny")
+            if mesh is not None:
+                from symmetry_tpu.models.llama import param_logical_axes
+
+                # Initialize directly as global sharded arrays (works when
+                # the mesh spans processes; device_put of host values
+                # cannot). Quantized leaves init int8 in the same program.
+                axes = param_logical_axes(config)
+                if quant:
+                    from symmetry_tpu.models.llama import (
+                        quantized_logical_axes)
+
+                    axes = quantized_logical_axes(axes)
+                shardings = shardings_for(axes, mesh, rules)
+                params = jax.jit(
+                    lambda: init_params(config, jax.random.key(0), dtype,
+                                        quantize=quant),
+                    out_shardings=shardings)()
+            else:
+                params = init_params(config, jax.random.key(0), dtype,
+                                     quantize=quant)
+        # Tokenizer after config resolution: the byte fallback must span
+        # the MODEL's vocab or sampled ids stream as silence (tokenizer.py).
+        tokenizer = get_tokenizer(tpu_cfg.tokenizer_path,
+                                  vocab_size=config.vocab_size)
+        return cls(
+            config, params, tokenizer, mesh=mesh,
+            max_slots=tpu_cfg.max_batch_size,
+            max_seq_len=tpu_cfg.max_seq_len,
+            prefill_buckets=tpu_cfg.prefill_buckets,
+            cache_dtype=dtype,
+            decode_block=getattr(tpu_cfg, "decode_block", 1),
+            kv_quant=tpu_cfg.kv_quantization == "int8",
+            pipeline_microbatches=tpu_cfg.pipeline_microbatches,
+            prefill_chunk=getattr(tpu_cfg, "prefill_chunk", 256),
+            prefill_token_budget=getattr(tpu_cfg, "prefill_token_budget",
+                                         None),
+        )

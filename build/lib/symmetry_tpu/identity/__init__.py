@@ -1,0 +1,16 @@
+from symmetry_tpu.identity.identity import Identity, discovery_key
+from symmetry_tpu.identity.noise import (
+    HandshakeError,
+    SecureSession,
+    client_handshake,
+    server_handshake,
+)
+
+__all__ = [
+    "Identity",
+    "discovery_key",
+    "HandshakeError",
+    "SecureSession",
+    "client_handshake",
+    "server_handshake",
+]

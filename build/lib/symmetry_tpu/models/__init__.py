@@ -1,0 +1,31 @@
+"""Model zoo: TPU-native decoder-only LMs the engine can serve.
+
+The reference contains no model code at all — it proxies every request to an
+external OpenAI-compatible server (reference: src/provider.ts:210-214,
+src/constants.ts:22-29). These models are the in-process replacement: pure
+functional JAX (params are pytrees, forward is a jittable function), layers
+stacked and scanned for O(1) compile cost in depth, every parameter tagged
+with logical sharding axes (parallel/sharding.py).
+"""
+
+from symmetry_tpu.models.llama import (
+    KVCache,
+    ModelConfig,
+    PRESETS,
+    forward,
+    init_cache,
+    init_params,
+    param_logical_axes,
+    preset,
+)
+
+__all__ = [
+    "KVCache",
+    "ModelConfig",
+    "PRESETS",
+    "forward",
+    "init_cache",
+    "init_params",
+    "param_logical_axes",
+    "preset",
+]

@@ -1,0 +1,702 @@
+"""Llama-family decoder (llama 3.x, mistral, any HF-llama-shaped LM).
+
+Design (TPU-first, not a port — the reference has no model code to port):
+
+  - Params are a plain pytree; `forward` is a pure function of
+    (params, tokens, positions, cache). Everything jits.
+  - All decoder layers are STACKED along a leading `layers` dim and executed
+    with `lax.scan`: compile time is O(1) in depth (llama3-70b is 80 layers;
+    unrolled tracing would take minutes and bloat the executable).
+  - Projection weights stay fused 2-D ([embed, heads*head_dim]) so each layer
+    is a handful of large matmuls the MXU tiles well, with logical axes
+    mapped to the mesh by parallel/sharding.py (megatron-style TP by
+    default — XLA derives the per-layer collectives from the shardings).
+  - One forward serves prefill AND decode: masking is by absolute position
+    (ops/attention.py), cache writes are scatters at per-sample positions,
+    so a continuous batch of ragged requests runs at static shape.
+
+HF weight compatibility (BASELINE.json north star loads HF safetensors):
+tensor layout/naming map in `HF_LAYER_MAP` + `convert_hf_params`
+(engine/weights.py does the streaming file IO).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from symmetry_tpu.ops.attention import gqa_attention
+from symmetry_tpu.ops.norm import rms_norm
+from symmetry_tpu.ops.quant import QuantizedTensor, qmatmul, quantize_tree
+from symmetry_tpu.ops.rope import apply_rope
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    intermediate_size: int
+    head_dim: int | None = None          # defaults to hidden//heads
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+    sliding_window: int | None = None    # mistral-v0.1 style local attention
+    attention_bias: bool = False         # qwen2-style QKV projection biases
+    max_position: int = 8192
+    # gemma family: gelu-tanh GeGLU, RMSNorm scale stored as (weight - 1),
+    # and embeddings multiplied by sqrt(hidden) at lookup
+    hidden_act: str = "silu"             # "silu" | "gelu_tanh"
+    norm_plus_one: bool = False
+    scale_embed: bool = False
+
+    @property
+    def dim_per_head(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.dim_per_head
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.dim_per_head
+
+
+@dataclass(frozen=True)
+class MoEConfig(ModelConfig):
+    """Mixture-of-experts variant (mixtral family): the MLP becomes
+    num_experts parallel FFNs with top-k routing (models/moe.py)."""
+
+    num_experts: int = 8
+    num_experts_per_tok: int = 2
+    # Prefill token-dispatch capacity (models/moe.py); None = the module
+    # default. Set >= num_experts / num_experts_per_tok for zero drops.
+    moe_capacity_factor: float | None = None
+
+
+# Named presets; sizes from the public HF configs of each model family.
+PRESETS: dict[str, ModelConfig] = {
+    # test-scale models (CPU-fast, exercised by the suite)
+    "tiny": ModelConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=128, rope_theta=10000.0,
+        max_position=512,
+    ),
+    "tiny-mha": ModelConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=4, intermediate_size=128, rope_theta=10000.0,
+        max_position=512,
+    ),
+    # production targets (BASELINE.json configs 2-5)
+    "llama3-8b": ModelConfig(
+        vocab_size=128256, hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, intermediate_size=14336, rope_theta=500000.0,
+    ),
+    "llama3-70b": ModelConfig(
+        vocab_size=128256, hidden_size=8192, num_layers=80, num_heads=64,
+        num_kv_heads=8, intermediate_size=28672, rope_theta=500000.0,
+    ),
+    "llama3.2-1b": ModelConfig(
+        vocab_size=128256, hidden_size=2048, num_layers=16, num_heads=32,
+        num_kv_heads=8, intermediate_size=8192, rope_theta=500000.0,
+        tie_embeddings=True,
+    ),
+    "mistral-7b": ModelConfig(
+        vocab_size=32768, hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, intermediate_size=14336, rope_theta=1000000.0,
+    ),
+    "tiny-moe": MoEConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=128, rope_theta=10000.0,
+        max_position=512, num_experts=4, num_experts_per_tok=2,
+    ),
+    "mixtral-8x7b": MoEConfig(
+        vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, intermediate_size=14336, rope_theta=1000000.0,
+        num_experts=8, num_experts_per_tok=2,
+    ),
+    "gemma-7b": ModelConfig(
+        vocab_size=256000, hidden_size=3072, num_layers=28, num_heads=16,
+        num_kv_heads=16, intermediate_size=24576, head_dim=256,
+        rope_theta=10000.0, rms_eps=1e-6, tie_embeddings=True,
+        hidden_act="gelu_tanh", norm_plus_one=True, scale_embed=True,
+    ),
+    "gemma-2b": ModelConfig(
+        vocab_size=256000, hidden_size=2048, num_layers=18, num_heads=8,
+        num_kv_heads=1, intermediate_size=16384, head_dim=256,
+        rope_theta=10000.0, rms_eps=1e-6, tie_embeddings=True,
+        hidden_act="gelu_tanh", norm_plus_one=True, scale_embed=True,
+    ),
+    "tiny-gemma": ModelConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=128, head_dim=16,
+        rope_theta=10000.0, max_position=512, tie_embeddings=True,
+        hidden_act="gelu_tanh", norm_plus_one=True, scale_embed=True,
+    ),
+    "qwen2-7b": ModelConfig(
+        vocab_size=152064, hidden_size=3584, num_layers=28, num_heads=28,
+        num_kv_heads=4, intermediate_size=18944, rope_theta=1000000.0,
+        rms_eps=1e-6, attention_bias=True,
+    ),
+    "tiny-qwen": ModelConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=128, rope_theta=10000.0,
+        max_position=512, attention_bias=True,
+    ),
+}
+
+
+def preset(name: str) -> ModelConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown model preset {name!r}; have {sorted(PRESETS)}")
+    return PRESETS[name]
+
+
+class KVCache(NamedTuple):
+    """Static-shape KV cache: [layers, batch, capacity, kv_heads, head_dim].
+
+    With quantized=True at init, k/v hold int8 payloads and k_scale/v_scale
+    hold the per-(layer, slot, kv_head, position) f32 dequant scales
+    (ops/quant.py quantize_kv) — [layers, batch, kv_heads, capacity].
+    Position is the MINOR scale dim on purpose: with kv_heads (8) minor the
+    arrays would tile-pad 16x in HBM the moment a Pallas kernel takes them
+    as operands. The scale planes are head_dim× smaller than the payload,
+    so the decode-step cache read drops to ~half of bf16.
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    lengths: jnp.ndarray  # [batch] int32: valid entries per slot
+    k_scale: jnp.ndarray | None = None
+    v_scale: jnp.ndarray | None = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+def init_cache(
+    config: ModelConfig, batch: int, capacity: int, dtype=jnp.bfloat16,
+    *, quantized: bool = False,
+) -> KVCache:
+    shape = (config.num_layers, batch, capacity, config.num_kv_heads,
+             config.dim_per_head)
+    if quantized:
+        scale_shape = (config.num_layers, batch, config.num_kv_heads,
+                       capacity)
+        return KVCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            lengths=jnp.zeros((batch,), jnp.int32),
+            k_scale=jnp.zeros(scale_shape, jnp.float32),
+            v_scale=jnp.zeros(scale_shape, jnp.float32),
+        )
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+
+
+def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16,
+                *, quantize: bool = False) -> dict:
+    """Random init (scaled normal). Real serving loads HF weights instead.
+
+    quantize=True materializes QUANT_KEYS leaves as int8 directly — the
+    whole random-init→scale→quantize pipeline for a leaf runs as ONE
+    compiled program (ops/quant.py make_leaf), so no full-precision copy of
+    a leaf ever lands in HBM beyond that program's fused temporaries. That
+    is what lets an 8B-parameter model initialize on a 16 GB chip.
+    """
+    c = config
+    keys = iter(jax.random.split(key, 16))
+
+    from symmetry_tpu.ops.quant import make_leaf
+
+    def dense(k, shape, scale=None, name=None):
+        scale = scale if scale is not None else shape[0] ** -0.5
+        return make_leaf(k, shape, scale, dtype,
+                         quantized=quantize and name in QUANT_KEYS)
+
+    L, E, F = c.num_layers, c.hidden_size, c.intermediate_size
+    n_exp = getattr(c, "num_experts", 0)
+    # MoE: FFN weights gain a leading experts dim; the router stays dense
+    # (it is contracted per token, tiny, and its logits feed a top-k).
+    ffn = (L, n_exp, E, F) if n_exp else (L, E, F)
+    ffn_d = (L, n_exp, F, E) if n_exp else (L, F, E)
+    params = {
+        "embed": dense(next(keys), (c.vocab_size, E), scale=0.02),
+        "layers": {
+            "attn_norm": jnp.ones((L, E), dtype),
+            "mlp_norm": jnp.ones((L, E), dtype),
+            "wq": dense(next(keys), (L, E, c.q_dim), name="wq"),
+            "wk": dense(next(keys), (L, E, c.kv_dim), name="wk"),
+            "wv": dense(next(keys), (L, E, c.kv_dim), name="wv"),
+            "wo": dense(next(keys), (L, c.q_dim, E), name="wo"),
+            "wg": dense(next(keys), ffn, name="wg"),
+            "wu": dense(next(keys), ffn, name="wu"),
+            "wd": dense(next(keys), ffn_d, name="wd"),
+        },
+        "final_norm": jnp.ones((E,), dtype),
+    }
+    if n_exp:
+        params["layers"]["router"] = dense(next(keys), (L, E, n_exp))
+    if c.attention_bias:
+        # qwen2: biases on q/k/v projections only (not o/mlp)
+        params["layers"]["bq"] = jnp.zeros((L, c.q_dim), dtype)
+        params["layers"]["bk"] = jnp.zeros((L, c.kv_dim), dtype)
+        params["layers"]["bv"] = jnp.zeros((L, c.kv_dim), dtype)
+    if not c.tie_embeddings:
+        params["lm_head"] = dense(next(keys), (E, c.vocab_size), scale=0.02,
+                                  name="lm_head")
+    return params
+
+
+def param_logical_axes(config: ModelConfig) -> dict:
+    """Pytree of logical-axis tuples, same structure as init_params output."""
+    moe = bool(getattr(config, "num_experts", 0))
+    ffn = (("layers", "experts", "embed", "mlp") if moe
+           else ("layers", "embed", "mlp"))
+    ffn_d = (("layers", "experts", "mlp", "embed") if moe
+             else ("layers", "mlp", "embed"))
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "wg": ffn,
+            "wu": ffn,
+            "wd": ffn_d,
+        },
+        "final_norm": ("embed",),
+    }
+    if moe:
+        axes["layers"]["router"] = ("layers", "embed", None)
+    if config.attention_bias:
+        axes["layers"]["bq"] = ("layers", "heads")
+        axes["layers"]["bk"] = ("layers", "kv_heads")
+        axes["layers"]["bv"] = ("layers", "kv_heads")
+    if not config.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def cache_logical_axes(*, quantized: bool = False) -> KVCache:
+    kv = ("layers", "batch", "cache_seq", "kv_heads", "head_dim")
+    sc = ("layers", "batch", "kv_heads", "cache_seq") if quantized else None
+    return KVCache(k=kv, v=kv, lengths=("batch",), k_scale=sc, v_scale=sc)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+
+
+def _layer(
+    h: jnp.ndarray,             # [B, S, E]
+    lp: dict,                   # one layer's params (leading L dim stripped)
+    cache: KVCache,             # FULL [L, B, T, K, D] cache (lengths unused)
+    layer: jnp.ndarray,         # scalar int32 layer index
+    positions: jnp.ndarray,     # [B, S]
+    kv_valid: jnp.ndarray,      # [B] cache length AFTER this call's writes
+    seq_lens: jnp.ndarray,      # [B] valid tokens in this call's input
+    config: ModelConfig,
+    prefill_flash: bool,        # static: flash self-attention (fresh cache)
+    ring_mesh=None,             # static: Mesh => sequence-parallel prefill
+    sp_mode: str = "ring",      # static: "ring" | "ulysses" (SURVEY §5.7)
+) -> tuple[jnp.ndarray, KVCache]:
+    B, S, E = h.shape
+    D, nq, nkv = config.dim_per_head, config.num_heads, config.num_kv_heads
+
+    x = rms_norm(h, _norm_w(lp["attn_norm"], config), config.rms_eps)
+    q = qmatmul(x, lp["wq"])
+    k = qmatmul(x, lp["wk"])
+    v = qmatmul(x, lp["wv"])
+    if config.attention_bias:  # qwen2 family
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(B, S, nq, D)
+    k = k.reshape(B, S, nkv, D)
+    v = v.reshape(B, S, nkv, D)
+    q = apply_rope(q, positions, config.rope_theta)
+    k = apply_rope(k, positions, config.rope_theta)
+
+    # Scatter the new K/V straight into the full cache at (layer, batch,
+    # position) — an in-place row write on the scan carry; a per-layer
+    # slice-out/slice-in would stream the whole layer slice through HBM.
+    # Padded tail tokens write garbage past kv_valid — never read,
+    # overwritten later. Quantized caches write int8 payload + f32 scales.
+    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    l_idx = jnp.full((B, S), layer, jnp.int32)
+    if cache.quantized:
+        from symmetry_tpu.ops.quant import quantize_kv
+
+        kq, ks = quantize_kv(k)  # ks [B, S, K]
+        vq, vs = quantize_kv(v)
+        # Scale planes are [L, B, K, T] (position minor, see KVCache): the
+        # mixed advanced/slice index puts the advanced dims (B, S) in
+        # front, matching the [B, S, K] scale values.
+        cache = cache._replace(
+            k=cache.k.at[l_idx, b_idx, positions].set(kq),
+            v=cache.v.at[l_idx, b_idx, positions].set(vq),
+            k_scale=cache.k_scale.at[l_idx, b_idx, :, positions].set(ks),
+            v_scale=cache.v_scale.at[l_idx, b_idx, :, positions].set(vs),
+        )
+    else:
+        cache = cache._replace(
+            k=cache.k.at[l_idx, b_idx, positions].set(k.astype(cache.k.dtype)),
+            v=cache.v.at[l_idx, b_idx, positions].set(v.astype(cache.v.dtype)),
+        )
+
+    if ring_mesh is not None:
+        # Long-context prefill: sequence sharded over the `context` mesh
+        # axis — K/V blocks rotating on ICI (parallel/ring.py), or one
+        # all-to-all head scatter when heads divide the shard count
+        # (parallel/ulysses.py).
+        if sp_mode == "ulysses":
+            from symmetry_tpu.parallel.ulysses import ulysses_attention
+
+            attn = ulysses_attention(q, k, v, seq_lens, ring_mesh)
+        else:
+            from symmetry_tpu.parallel.ring import ring_attention
+
+            attn = ring_attention(q, k, v, seq_lens, ring_mesh)
+    elif prefill_flash:
+        # Prefill-from-empty: attention is over this call's own K/V — the
+        # Pallas kernel streams K/V blocks through VMEM instead of
+        # materializing [H, S, S] scores (ops/flash.py); the cache slice is
+        # never read back. Sliding-window models restrict the kernel's
+        # block range to the window.
+        from symmetry_tpu.ops.flash import flash_prefill
+
+        attn = flash_prefill(q, k, v, seq_lens,
+                             window=config.sliding_window,
+                             interpret=jax.default_backend() != "tpu")
+    else:
+        from symmetry_tpu.ops import decode_attention as da
+
+        if S == 1 and da.supports(config, cache.k.shape[2],
+                                  jax.default_backend()):
+            # Single-position decode on TPU: the Pallas kernel reads only
+            # each slot's occupied KV prefix (per-slot block skipping); the
+            # full cache is its operand, layer selection happens in the
+            # kernel's block addressing (ops/decode_attention.py).
+            attn = da.decode_attention(
+                q[:, 0], cache.k, cache.v, layer, kv_valid,
+                k_scale=cache.k_scale if cache.quantized else None,
+                v_scale=cache.v_scale if cache.quantized else None,
+                window=config.sliding_window,
+                interpret=jax.default_backend() != "tpu")[:, None]
+        else:
+            def at_layer(arr):
+                return jax.lax.dynamic_index_in_dim(arr, layer, 0,
+                                                    keepdims=False)
+
+            attn = gqa_attention(
+                q, at_layer(cache.k), at_layer(cache.v), positions, kv_valid,
+                sliding_window=config.sliding_window,
+                k_scale=at_layer(cache.k_scale) if cache.quantized else None,
+                v_scale=at_layer(cache.v_scale) if cache.quantized else None)
+    h = h + qmatmul(attn.reshape(B, S, nq * D), lp["wo"])
+
+    x = rms_norm(h, _norm_w(lp["mlp_norm"], config), config.rms_eps)
+    if "router" in lp:
+        from symmetry_tpu.models.moe import moe_mlp
+
+        h = h + moe_mlp(x, lp, config)
+    else:
+        h = h + qmatmul(_act(qmatmul(x, lp["wg"]), config)
+                        * qmatmul(x, lp["wu"]), lp["wd"])
+    return h, cache
+
+
+def _act(x: jnp.ndarray, config: ModelConfig) -> jnp.ndarray:
+    """Gated-MLP activation: silu (llama/mistral/qwen) or tanh-approx gelu
+    (gemma's GeGLU)."""
+    if config.hidden_act == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def _norm_w(w: jnp.ndarray, config: ModelConfig) -> jnp.ndarray:
+    """Gemma stores RMSNorm scale as (weight - 1): the model applies
+    (1 + w). The add runs in float32 — HF GemmaRMSNorm computes
+    (1.0 + weight.float()), and doing it in a bf16 checkpoint dtype would
+    round the multiplier at every one of the model's norm sites. rms_norm
+    upcasts anyway, so this costs nothing."""
+    if not config.norm_plus_one:
+        return w
+    return w.astype(jnp.float32) + 1.0
+
+
+def forward_hidden(
+    params: dict,
+    config: ModelConfig,
+    tokens: jnp.ndarray,      # [B, S] int32
+    cache: KVCache,           # lengths[b] = tokens already in cache for slot b
+    seq_lens: jnp.ndarray | None = None,  # [B] valid tokens in `tokens`; None = all S
+    *,
+    prefill_flash: bool = False,  # static: caller guarantees cache is empty
+    ring_mesh=None,               # static: context-parallel prefill mesh
+    sp_mode: str = "ring",        # static: "ring" | "ulysses"
+) -> tuple[jnp.ndarray, KVCache]:
+    """Decoder trunk: returns (final-norm hidden states [B, S, E], cache).
+
+    Split from the LM head so prefill can project only the last valid
+    position — at 128k vocab the head matmul over a full padded bucket would
+    dominate prefill cost.
+
+    prefill_flash=True routes attention through the Pallas flash kernel.
+    VALID ONLY when cache.lengths are all zero (engine prefill's case) —
+    both fast paths attend to this call's own K/V, not the cache.
+    ring_mesh additionally shards the sequence over the mesh's `context`
+    axis; it requires prefill_flash's empty-cache contract and S divisible
+    by the shard count. sp_mode picks the scheme: "ring" rotates K/V
+    blocks (parallel/ring.py, any head count), "ulysses" head-scatters via
+    one all-to-all (parallel/ulysses.py, needs kv_heads % shards == 0).
+    Sliding-window models (mistral-v0.1) use the window-bounded flash
+    kernel for prefill. The ring/ulysses schemes do not support windows:
+    with ring_mesh set, a sliding-window model runs the (non-sequence-
+    parallel) flash kernel instead — callers needing SP for windowed
+    models must shard some other way.
+    """
+    B, S = tokens.shape
+    if seq_lens is None:
+        seq_lens = jnp.full((B,), S, jnp.int32)
+    positions = cache.lengths[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    kv_valid = cache.lengths + seq_lens
+    if ring_mesh is not None and not prefill_flash:
+        # Ring q/kv positions start at 0 and ignore cached entries — only
+        # the prefill-from-empty contract makes that correct. Fail loudly
+        # rather than silently mis-attend on a continuation call.
+        raise ValueError("ring_mesh requires prefill_flash=True "
+                         "(prefill-from-empty contract)")
+    use_ring = ring_mesh if (ring_mesh is not None and S > 1
+                             and config.sliding_window is None) else None
+    # Flash prefill handles sliding windows natively (window-bounded block
+    # range); only the ring path still requires global attention.
+    use_flash = prefill_flash and use_ring is None and S > 1
+
+    n_stacked = jax.tree.leaves(params["layers"])[0].shape[0]
+    if n_stacked != config.num_layers:
+        # A config/checkpoint depth mismatch must fail loudly: the cache is
+        # sized by config, and out-of-bounds scatter/gather on the extra
+        # layers would be silently dropped/clamped instead of erroring.
+        raise ValueError(f"params carry {n_stacked} stacked layers but "
+                         f"config.num_layers = {config.num_layers}")
+    h = jnp.take(params["embed"], tokens, axis=0)
+    if config.scale_embed:
+        # gemma: embeddings scaled by sqrt(hidden) at lookup, normalizer
+        # cast to the activation dtype (HF modeling_gemma semantics)
+        h = h * jnp.asarray(config.hidden_size ** 0.5, h.dtype)
+    h, new_cache = run_layers(params["layers"], h, cache, positions,
+                              kv_valid, seq_lens, config,
+                              use_flash=use_flash, use_ring=use_ring,
+                              sp_mode=sp_mode)
+    h = rms_norm(h, _norm_w(params["final_norm"], config), config.rms_eps)
+    return h, new_cache._replace(lengths=kv_valid)
+
+
+def run_layers(
+    layers_params: dict,
+    h: jnp.ndarray,
+    cache: KVCache,            # leading layer dim == layers_params' leading dim
+    positions: jnp.ndarray,
+    kv_valid: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    config: ModelConfig,
+    *,
+    use_flash: bool = False,
+    use_ring=None,
+    sp_mode: str = "ring",
+) -> tuple[jnp.ndarray, KVCache]:
+    """Scan a stack of decoder layers over `h`. Factored out of
+    forward_hidden so pipeline parallelism (parallel/pipeline.py) can run a
+    STAGE'S local slice of layers against its local cache shard — layer
+    indices inside are local to the stack passed in, which is exactly what
+    the per-stage cache expects."""
+
+    def body(carry, xs):
+        # The cache rides the CARRY, scatter-updated in place: scan xs/ys
+        # would stream the full [L, B, T, K, D] arrays through HBM every
+        # forward — at decode that re-writes ~0.5 GB per token.
+        h, c = carry
+        lp, l = xs
+        h, c = _layer(h, lp, c, l, positions, kv_valid,
+                      seq_lens, config, use_flash, ring_mesh=use_ring,
+                      sp_mode=sp_mode)
+        return (h, c), None
+
+    n_layers = jax.tree.leaves(layers_params)[0].shape[0]
+    (h, new_cache), _ = jax.lax.scan(
+        body, (h, cache),
+        (layers_params, jnp.arange(n_layers, dtype=jnp.int32)))
+    return h, new_cache
+
+
+def logits_from_hidden(params: dict, config: ModelConfig,
+                       h: jnp.ndarray) -> jnp.ndarray:
+    """LM head: [B, S, E] hidden -> [B, S, vocab] float32 logits."""
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    return qmatmul(h, head).astype(jnp.float32)
+
+
+# Weights eligible for int8 quantization (all the large matmuls; the
+# embedding stays dense — it is gathered, not contracted).
+QUANT_KEYS = ("wq", "wk", "wv", "wo", "wg", "wu", "wd", "lm_head")
+
+
+def quantize_params(params: dict) -> dict:
+    """In-place int8 quantization of all QUANT_KEYS leaves (ops/quant.py)."""
+    return quantize_tree(params, QUANT_KEYS)
+
+
+def quantized_logical_axes(axes: dict) -> dict:
+    """Map a dense logical-axes tree to its quantized counterpart: the int8
+    payload keeps the dense axes; per-column scales drop the contraction
+    (second-to-last) axis."""
+    def visit(node):
+        out = {}
+        for name, child in node.items():
+            if isinstance(child, dict):
+                out[name] = visit(child)
+            elif name in QUANT_KEYS:
+                out[name] = QuantizedTensor(
+                    q=child, scale=child[:-2] + child[-1:])
+            else:
+                out[name] = child
+        return out
+
+    return visit(axes)
+
+
+def forward(
+    params: dict,
+    config: ModelConfig,
+    tokens: jnp.ndarray,      # [B, S] int32
+    cache: KVCache,
+    seq_lens: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Run the decoder; returns (logits [B, S, vocab] f32, updated cache).
+
+    Serves prefill (S = padded prompt length, cache.lengths typically 0) and
+    decode (S = 1 per slot) with the same traced computation. Logits at
+    padded positions are garbage by contract; callers index the last valid
+    position.
+    """
+    h, cache = forward_hidden(params, config, tokens, cache, seq_lens)
+    return logits_from_hidden(params, config, h), cache
+
+
+# ---------------------------------------------------------------------------
+# HF weight layout map (used by engine/weights.py; kept here because it is
+# model knowledge). HF linear weights are [out, in] — transposed vs ours.
+
+HF_TOP_MAP = {
+    "model.embed_tokens.weight": ("embed", False),
+    "model.norm.weight": ("final_norm", False),
+    "lm_head.weight": ("lm_head", True),  # [V,E] -> [E,V]
+}
+HF_LAYER_MAP = {
+    "input_layernorm.weight": ("attn_norm", False),
+    "post_attention_layernorm.weight": ("mlp_norm", False),
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    # qwen2: QKV projection biases (absent in llama/mistral checkpoints)
+    "self_attn.q_proj.bias": ("bq", False),
+    "self_attn.k_proj.bias": ("bk", False),
+    "self_attn.v_proj.bias": ("bv", False),
+    "self_attn.o_proj.weight": ("wo", True),
+    "mlp.gate_proj.weight": ("wg", True),
+    "mlp.up_proj.weight": ("wu", True),
+    "mlp.down_proj.weight": ("wd", True),
+}
+# Mixtral: the MLP block is `block_sparse_moe` — a router (`gate`) plus
+# per-expert w1/w2/w3 Linears (w1=gate_proj, w2=down_proj, w3=up_proj).
+# All are HF [out, in] → transposed; experts stack on our leading dim.
+HF_MOE_ROUTER = "block_sparse_moe.gate.weight"            # → router (T)
+HF_EXPERT_MAP = {"w1": "wg", "w3": "wu", "w2": "wd"}      # all transposed
+
+
+def hf_expert_name(layer: int, expert: int, ours: str) -> str:
+    w = {v: k for k, v in HF_EXPERT_MAP.items()}[ours]
+    return f"model.layers.{layer}.block_sparse_moe.experts.{expert}.{w}.weight"
+
+
+def config_from_hf(hf: dict[str, Any]) -> ModelConfig:
+    """Build a ModelConfig from an HF config.json dict (llama/mistral/
+    qwen2/mixtral shapes; mixtral's num_local_experts selects MoEConfig)."""
+    arch = (hf.get("architectures") or [""])[0]
+    # Exact match: gemma-2/3 checkpoints (Gemma2ForCausalLM, ...) need
+    # logit softcapping, post-layer norms, and alternating local
+    # attention this decoder does not implement — loading them with
+    # gemma-1 semantics would silently generate garbage.
+    gemma = arch == "GemmaForCausalLM"
+    if arch.startswith("Gemma") and not gemma:
+        raise ValueError(
+            f"unsupported architecture {arch!r}: only first-generation "
+            f"GemmaForCausalLM semantics are implemented")
+    # qwen2 configs carry a vestigial sliding_window alongside
+    # use_sliding_window: false — honoring it would silently disable every
+    # fast attention path (flash prefill, ring, the Pallas decode kernel).
+    sliding = hf.get("sliding_window")
+    if hf.get("use_sliding_window") is False:
+        sliding = None
+    if hf.get("num_local_experts"):
+        return MoEConfig(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads",
+                                hf["num_attention_heads"]),
+            intermediate_size=hf["intermediate_size"],
+            head_dim=hf.get("head_dim"),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rms_eps=hf.get("rms_norm_eps", 1e-5),
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            sliding_window=sliding,
+            attention_bias=hf.get("attention_bias", "Qwen2" in arch),
+            max_position=hf.get("max_position_embeddings", 8192),
+            num_experts=hf["num_local_experts"],
+            num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        )
+    return ModelConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        intermediate_size=hf["intermediate_size"],
+        head_dim=hf.get("head_dim"),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_eps=hf.get("rms_norm_eps", 1e-5),
+        # gemma ties embeddings BY DEFAULT, so its config.json often omits
+        # the key entirely — defaulting it False would reject the checkpoint
+        tie_embeddings=hf.get("tie_word_embeddings", gemma),
+        sliding_window=sliding,
+        # older qwen2 configs carry no attention_bias key; the architecture
+        # implies it (HF modeling_qwen2 hardcodes bias=True on q/k/v).
+        attention_bias=hf.get("attention_bias", "Qwen2" in arch),
+        max_position=hf.get("max_position_embeddings", 8192),
+        # gemma: GeGLU + (1+w) norms + scaled embeddings; hidden_activation
+        # ("gelu_pytorch_tanh") appears in newer configs, older ones imply it
+        hidden_act="gelu_tanh" if gemma else "silu",
+        norm_plus_one=gemma,
+        scale_embed=gemma,
+    )

@@ -1,0 +1,172 @@
+"""Mixture-of-experts MLP (mixtral family) — dense-mixture, TPU-first.
+
+The reference has no model code at all (SURVEY §0); MoE enters through the
+framework's model-family coverage (mixtral-8x7b preset, llama.py) and the
+`expert` mesh axis (SURVEY §2.3: expert parallelism "only if MoE models
+are added" — they are).
+
+Design: DENSE mixture. Every expert processes every token; the top-k
+router gates (zeros outside the selected experts) weight the combine. Why
+this is the TPU-right shape for serving:
+
+  - A serving batch of B slots × top-2 routing touches essentially every
+    expert every step, so all expert weights stream from HBM regardless —
+    the decode step stays bandwidth-bound and skipping compute for
+    unselected (token, expert) pairs saves no HBM traffic.
+  - The expert dim becomes a leading batch dim of ONE big dot_general per
+    projection — the MXU sees [experts] × [tokens, embed] @ [embed, ffn]
+    batched matmuls, no gathers, no ragged dispatch, no recompiles.
+  - Sharding: experts map to the `expert` mesh axis and each expert's ffn
+    dim to `model` (parallel/sharding.py rules); XLA derives the combine
+    all-reduce from the shardings, exactly like the dense-MLP TP path.
+
+PREFILL is the exception: it is compute-bound (S large), and the dense
+mixture pays num_experts/top_k extra FLOPs (4x for mixtral-8x7b). There
+moe_mlp routes through capacity-factor token DISPATCH (moe_mlp_dispatch):
+tokens are gathered into a static [experts, capacity, embed] buffer (rank
+computed with a one-hot cumsum — no ragged shapes, no recompiles), each
+expert runs one batched matmul over just its tokens, and a scatter-add
+combines the gated results. Under an `expert` mesh axis the gather/
+scatter become XLA-inserted all-to-alls along it, exactly the GShard/
+Switch dispatch pattern. Tokens past an expert's capacity are dropped
+(standard switch semantics); capacity_factor trades that tail loss
+against the FLOP saving.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from symmetry_tpu.ops.quant import QuantizedTensor
+
+# Per-expert buffer = ceil(T * top_k / X * CAPACITY_FACTOR) tokens.
+# Capacity-factor dispatch is LOSSY under routing imbalance: (token,
+# expert) pairs past an expert's capacity contribute nothing (standard
+# switch semantics, no renormalization). The default of 2.0 keeps the
+# drop tail negligible for mixtral-like routing while still saving
+# X / (k * cf) = 2x prefill FLOPs; set `moe_capacity_factor` to
+# num_experts / num_experts_per_tok for guaranteed-lossless dispatch
+# (which also forfeits the FLOP saving — capacity then covers the
+# worst case), or lower for more speed at more drop risk.
+CAPACITY_FACTOR = 2.0
+# Below this many tokens the dense mixture is used even at S > 1: the
+# dispatch bookkeeping outweighs the matmul saving for tiny prefills.
+MIN_DISPATCH_TOKENS = 64
+
+
+def qmatmul_experts(x: jnp.ndarray, w) -> jnp.ndarray:
+    """[B, S, D] @ per-expert [X, D, F] -> [B, S, X, F].
+
+    QuantizedTensor experts keep the int8 payload as the dot operand (no
+    bf16 materialization — same rule as ops/quant.py qmatmul); per-column
+    scales [X, F] apply to the f32 accumulator."""
+    if isinstance(w, QuantizedTensor):
+        y = jax.lax.dot_general(
+            x, w.q,
+            dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [B, S, X, F]
+        return (y * w.scale).astype(x.dtype)
+    return jnp.einsum("bsd,xdf->bsxf", x, w)
+
+
+def route_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Router logits [B, S, X] -> dense gates [B, S, X]: softmax over the
+    top-k logits (mixtral semantics: normalize AFTER selection), zeros
+    elsewhere. Static-shape: one_hot scatter, no gathers."""
+    top_vals, top_idx = jax.lax.top_k(logits, k)          # [B, S, k]
+    probs = jax.nn.softmax(top_vals, axis=-1)
+    onehot = jax.nn.one_hot(top_idx, logits.shape[-1],
+                            dtype=probs.dtype)            # [B, S, k, X]
+    return jnp.einsum("bsk,bskx->bsx", probs, onehot)
+
+
+def moe_mlp(x: jnp.ndarray, lp: dict, config) -> jnp.ndarray:
+    """MoE FFN: [B, S, E] -> [B, S, E]. Dense mixture at decode
+    (bandwidth-bound), capacity-factor dispatch at prefill
+    (compute-bound) — see module docstring."""
+    B, S, _ = x.shape
+    if S > 1 and B * S >= MIN_DISPATCH_TOKENS:
+        return moe_mlp_dispatch(x, lp, config)
+    gates = route_top_k(
+        jnp.asarray(x @ lp["router"], jnp.float32),
+        config.num_experts_per_tok).astype(x.dtype)       # [B, S, X]
+    h = jax.nn.silu(qmatmul_experts(x, lp["wg"])) * qmatmul_experts(
+        x, lp["wu"])                                      # [B, S, X, F]
+    # Per-expert down-projection then gated combine over experts.
+    wd = lp["wd"]
+    if isinstance(wd, QuantizedTensor):
+        y = jax.lax.dot_general(
+            h, wd.q,
+            dimension_numbers=(((3,), (1,)), ((2,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # batch over experts: [X, B, S, E]
+        y = (y * wd.scale[:, None, None, :]).astype(x.dtype)
+        y = jnp.moveaxis(y, 0, 2)                         # [B, S, X, E]
+    else:
+        y = jnp.einsum("bsxf,xfe->bsxe", h, wd)
+    return jnp.einsum("bsxe,bsx->bse", y, gates)
+
+
+def _expert_matmul(xg: jnp.ndarray, w) -> jnp.ndarray:
+    """Per-expert batched matmul: [X, C, A] @ [X, A, F] -> [X, C, F]."""
+    if isinstance(w, QuantizedTensor):
+        y = jax.lax.dot_general(
+            xg, w.q,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        return (y * w.scale[:, None, :]).astype(xg.dtype)
+    return jnp.einsum("xca,xaf->xcf", xg, w)
+
+
+def moe_mlp_dispatch(x: jnp.ndarray, lp: dict, config) -> jnp.ndarray:
+    """Capacity-factor token dispatch (GShard/Switch shape, static sizes).
+
+    Each (token, choice) pair is ranked within its expert by a one-hot
+    cumsum; pairs past the expert's capacity are dropped. Experts compute
+    ONE batched matmul over their gathered tokens — FLOPs scale with
+    top_k * capacity_factor instead of num_experts — and a scatter-add
+    puts the gated outputs back in token order.
+    """
+    B, S, E = x.shape
+    X = config.num_experts
+    k = config.num_experts_per_tok
+    cf = getattr(config, "moe_capacity_factor", None) or CAPACITY_FACTOR
+    T = B * S
+    C = min(T, math.ceil(T * k / X * cf))
+
+    xf = x.reshape(T, E)
+    logits = jnp.asarray(xf @ lp["router"], jnp.float32)      # [T, X]
+    top_vals, top_idx = jax.lax.top_k(logits, k)              # [T, k]
+    probs = jax.nn.softmax(top_vals, axis=-1)                 # mixtral renorm
+
+    flat_expert = top_idx.reshape(-1)                         # [T*k]
+    flat_token = jnp.repeat(jnp.arange(T, dtype=jnp.int32), k)
+    flat_gate = probs.reshape(-1).astype(x.dtype)
+
+    # Rank of each pair within its expert = how many earlier pairs chose
+    # the same expert (one-hot cumsum: static shapes, no sort).
+    onehot = jax.nn.one_hot(flat_expert, X, dtype=jnp.int32)  # [T*k, X]
+    before = jnp.cumsum(onehot, axis=0) - onehot
+    rank = jnp.take_along_axis(before, flat_expert[:, None], 1)[:, 0]
+    keep = rank < C
+    # Slot in the [X * C] dispatch buffer; dropped pairs target a trash
+    # slot (index X*C) so every scatter stays in bounds and static.
+    slot = jnp.where(keep, flat_expert * C + rank, X * C)
+
+    token_for_slot = jnp.zeros((X * C + 1,), jnp.int32).at[slot].set(
+        flat_token)
+    gate_for_slot = jnp.zeros((X * C + 1,), x.dtype).at[slot].set(
+        jnp.where(keep, flat_gate, 0).astype(x.dtype))
+
+    xg = jnp.take(xf, token_for_slot[:X * C], axis=0).reshape(X, C, E)
+    h = jax.nn.silu(_expert_matmul(xg, lp["wg"])) * _expert_matmul(
+        xg, lp["wu"])                                         # [X, C, F]
+    y = _expert_matmul(h, lp["wd"])                           # [X, C, E]
+
+    weighted = y.reshape(X * C, E) * gate_for_slot[:X * C, None]
+    out = jnp.zeros((T, E), x.dtype).at[token_for_slot[:X * C]].add(weighted)
+    return out.reshape(B, S, E)

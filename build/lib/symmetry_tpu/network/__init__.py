@@ -1,0 +1,3 @@
+from symmetry_tpu.network.peer import Peer
+
+__all__ = ["Peer"]

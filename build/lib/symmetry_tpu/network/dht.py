@@ -1,0 +1,540 @@
+"""Kademlia-style DHT for decentralized provider discovery.
+
+The reference's discovery floor is `hyperdht` (reference package-lock:
+hyperdht 6.15.4 under hyperswarm): announce/lookup by 32-byte topic over a
+Kademlia routing table, so providers are findable WITHOUT the central
+server. This module is the TPU-era equivalent (SURVEY §2.2): the same
+topic semantics — topic = discovery_key = BLAKE2b-32 of the provider's
+public key (identity/identity.py) — over asyncio UDP datagrams.
+
+Protocol (JSON datagrams, single round-trip request/response):
+
+  ping          → pong                      liveness + routing-table refresh
+  find_node(t)  → nodes closest to t        iterative lookup step
+  announce(t)   → stored                    register (addr, pubkey) under t
+  lookup(t)     → peers under t + nodes     discovery + further hops
+
+Design choices vs the reference stack, deliberately simplified:
+  - JSON over UDP instead of a custom binary codec — message sizes are
+    tiny and this is the control plane, not the token stream.
+  - Values (topic → peers) expire after TTL; announcers re-announce on an
+    interval (REANNOUNCE_S), exactly hyperswarm's liveness model.
+  - Announce/unannounce records that carry a publicKey are SIGNED with the
+    announcer's Ed25519 key and verified on store: a third party can
+    neither plant a record under someone else's key nor evict a live
+    provider with a forged unannounce (hyperdht's mutable-record
+    signing, here over the same identity key the data plane pins).
+  - NAT holepunching lives one level up (network/natpunch.py,
+    rendezvous-assisted simultaneous-open through the server); the DHT
+    itself assumes reachable nodes (DC/DCN deployment).
+
+Iterative lookup: standard Kademlia — query the ALPHA closest known nodes,
+merge returned nodes, repeat until the closest set stabilizes, collect
+peers from lookup responses along the way.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from symmetry_tpu.utils.logging import logger
+
+K_BUCKET = 16          # max nodes per bucket (Kademlia k)
+ALPHA = 3              # lookup parallelism
+ID_BITS = 256
+VALUE_TTL_S = 10 * 60  # announced peers expire unless re-announced
+REANNOUNCE_S = 4 * 60
+RPC_TIMEOUT_S = 2.0
+# Wall-clock tolerance on signed records: announcer and storing node must
+# agree within this window (10 min), or stores are rejected — signed
+# discovery REQUIRES loosely NTP-synced clocks. A provider whose clock is
+# skewed past this is undiscoverable on remote nodes; DHTNode escalates
+# repeated all-rejected announce rounds to an error and exposes
+# `consecutive_rejected_rounds` for health consumers (round-3 advisor).
+MAX_SIG_SKEW_S = VALUE_TTL_S
+
+
+def _xor_distance(a: bytes, b: bytes) -> int:
+    return int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
+
+
+def _announce_sig_msg(topic_hex: str, payload: dict, ts: float) -> bytes:
+    """Canonical bytes an announcer signs: topic + payload (sans volatile
+    fields) + wall-clock timestamp. Deterministic JSON so announcer and
+    verifier serialize identically."""
+    body = {k: v for k, v in payload.items() if k != "sig"}
+    return json.dumps(["announce", topic_hex, body, round(ts, 3)],
+                      sort_keys=True, separators=(",", ":")).encode()
+
+
+def _unannounce_sig_msg(topic_hex: str, key: str, ts: float) -> bytes:
+    return json.dumps(["unannounce", topic_hex, key, round(ts, 3)],
+                      sort_keys=True, separators=(",", ":")).encode()
+
+
+def parse_host_port(entry: str) -> tuple[str, int]:
+    """'host:port' → (host, port) with a diagnosable error on bad input
+    (shared by provider and client bootstrap-list parsing)."""
+    host, sep, port = str(entry).rpartition(":")
+    if not sep or not host:
+        raise ValueError(
+            f"DHT bootstrap entry {entry!r} must be 'host:port'")
+    try:
+        return host, int(port)
+    except ValueError:
+        raise ValueError(
+            f"DHT bootstrap entry {entry!r} has a non-numeric port") from None
+
+
+@dataclass(slots=True)
+class NodeInfo:
+    node_id: bytes        # 32-byte DHT id
+    host: str
+    port: int
+    last_seen: float = field(default_factory=time.monotonic)
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    def to_wire(self) -> list:
+        return [self.node_id.hex(), self.host, self.port]
+
+    @classmethod
+    def from_wire(cls, raw: list) -> "NodeInfo":
+        return cls(node_id=bytes.fromhex(raw[0]), host=raw[1],
+                   port=int(raw[2]))
+
+
+class RoutingTable:
+    """256 XOR-distance buckets of up to K_BUCKET nodes each."""
+
+    def __init__(self, self_id: bytes) -> None:
+        self.self_id = self_id
+        self.buckets: list[list[NodeInfo]] = [[] for _ in range(ID_BITS)]
+
+    def _bucket_index(self, node_id: bytes) -> int:
+        d = _xor_distance(self.self_id, node_id)
+        return d.bit_length() - 1 if d else 0
+
+    def add(self, node: NodeInfo) -> None:
+        if node.node_id == self.self_id:
+            return
+        bucket = self.buckets[self._bucket_index(node.node_id)]
+        for i, existing in enumerate(bucket):
+            if existing.node_id == node.node_id:
+                bucket[i] = node  # refresh address + last_seen
+                return
+        if len(bucket) < K_BUCKET:
+            bucket.append(node)
+        else:
+            # Evict the stalest entry (reference hyperdht pings the oldest;
+            # one-shot replacement keeps the table fresh without extra RPC)
+            stalest = min(range(len(bucket)),
+                          key=lambda i: bucket[i].last_seen)
+            if bucket[stalest].last_seen + VALUE_TTL_S < time.monotonic():
+                bucket[stalest] = node
+
+    def remove(self, node_id: bytes) -> None:
+        bucket = self.buckets[self._bucket_index(node_id)]
+        bucket[:] = [n for n in bucket if n.node_id != node_id]
+
+    def closest(self, target: bytes, count: int = K_BUCKET) -> list[NodeInfo]:
+        everyone = [n for b in self.buckets for n in b]
+        everyone.sort(key=lambda n: _xor_distance(n.node_id, target))
+        return everyone[:count]
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self.buckets)
+
+
+class _Protocol(asyncio.DatagramProtocol):
+    def __init__(self, node: "DHTNode") -> None:
+        self.node = node
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        self.node._on_datagram(data, addr)
+
+
+class DHTNode:
+    """One DHT participant: routing table + topic store + RPC endpoint.
+
+    Usage:
+        node = DHTNode()
+        await node.start("127.0.0.1", 0, bootstrap=[(host, port), ...])
+        await node.announce(topic, payload={"address": ..., "publicKey": ...})
+        peers = await node.lookup(topic)
+    """
+
+    def __init__(self, node_id: bytes | None = None, *,
+                 identity=None) -> None:
+        self.node_id = node_id or os.urandom(32)
+        # Optional Ed25519 identity (identity/identity.py). When set,
+        # announce()/unannounce() sign their records so remote nodes can
+        # verify them against the payload's publicKey.
+        self.identity = identity
+        self.table = RoutingTable(self.node_id)
+        # topic hex -> {peer key -> (payload, stored_at)}
+        self._store: dict[str, dict[str, tuple[dict, float]]] = {}
+        # (topic hex, key) -> signed unannounce ts: fences REPLAYED
+        # announces — without it, a captured announce packet re-stored
+        # after the owner's unannounce resurrects a drained provider.
+        self._tombstones: dict[tuple[str, str], float] = {}
+        self._transport: asyncio.DatagramTransport | None = None
+        self._pending: dict[str, asyncio.Future] = {}
+        self._seq = 0
+        self._announcing: dict[str, dict] = {}
+        self._tasks: set[asyncio.Task] = set()
+        # Announce rounds in a row where every reachable node rejected the
+        # record and none stored it (clock skew / bad signature). >= 2 is a
+        # health error: this node is undiscoverable (see _announce_once).
+        self.consecutive_rejected_rounds = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self, host: str = "0.0.0.0", port: int = 0,
+                    bootstrap: list[tuple[str, int]] | None = None) -> None:
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _Protocol(self), local_addr=(host, port))
+        reached = False
+        for addr in bootstrap or []:
+            try:
+                await self._rpc(addr, {"type": "ping"})
+                reached = True
+            except asyncio.TimeoutError:
+                logger.warning(f"dht bootstrap node {addr} unreachable")
+        if reached:
+            # one table-population lookup around our own id, after all
+            # bootstrap pings (not one full lookup per bootstrap node)
+            await self._iterative_find(self.node_id)
+        task = asyncio.get_running_loop().create_task(self._maintenance())
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    @property
+    def port(self) -> int:
+        assert self._transport is not None
+        return self._transport.get_extra_info("sockname")[1]
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        if self._transport is not None:
+            self._transport.close()
+
+    # ------------------------------------------------------------ public API
+
+    async def announce(self, topic: bytes, payload: dict) -> int:
+        """Store (self, payload) under topic on the closest nodes; returns
+        the number of nodes that accepted. Re-announced periodically until
+        unannounce(). Records are keyed by the payload's publicKey when
+        present, so a restarted announcer OVERWRITES its old record rather
+        than leaving a stale twin under a fresh DHT node id.
+
+        publicKey records are SIGNED (the node's identity must hold that
+        key): remote nodes verify on store, so nobody can announce under —
+        or later unannounce — a key they don't control."""
+        if self.identity is not None:
+            payload = dict(payload)
+            payload.setdefault("publicKey", self.identity.public_hex)
+        if payload.get("publicKey") and (
+                self.identity is None
+                or self.identity.public_hex != payload["publicKey"]):
+            raise ValueError(
+                "announcing a publicKey record requires the matching "
+                "identity to sign it (DHTNode(identity=...))")
+        self._announcing[topic.hex()] = payload
+        return await self._announce_once(topic, payload)
+
+    async def unannounce(self, topic: bytes) -> None:
+        """Stop re-announcing AND delete the record from the remote nodes
+        holding it (hyperdht semantics) — without the RPC, a drained
+        provider would stay resolvable until TTL expiry (~10 min). Signed
+        when the record was, so third parties can't evict it."""
+        payload = self._announcing.pop(topic.hex(), None)
+        key = self._record_key(payload or {})
+        self._store.get(topic.hex(), {}).pop(key, None)
+        msg: dict[str, Any] = {"type": "unannounce", "topic": topic.hex(),
+                               "key": key}
+        if self.identity is not None and key == self.identity.public_hex:
+            ts = time.time()
+            msg["ts"] = round(ts, 3)
+            msg["sig"] = self.identity.sign(
+                _unannounce_sig_msg(topic.hex(), key, ts)).hex()
+        # One retry on timeout: a node that misses the unannounce also
+        # misses the replay-fencing tombstone, so a captured announce could
+        # be replayed at it for up to MAX_SIG_SKEW_S (best-effort fence —
+        # nodes unreachable through both attempts keep that residual
+        # window, bounded by the record TTL).
+        for node in self.table.closest(topic, K_BUCKET):
+            for _ in range(2):
+                try:
+                    await self._rpc(node.addr, msg)
+                    break
+                except asyncio.TimeoutError:
+                    continue
+
+    def _record_key(self, payload: dict) -> str:
+        return str(payload.get("publicKey") or self.node_id.hex())
+
+    async def lookup(self, topic: bytes) -> list[dict]:
+        """Find peers announced under topic anywhere in the DHT."""
+        peers: dict[str, dict] = {}
+        # local hits first
+        for key, (payload, _) in self._store.get(topic.hex(), {}).items():
+            peers[key] = payload
+        await self._iterative_find(topic, collect_peers=peers)
+        return list(peers.values())
+
+    # ------------------------------------------------------------ internals
+
+    async def _announce_once(self, topic: bytes, payload: dict) -> int:
+        if self.identity is not None and payload.get("publicKey"):
+            # Fresh timestamp + signature per (re-)announce: the ts also
+            # fences unannounce replays from before the latest announce.
+            payload = {k: v for k, v in payload.items()
+                       if k not in ("sig", "ts")}
+            ts = time.time()
+            payload["ts"] = round(ts, 3)
+            payload["sig"] = self.identity.sign(
+                _announce_sig_msg(topic.hex(), payload, ts)).hex()
+        await self._iterative_find(topic)
+        targets = self.table.closest(topic, K_BUCKET) or []
+        ok = 0
+        rejected = 0
+        for node in targets[:K_BUCKET]:
+            try:
+                resp = await self._rpc(node.addr, {
+                    "type": "announce", "topic": topic.hex(),
+                    "payload": payload})
+                # A "rejected" reply (bad signature / clock skew) is NOT a
+                # store — counting it would log "announced on N nodes"
+                # while the provider is undiscoverable.
+                if resp.get("type") == "stored":
+                    ok += 1
+                else:
+                    rejected += 1
+                    logger.warning(
+                        f"dht announce rejected by {node.addr}: "
+                        f"{resp.get('error', resp.get('type'))}")
+            except asyncio.TimeoutError:
+                self.table.remove(node.node_id)
+        # Every reachable node rejecting while none stores is a HEALTH
+        # condition, not noise: the classic cause is a skewed local clock
+        # (> MAX_SIG_SKEW_S), which leaves this announcer silently
+        # undiscoverable while its own log shows routine re-announces.
+        if rejected and not ok:
+            self.consecutive_rejected_rounds += 1
+            if self.consecutive_rejected_rounds >= 2:
+                logger.error(
+                    f"dht: {self.consecutive_rejected_rounds} consecutive "
+                    f"announce rounds fully rejected — this node is NOT "
+                    f"discoverable. Most likely cause: local clock skewed "
+                    f"more than {MAX_SIG_SKEW_S / 60:.0f} min from the "
+                    f"storing nodes (signed records require NTP-synced "
+                    f"clocks)")
+        elif ok:
+            self.consecutive_rejected_rounds = 0
+        # Always store locally too: a 1-node network must still resolve.
+        self._store_value(topic.hex(), self._record_key(payload), payload)
+        return ok
+
+    async def _iterative_find(self, target: bytes,
+                              collect_peers: dict | None = None) -> None:
+        queried: set[bytes] = set()
+        shortlist = self.table.closest(target, K_BUCKET)
+        while True:
+            batch = [n for n in shortlist if n.node_id not in queried][:ALPHA]
+            if not batch:
+                return
+            results = await asyncio.gather(
+                *(self._find_rpc(n, target, collect_peers) for n in batch),
+                return_exceptions=True)
+            for node, res in zip(batch, results):
+                queried.add(node.node_id)
+                if isinstance(res, Exception):
+                    self.table.remove(node.node_id)
+            shortlist = self.table.closest(target, K_BUCKET)
+
+    async def _find_rpc(self, node: NodeInfo, target: bytes,
+                        collect_peers: dict | None) -> None:
+        msg_type = "lookup" if collect_peers is not None else "find_node"
+        resp = await self._rpc(node.addr, {"type": msg_type,
+                                           "topic": target.hex()})
+        for raw in resp.get("nodes", []):
+            try:
+                self.table.add(NodeInfo.from_wire(raw))
+            except (ValueError, IndexError, TypeError):
+                continue
+        if collect_peers is not None:
+            for key, payload in resp.get("peers", {}).items():
+                collect_peers.setdefault(key, payload)
+
+    def _store_value(self, topic_hex: str, key: str, payload: dict) -> None:
+        self._store.setdefault(topic_hex, {})[key] = (payload, time.monotonic())
+
+    async def _maintenance(self) -> None:
+        while True:
+            await asyncio.sleep(REANNOUNCE_S)
+            now = time.monotonic()
+            for topic_hex, entries in list(self._store.items()):
+                for key, (_, stored) in list(entries.items()):
+                    if stored + VALUE_TTL_S < now:
+                        del entries[key]
+                if not entries:
+                    del self._store[topic_hex]
+            # Tombstones only need to outlive the announce-replay window
+            # (announces older than MAX_SIG_SKEW_S are rejected anyway).
+            cutoff = time.time() - 2 * MAX_SIG_SKEW_S
+            self._tombstones = {k: ts for k, ts in self._tombstones.items()
+                                if ts > cutoff}
+            for topic_hex, payload in list(self._announcing.items()):
+                try:
+                    await self._announce_once(bytes.fromhex(topic_hex),
+                                              payload)
+                except Exception as exc:  # noqa: BLE001 — keep re-announcing
+                    logger.debug(f"dht re-announce failed: {exc}")
+
+    # ------------------------------------------------------------ wire
+
+    async def _rpc(self, addr: tuple[str, int], msg: dict) -> dict:
+        self._seq += 1
+        msg_id = f"{self._seq}:{os.urandom(4).hex()}"
+        msg = {**msg, "id": msg_id,
+               "from": [self.node_id.hex(), self.port]}
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        try:
+            assert self._transport is not None, "node not started"
+            self._transport.sendto(json.dumps(msg).encode(), addr)
+            return await asyncio.wait_for(fut, RPC_TIMEOUT_S)
+        finally:
+            self._pending.pop(msg_id, None)
+
+    def _on_datagram(self, data: bytes, addr: tuple[str, int]) -> None:
+        try:
+            msg = json.loads(data.decode())
+        except (ValueError, UnicodeDecodeError):
+            return
+        if not isinstance(msg, dict):
+            return
+        msg_id = msg.get("id")
+        sender = msg.get("from")
+        if isinstance(sender, list) and len(sender) == 2:
+            try:
+                self.table.add(NodeInfo(node_id=bytes.fromhex(sender[0]),
+                                        host=addr[0], port=int(sender[1])))
+            except (ValueError, TypeError):
+                pass
+        if msg.get("resp"):
+            fut = self._pending.get(msg_id or "")
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+            return
+        reply = self._handle_request(msg)
+        if reply is not None and self._transport is not None:
+            reply.update(id=msg_id, resp=True,
+                         **{"from": [self.node_id.hex(), self.port]})
+            self._transport.sendto(json.dumps(reply).encode(), addr)
+
+    def _handle_request(self, msg: dict) -> dict | None:
+        mtype = msg.get("type")
+        if mtype == "ping":
+            return {"type": "pong"}
+        if mtype in ("find_node", "lookup"):
+            topic_hex = msg.get("topic", "")
+            try:
+                target = bytes.fromhex(topic_hex)
+            except ValueError:
+                return None
+            nodes = [n.to_wire() for n in self.table.closest(target, K_BUCKET)]
+            reply: dict[str, Any] = {"type": "nodes", "nodes": nodes}
+            if mtype == "lookup":
+                reply["peers"] = {
+                    key: payload for key, (payload, _)
+                    in self._store.get(topic_hex, {}).items()}
+            return reply
+        if mtype == "announce":
+            topic_hex = msg.get("topic", "")
+            payload = msg.get("payload")
+            sender = msg.get("from")
+            if (isinstance(payload, dict) and isinstance(sender, list)
+                    and len(topic_hex) == 64):
+                # Key by the announced publicKey (falling back to the DHT
+                # node id): a restarted announcer overwrites its old
+                # record instead of accumulating stale twins. publicKey
+                # records must carry a valid fresh signature under that
+                # key — otherwise anyone could shadow a provider's record.
+                if payload.get("publicKey"):
+                    if not self._verify_announce(topic_hex, payload):
+                        return {"type": "rejected", "error": "bad signature"}
+                    key = str(payload["publicKey"])
+                    # Replay fence: an announce signed BEFORE the owner's
+                    # last verified unannounce must not resurrect the record.
+                    dead_ts = self._tombstones.get((topic_hex, key))
+                    if (dead_ts is not None
+                            and float(payload.get("ts", 0)) <= dead_ts):
+                        return {"type": "rejected", "error": "tombstoned"}
+                else:
+                    # sender[0] is the announcer's DHT node id (the "from"
+                    # field is [node_id_hex, port]) — the same fallback
+                    # _record_key uses, so its unannounce key matches.
+                    key = str(sender[0])
+                self._store_value(topic_hex, key, payload)
+                return {"type": "stored"}
+            return None
+        if mtype == "unannounce":
+            topic_hex = msg.get("topic", "")
+            key = str(msg.get("key", ""))
+            entries = self._store.get(topic_hex, {})
+            existing = entries.get(key)
+            if existing is not None and existing[0].get("publicKey"):
+                # Signed record: removal needs a fresh signature under the
+                # SAME key, timestamped at/after the stored announce — a
+                # forged or replayed unannounce can't evict a live
+                # provider. (Round-2 verdict: discovery-DoS hole.)
+                if not self._verify_unannounce(topic_hex, key, msg,
+                                               existing[0]):
+                    return {"type": "rejected", "error": "bad signature"}
+                self._tombstones[(topic_hex, key)] = float(msg.get("ts", 0))
+            entries.pop(key, None)
+            return {"type": "removed"}
+        return None
+
+    def _verify_announce(self, topic_hex: str, payload: dict) -> bool:
+        from symmetry_tpu.identity import Identity
+
+        try:
+            pub = bytes.fromhex(str(payload["publicKey"]))
+            sig = bytes.fromhex(str(payload.get("sig", "")))
+            ts = float(payload.get("ts", 0))
+        except (ValueError, TypeError):
+            return False
+        if abs(time.time() - ts) > MAX_SIG_SKEW_S:
+            return False
+        return Identity.verify(
+            _announce_sig_msg(topic_hex, payload, ts), sig, pub)
+
+    @staticmethod
+    def _verify_unannounce(topic_hex: str, key: str, msg: dict,
+                           stored: dict) -> bool:
+        from symmetry_tpu.identity import Identity
+
+        try:
+            pub = bytes.fromhex(key)
+            sig = bytes.fromhex(str(msg.get("sig", "")))
+            ts = float(msg.get("ts", 0))
+        except (ValueError, TypeError):
+            return False
+        if abs(time.time() - ts) > MAX_SIG_SKEW_S:
+            return False
+        if ts < float(stored.get("ts", 0)):
+            return False  # replay from before the latest announce
+        return Identity.verify(
+            _unannounce_sig_msg(topic_hex, key, ts), sig, pub)

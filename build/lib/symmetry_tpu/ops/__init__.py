@@ -1,0 +1,19 @@
+"""TPU-first tensor ops: RoPE, attention, normalization, sampling, quant.
+
+Everything here is a pure function over jax arrays with static shapes, safe
+under `jax.jit` — the compute floor the reference never had (it proxied all
+inference to an external HTTP server, reference: src/provider.ts:210-214).
+"""
+
+from symmetry_tpu.ops.rope import apply_rope, rope_cos_sin
+from symmetry_tpu.ops.norm import rms_norm
+from symmetry_tpu.ops.attention import gqa_attention
+from symmetry_tpu.ops.sampling import sample_tokens
+
+__all__ = [
+    "apply_rope",
+    "rope_cos_sin",
+    "rms_norm",
+    "gqa_attention",
+    "sample_tokens",
+]

@@ -1,0 +1,124 @@
+"""Native int8 MXU matmul (W8A8) — measured, NOT routed (see below).
+
+The regime matters (all numbers measured on this v5e, fetch-fenced,
+carry-dependent loops — tools/probe_s8_mxu.py, tools/bisect_decode.py):
+
+  - DECODE (M ≈ slot count, ~128 rows): bandwidth-bound. Every int8 form
+    is convert-throughput-limited; this kernel measured ~50% SLOWER than
+    the XLA mixed dot in the full trunk (48.5 vs 32.1 ms). Decode stays
+    on ops/quant.qmatmul's mixed dot.
+  - PREFILL (M ≥ ~256 token rows): the kernel's s8×s8 MXU tiles measure
+    ~172 TFLOP/s in ISOLATION at M=512 (vs the convert-limited mixed
+    dot), but routed into the real prefill path the end-to-end group
+    time is identical (165.3 vs 167.6 ms) — prefill is not matmul-bound.
+    Since W8A8 adds per-row activation-quant noise for zero measured
+    gain, it is NOT routed; the mixed dot serves both regimes.
+
+Kept as a correct, tested building block (tests/test_qmm.py pins the
+arithmetic against a bit-exact integer reference in interpret mode) and
+as the measurement record — a future TPU generation or a genuinely
+matmul-bound workload may flip the verdict. The activation is quantized
+dynamically per row to int8; the s32 tile products are rescaled in the
+kernel epilogue by (row activation scale × per-output-channel weight
+scale).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Tile sizes measured on v5e (tools/probe_s8_mxu.py, M=512): smaller bn
+# keeps more N-blocks for the grid, which generalizes better to narrow
+# layers; (512, 1024) performs comparably at wide shapes.
+BLOCK_N = 256
+BLOCK_K = 512
+MIN_ROWS = 32  # below this the MXU is mostly idle
+
+
+def _kernel(x_ref, w_ref, xs_ref, ws_ref, o_ref, acc_scr, *, n_k: int,
+            out_dtype):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    acc_scr[:] += jax.lax.dot_general(
+        x_ref[:], w_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+    @pl.when(k == n_k - 1)
+    def _():
+        # epilogue: s32 -> f32, row scale × column scale, cast out
+        o_ref[:] = (acc_scr[:].astype(jnp.float32)
+                    * xs_ref[:] * ws_ref[:]).astype(out_dtype)
+
+
+def _pick_block(dim: int, prefer: int) -> int | None:
+    for cand in (prefer, 512, 256, 128, 64):
+        if cand <= prefer and dim % cand == 0:
+            return cand
+    return None
+
+
+def supports(m: int, k: int, n: int, backend: str) -> bool:
+    """Static gate for the w8a8 kernel (shapes tileable, MXU-worthy M)."""
+    return (backend == "tpu"
+            and m >= MIN_ROWS
+            and _pick_block(k, BLOCK_K) is not None
+            and _pick_block(n, BLOCK_N) is not None)
+
+
+def quantize_rows(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-row int8: x [M, K] -> (q [M, K] s8, scale [M, 1] f32)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
+def w8a8_matmul(
+    x: jnp.ndarray,        # [M, K] float (bf16/f32)
+    wq: jnp.ndarray,       # [K, N] int8
+    w_scale: jnp.ndarray,  # [N] f32 per-output-channel
+    *,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """x @ dequant(wq) with the activation quantized per row to int8 and
+    the product computed as native s8×s8 → s32 MXU tiles."""
+    M, K = x.shape
+    Kw, N = wq.shape
+    assert K == Kw, (K, Kw)
+    out_dtype = out_dtype or x.dtype
+    bk = _pick_block(K, BLOCK_K)
+    bn = _pick_block(N, BLOCK_N)
+    if bk is None or bn is None:
+        raise ValueError(f"untileable w8a8 shape K={K} N={N}")
+    n_k = K // bk
+
+    xq, xs = quantize_rows(x)
+    ws = w_scale.astype(jnp.float32).reshape(1, N)
+
+    return pl.pallas_call(
+        functools.partial(_kernel, n_k=n_k, out_dtype=out_dtype),
+        grid=(N // bn, n_k),
+        in_specs=[
+            pl.BlockSpec((M, bk), lambda n, k: (0, k)),
+            pl.BlockSpec((bk, bn), lambda n, k: (k, n)),
+            pl.BlockSpec((M, 1), lambda n, k: (0, 0)),
+            pl.BlockSpec((1, bn), lambda n, k: (0, n)),
+        ],
+        out_specs=pl.BlockSpec((M, bn), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        scratch_shapes=[pltpu.VMEM((M, bn), jnp.int32)],
+        interpret=interpret,
+    )(xq, wq, xs, ws)

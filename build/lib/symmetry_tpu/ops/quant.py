@@ -1,0 +1,112 @@
+"""Int8 weight quantization (BASELINE config 5: llama3-70b int8 TP).
+
+Symmetric per-output-channel int8: for w [.., in, out], each output column
+gets scale = max|column| / 127, q = round(w / scale). The matmul computes
+(x @ q) * scale — exact w.r.t. per-column scaling, and the int8 weight
+halves HBM traffic vs bf16, which is the decode bottleneck (weights are
+re-read every step).
+
+QuantizedTensor is a pytree, so quantized params stack under lax.scan,
+shard with NamedShardings, and donate exactly like dense ones.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantizedTensor(NamedTuple):
+    q: jnp.ndarray      # int8, same shape as the dense weight
+    scale: jnp.ndarray  # f32, weight shape minus the contraction dim
+
+
+def quantize(w: jnp.ndarray, *, contract_axis: int = -2) -> QuantizedTensor:
+    """Quantize a dense weight along its contraction (input) axis."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=contract_axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q=q, scale=jnp.squeeze(scale, axis=contract_axis))
+
+
+def dequantize(qt: QuantizedTensor, dtype=jnp.float32,
+               *, contract_axis: int = -2) -> jnp.ndarray:
+    scale = jnp.expand_dims(qt.scale, contract_axis)
+    return (qt.q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
+    """x @ w for dense arrays or QuantizedTensor ([in, out] contraction).
+
+    Uses a mixed-precision dot with the int8 operand passed directly — no
+    `astype` on the weight, so XLA never materializes a bf16 copy (for a
+    128k-vocab head that copy alone is >1 GB). Accumulates f32, applies the
+    per-column scales, casts back to the activation dtype.
+
+    Measured alternative, not routed: the native s8×s8 MXU kernel
+    (ops/qmm.py) is ~50% slower in-trunk at decode-sized M and exactly
+    NEUTRAL at prefill-sized M (165.3 vs 167.6 ms per coalesced prefill
+    group on-chip, despite winning isolated matmul microbenchmarks —
+    prefill is not matmul-bound). Since W8A8 would add activation-quant
+    noise for zero measured gain, the mixed dot serves both regimes.
+    """
+    if isinstance(w, QuantizedTensor):
+        y = jax.lax.dot_general(
+            x, w.q,
+            dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return (y * w.scale).astype(x.dtype)
+    return x @ w
+
+
+# One shared jitted quantizer: donating the dense original lets XLA reuse
+# its buffer; both post-hoc tree quantization and quantized init go through
+# this single definition.
+quantize_jit = jax.jit(quantize, donate_argnums=(0,))
+
+
+def quantize_tree(params: dict, keys: tuple[str, ...]) -> dict:
+    """Quantize the named leaves of a params dict in place (donating the
+    dense originals one at a time to bound peak memory)."""
+
+    def visit(node):
+        for name, child in list(node.items()):
+            if isinstance(child, dict):
+                visit(child)
+            elif name in keys:
+                node[name] = quantize_jit(child)
+
+    visit(params)
+    return params
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-token-per-head symmetric int8 for KV cache entries.
+
+    x [..., D] -> (q int8 [..., D], scale f32 [...]): one scale per leading
+    index (token × kv-head), amax over the head_dim axis. At decode the
+    cache read is the second-largest HBM stream after the weights; int8
+    halves it, and the scale array is D× smaller than the payload.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, jnp.squeeze(scale, axis=-1)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("shape", "scale", "dtype", "quantized"))
+def make_leaf(key, shape: tuple[int, ...], scale: float, dtype,
+              quantized: bool = False):
+    """Random-init one parameter leaf fully inside ONE compiled program:
+    normal → scale → cast (→ quantize). Nothing full-precision survives the
+    program, so peak memory per leaf is its fused temporaries — which is
+    what makes 8B-scale quantized init fit on one chip."""
+    w = (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+    return quantize(w) if quantized else w

@@ -1,0 +1,71 @@
+"""Token sampling: greedy / temperature / top-k / top-p, batched and jittable.
+
+Controls are per-slot arrays, not Python scalars, so one compiled sampler
+serves a continuous batch where every request carries its own temperature
+(InferenceRequest sampling fields, provider/backends/base.py). temperature==0
+selects greedy via masking rather than control flow — no recompiles, no
+data-dependent branching under jit.
+
+Perf note: a full [B, V] sort at V=128k costs more than the decode matmuls
+for small models, so sampling is restricted to the top `cap` logits via
+`lax.top_k` (top-k at small k is a cheap partial reduction on TPU). Greedy
+and any top_k <= cap are exact; top-p loses only the probability mass beyond
+the top `cap` tokens (< 1e-3 for typical LM distributions at cap=64).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from symmetry_tpu.ops.attention import NEG_INF
+
+SAMPLING_TOP_CAP = 64
+
+
+def sample_tokens(
+    logits: jnp.ndarray,        # [B, V] float
+    key: jax.Array,             # PRNG key — scalar, or [B] per-slot keys
+    temperature: jnp.ndarray,   # [B] float; 0 => greedy
+    top_p: jnp.ndarray,         # [B] float in (0, 1]; 1 => disabled
+    top_k: jnp.ndarray,         # [B] int32; 0 => disabled
+    cap: int = SAMPLING_TOP_CAP,
+) -> jnp.ndarray:
+    """Returns sampled token ids [B] int32."""
+    B, V = logits.shape
+    cap = min(cap, V)
+    logits = logits.astype(jnp.float32)
+
+    # Scale by temperature (guard 0 to keep the math finite; the greedy lane
+    # is selected by the final where, not by this value).
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+
+    # Partial sort: [B, cap] descending, with original vocab indices.
+    top_logits, top_idx = jax.lax.top_k(scaled, cap)
+
+    ranks = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    # top-k: keep ranks < k (0 disables; anything beyond cap acts as cap).
+    # Greedy (temperature == 0) is expressed as k = 1: with only rank 0
+    # unmasked, the categorical below deterministically returns the argmax —
+    # one select lane, no separate greedy branch.
+    k = jnp.where(top_k > 0, top_k, cap)
+    k = jnp.where(temperature > 0, k, 1)
+    keep = ranks < k[:, None]
+    # top-p: keep the smallest prefix whose probability mass reaches p.
+    # (Mass is computed over the top-cap window — the tail beyond cap is
+    # treated as zero, see module docstring.)
+    probs = jax.nn.softmax(top_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # token i is kept if the mass strictly before it is < p (always keeps rank 0)
+    mass_before = cum - probs
+    keep &= mass_before < top_p[:, None]
+
+    masked = jnp.where(keep, top_logits, NEG_INF)
+    if key.ndim:  # [B] per-slot keys: each row draws from its own stream
+        choice_rank = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row))(key, masked)
+    else:
+        choice_rank = jax.random.categorical(key, masked, axis=-1)  # [B]
+    sampled = jnp.take_along_axis(top_idx, choice_rank[:, None], axis=-1)[:, 0]
+    return sampled.astype(jnp.int32)

@@ -1,0 +1,24 @@
+"""Distributed compute: device mesh, sharding rules, collectives.
+
+The reference's only distributed axes were many independent provider
+processes behind server routing plus per-provider connection caps
+(SURVEY §2.3; reference src/provider.ts:38-40). Intra-provider parallelism
+is net-new here and is expressed the TPU way: a `jax.sharding.Mesh`,
+logical-axis PartitionSpecs on every parameter and activation, and XLA
+inserting the collectives — never hand-written sends.
+"""
+
+from symmetry_tpu.parallel.mesh import MeshSpec, build_mesh
+from symmetry_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    logical_to_spec,
+    shardings_for,
+)
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "DEFAULT_RULES",
+    "logical_to_spec",
+    "shardings_for",
+]

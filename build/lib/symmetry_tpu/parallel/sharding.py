@@ -1,0 +1,73 @@
+"""Logical-axis sharding: name every tensor dim, map names to mesh axes.
+
+Models annotate parameters with logical axis names ("vocab", "embed",
+"heads", ...); a rules table maps each name to a mesh axis (or None for
+replicated). Changing the parallelism strategy = changing the rules, not
+the model. The default rules implement megatron-style tensor parallelism:
+
+    wq/wk/wv column-parallel (shard heads), wo row-parallel,
+    w_gate/w_up column-parallel (shard mlp), w_down row-parallel,
+    embedding + lm_head sharded over vocab.
+
+Under jit with these NamedShardings, XLA inserts exactly the two
+all-reduces per layer (after wo, after w_down) that hand-written megatron
+TP would — but derived from shardings, not coded (SURVEY §5.8 tier (a)).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis name -> mesh axis name (None = replicated)
+DEFAULT_RULES: dict[str, str | None] = {
+    "batch": "data",
+    "seq": None,
+    "layers": None,        # stacked-layer leading dim (lax.scan over it)
+    "embed": None,
+    "heads": "model",
+    "kv_heads": "model",
+    "head_dim": None,
+    "mlp": "model",
+    "vocab": "model",
+    "cache_seq": None,
+    "context": "context",  # sequence-parallel activations (ring attention)
+    "experts": "expert",   # MoE expert parallelism (models/moe.py)
+}
+
+
+def logical_to_spec(
+    axes: tuple[str | None, ...], rules: dict[str, str | None] | None = None
+) -> P:
+    rules = DEFAULT_RULES if rules is None else rules
+    mesh_axes = []
+    for name in axes:
+        if name is None:
+            mesh_axes.append(None)
+            continue
+        if name not in rules:
+            raise KeyError(f"no sharding rule for logical axis {name!r}")
+        mesh_axes.append(rules[name])
+    return P(*mesh_axes)
+
+
+def shardings_for(
+    logical_axes: Any,  # pytree of tuples of logical axis names
+    mesh: Mesh,
+    rules: dict[str, str | None] | None = None,
+) -> Any:
+    """Pytree of NamedShardings matching a pytree of logical-axes tuples."""
+    from symmetry_tpu.ops.quant import QuantizedTensor
+
+    # A logical-axes LEAF is a plain tuple of axis names. QuantizedTensor
+    # is also a tuple (NamedTuple) but is a CONTAINER here — its q/scale
+    # fields each hold their own axes tuple — so it must be recursed into,
+    # not handed to logical_to_spec whole.
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_spec(axes, rules)),
+        logical_axes,
+        is_leaf=lambda x: (isinstance(x, tuple)
+                           and not isinstance(x, QuantizedTensor)),
+    )

@@ -1,0 +1,65 @@
+"""Wire-protocol message keys.
+
+Superset of the reference's `serverMessageKeys` vocabulary
+(reference: src/constants.ts:3-20), which is the de-facto protocol spec between
+server, provider, and client. The reference's misspelled `conectionSize` is kept
+as an accepted alias for interop.
+
+New keys (marked TPU) extend the protocol for the native engine: structured
+token streaming, usage metrics, and graceful drain.
+"""
+
+from __future__ import annotations
+
+
+class MessageKey:
+    # --- reference vocabulary (src/constants.ts:3-20) ---
+    CHALLENGE = "challenge"
+    CONNECTION_SIZE = "connectionSize"
+    CONNECTION_SIZE_ALIAS = "conectionSize"  # sic — reference spelling, accepted on ingress
+    HEARTBEAT = "heartbeat"
+    INFERENCE = "inference"
+    INFERENCE_ENDED = "inferenceEnded"
+    JOIN = "join"
+    JOIN_ACK = "joinAck"
+    LEAVE = "leave"
+    NEW_CONVERSATION = "newConversation"
+    PING = "ping"
+    PONG = "pong"
+    PROVIDER_DETAILS = "providerDetails"
+    REPORT_COMPLETION = "reportCompletion"
+    REQUEST_PROVIDER = "requestProvider"
+    SESSION_VALID = "sessionValid"
+    VERIFY_SESSION = "verifySession"
+
+    # --- TPU-native extensions ---
+    CHALLENGE_RESPONSE = "challengeResponse"  # signed challenge reply (both directions)
+    TOKEN_CHUNK = "tokenChunk"                # structured streamed tokens (engine-native)
+    INFERENCE_ERROR = "inferenceError"        # structured mid-stream failure
+    INFERENCE_CANCEL = "inferenceCancel"      # client aborts one in-flight
+                                              # request by its requestId
+    DRAIN = "drain"                           # graceful shutdown: stop accepting, finish in-flight
+    METRICS = "metrics"                       # provider → server load metrics (tok/s, queue depth)
+    PROVIDER_LIST = "providerList"            # server → client available models
+
+    # --- relay (NAT fallback: server splices client↔provider, payload
+    #     stays end-to-end Noise-encrypted — the reference gets this leg
+    #     from hyperdht relaying; network/relay.py) ---
+    RELAY_CONNECT = "relayConnect"            # client → server {providerKey}
+    RELAY_OPEN = "relayOpen"                  # server → provider {relayId}
+    RELAY_ACCEPT = "relayAccept"              # provider → server {relayId}
+    RELAY_READY = "relayReady"                # server → both ends
+    RELAY_DATA = "relayData"                  # spliced opaque frames
+    RELAY_CLOSE = "relayClose"                # either end / server teardown
+
+
+SERVER_MESSAGE_KEYS = frozenset(
+    v for k, v in vars(MessageKey).items() if not k.startswith("_")
+)
+
+
+def normalize_key(key: str) -> str:
+    """Map reference-compat aliases to canonical keys."""
+    if key == MessageKey.CONNECTION_SIZE_ALIAS:
+        return MessageKey.CONNECTION_SIZE
+    return key

@@ -1,0 +1,4 @@
+from symmetry_tpu.provider.config import ConfigManager, TpuConfig
+from symmetry_tpu.provider.provider import SymmetryProvider
+
+__all__ = ["ConfigManager", "TpuConfig", "SymmetryProvider"]

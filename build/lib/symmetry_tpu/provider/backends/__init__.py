@@ -1,0 +1,4 @@
+from symmetry_tpu.provider.backends.base import InferenceBackend, StreamChunk, get_backend
+from symmetry_tpu.provider.backends.echo import EchoBackend
+
+__all__ = ["InferenceBackend", "StreamChunk", "get_backend", "EchoBackend"]

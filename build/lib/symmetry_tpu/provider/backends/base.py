@@ -1,0 +1,89 @@
+"""Inference backend seam.
+
+The reference has exactly one backend shape — an external OpenAI-compatible
+HTTP server it proxies to (src/provider.ts:299-319) — selected by the
+`apiProvider` config out of a fixed registry (src/constants.ts:22-29). Here the
+backend is a first-class interface so `tpu_native` (in-process JAX engine) and
+the HTTP proxies are interchangeable:
+
+    backend = get_backend(config)
+    async for chunk in backend.stream(request): ...
+
+Each StreamChunk carries both the raw wire form (forwarded verbatim to the
+client, preserving the reference's passthrough semantics, src/provider.ts:247)
+and the extracted text delta (for data collection — the reference re-parses
+every chunk to get this, src/provider.ts:243-246; we extract once).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Any, AsyncIterator
+
+
+@dataclass(slots=True)
+class InferenceRequest:
+    """An `inference` message payload (reference: src/types.ts:28-31)."""
+
+    messages: list[dict[str, str]]
+    key: str = "inference"
+    # Sampling controls (tpu_native; proxies forward what their API accepts).
+    max_tokens: int | None = None
+    temperature: float | None = None
+    top_p: float | None = None
+    top_k: int | None = None
+    seed: int | None = None
+
+
+@dataclass(slots=True)
+class StreamChunk:
+    raw: str          # exact chunk forwarded to the client (SSE line / JSON line)
+    text: str         # extracted completion delta ("" for control chunks)
+    done: bool = False
+    # Tokens this chunk represents. Engine backends report the true count
+    # (a block-decode chunk carries many tokens); proxy backends leave 0
+    # and the provider falls back to chunk counting — the reference's
+    # accounting (one chunk ≈ one token, src/provider.ts:243-246).
+    tokens: int = 0
+
+
+class InferenceBackend(abc.ABC):
+    """A source of streamed completions."""
+
+    name: str = "?"
+
+    @abc.abstractmethod
+    def stream(self, request: InferenceRequest) -> AsyncIterator[StreamChunk]:
+        """Yield chunks for one completion. Raises BackendError on failure."""
+
+    async def start(self) -> None:
+        """Load weights / open pools. Called once before serving."""
+
+    async def stop(self) -> None:
+        """Release resources; called at provider shutdown."""
+
+    async def healthy(self) -> bool:
+        """Liveness for failure detection (SURVEY §5.3): engine wedge must
+        unregister the provider."""
+        return True
+
+
+class BackendError(RuntimeError):
+    pass
+
+
+def get_backend(config: Any) -> InferenceBackend:
+    """Instantiate the backend named by config.apiProvider."""
+    provider = config.api_provider
+    if provider == "echo":
+        from symmetry_tpu.provider.backends.echo import EchoBackend
+
+        return EchoBackend()
+    if provider == "tpu_native":
+        from symmetry_tpu.provider.backends.tpu_native import TpuNativeBackend
+
+        return TpuNativeBackend(config)
+    from symmetry_tpu.provider.backends.proxy import ProxyBackend
+
+    return ProxyBackend(config)

@@ -1,0 +1,44 @@
+"""Echo backend: deterministic fake model for tests and protocol bring-up.
+
+Streams the last user message back word-by-word as OpenAI-style SSE chunks —
+the 'fake echo model' seam SURVEY §4 calls for, letting the full
+client→server→provider path run with no TPU and no external server.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import AsyncIterator
+
+from symmetry_tpu.provider.backends.base import (
+    InferenceBackend,
+    InferenceRequest,
+    StreamChunk,
+)
+
+
+class EchoBackend(InferenceBackend):
+    name = "echo"
+
+    def __init__(self, delay_s: float = 0.0) -> None:
+        self._delay = delay_s
+
+    async def stream(self, request: InferenceRequest) -> AsyncIterator[StreamChunk]:
+        last_user = ""
+        for m in reversed(request.messages):
+            if m.get("role") == "user":
+                last_user = m.get("content", "")
+                break
+        words = last_user.split(" ") or [""]
+        for i, word in enumerate(words):
+            token = word if i == 0 else " " + word
+            chunk = {
+                "object": "chat.completion.chunk",
+                "model": "echo",
+                "choices": [{"index": 0, "delta": {"content": token}}],
+            }
+            yield StreamChunk(raw=f"data: {json.dumps(chunk)}", text=token)
+            if self._delay:
+                await asyncio.sleep(self._delay)
+        yield StreamChunk(raw="data: [DONE]", text="", done=True)

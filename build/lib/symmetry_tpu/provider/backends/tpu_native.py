@@ -1,0 +1,389 @@
+"""tpu_native backend: the in-process JAX engine as an apiProvider.
+
+The flagship of the rebuild (BASELINE.json north star): where the reference
+could only proxy to an external GPU server (reference: src/provider.ts:
+210-214), this backend hosts the model itself — HF weights pjit-sharded over
+the provider's TPU slice, continuous batching across peers, tokens streamed
+back as OpenAI-style chat.completion.chunk SSE lines so existing clients
+can't tell the difference (same wire format the proxy backends forward).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Any, AsyncIterator
+
+from symmetry_tpu.engine.engine import EngineError, InferenceEngine, SamplingParams
+from symmetry_tpu.engine.scheduler import AsyncSession, Scheduler
+from symmetry_tpu.provider.backends.base import (
+    BackendError,
+    InferenceBackend,
+    InferenceRequest,
+    StreamChunk,
+)
+from symmetry_tpu.utils.logging import logger as log
+
+DEFAULT_MAX_NEW_TOKENS = 512
+
+
+class TpuNativeBackend(InferenceBackend):
+    """Two isolation modes (tpu.engine_isolation):
+
+    "process" (default): the engine lives in a host subprocess behind a
+    JSON-lines pipe (engine/host.py). Measured necessity, not taste: the
+    in-process engine thread's GIL-held device syncs starved the
+    provider's event loop so badly that every client's TTFT equalled the
+    benchmark's wall time.
+
+    "inproc": the engine thread shares this process (tests, debugging,
+    and anything that needs direct engine access).
+    """
+
+    name = "tpu_native"
+
+    def __init__(self, config: Any) -> None:
+        self._config = config
+        self._model_name = config.model_name
+        self._engine: InferenceEngine | None = None
+        self._scheduler: Scheduler | None = None
+        self._command_loop = None
+        self._proc: asyncio.subprocess.Process | None = None
+        self._cfg_path: str | None = None
+        self._queues: dict[str, asyncio.Queue] = {}
+        self._reader: asyncio.Task | None = None
+        self._started = False
+        self._host_dead = False
+        self._engine_alive = True  # host-reported scheduler liveness
+        self._stats_waiters: list[asyncio.Future] = []
+
+    @property
+    def _process_mode(self) -> bool:
+        return getattr(self._config.tpu, "engine_isolation",
+                       "process") == "process"
+
+    async def start(self) -> None:
+        """Load weights and start the engine (may take minutes for large
+        checkpoints; nothing here blocks the event loop)."""
+        if self._started:
+            return
+        tpu_cfg = self._config.tpu
+        mh = tpu_cfg.multihost
+        if mh and mh.get("num_processes", 1) > 1 and mh.get("process_id", 0) != 0:
+            # Refuse BEFORE joining the distributed job / loading weights —
+            # a wrong-rank provider would become a dead participant the
+            # other ranks hang on.
+            raise BackendError(
+                "only rank 0 runs the provider; start other ranks with "
+                "`python -m symmetry_tpu.provider --worker`")
+        if self._process_mode:
+            await self._start_host_process()
+        else:
+            await self._start_inproc()
+        self._started = True
+
+    async def _start_inproc(self) -> None:
+        from symmetry_tpu.utils.compile_cache import enable_compile_cache
+
+        tpu_cfg = self._config.tpu
+        mh = tpu_cfg.multihost
+        enable_compile_cache(tpu_cfg)
+
+        def build() -> InferenceEngine:
+            return InferenceEngine.from_tpu_config(tpu_cfg)
+
+        self._engine = await asyncio.to_thread(build)
+        sched_engine = self._engine
+        if mh and mh.get("num_processes", 1) > 1:
+            # Rank 0 fronts the network; its scheduler drives all ranks in
+            # lockstep through the command loop (parallel/multihost.py).
+            from symmetry_tpu.parallel.multihost import (
+                CommandLoop, MultihostEngine)
+
+            self._command_loop = CommandLoop(self._engine,
+                                             is_coordinator=True)
+            sched_engine = MultihostEngine(self._command_loop)
+        # Compile the decode program before taking traffic: the first
+        # request must never stall every stream on a fresh XLA compile.
+        await asyncio.to_thread(sched_engine.warmup)
+        self._scheduler = Scheduler(sched_engine)
+        self._scheduler.start()
+        log.info(
+            f"tpu_native engine up (inproc): model={self._model_name} "
+            f"slots={self._engine.max_slots} seq={self._engine.max_seq_len}")
+
+    async def _start_host_process(self) -> None:
+        import sys
+        import tempfile
+
+        import yaml
+
+        cfg = {k: v for k, v in self._config.get_all().items()
+               if k != "apiKey"}
+        with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                         delete=False) as fh:
+            yaml.safe_dump(cfg, fh)
+            self._cfg_path = fh.name
+        self._proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "symmetry_tpu.engine.host", self._cfg_path,
+            stdin=asyncio.subprocess.PIPE, stdout=asyncio.subprocess.PIPE)
+        # await the ready line (weight loading + warmup happen in the host)
+        while True:
+            line = await self._proc.stdout.readline()
+            if not line:
+                rc = await self._proc.wait()
+                raise BackendError(f"engine host died during startup "
+                                   f"(rc={rc})")
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if msg.get("op") == "ready":
+                break
+        self._reader = asyncio.get_running_loop().create_task(
+            self._read_events())
+        log.info(f"tpu_native engine host up (pid {self._proc.pid}): "
+                 f"model={self._model_name}")
+
+    async def _read_events(self) -> None:
+        assert self._proc is not None and self._proc.stdout is not None
+        while True:
+            line = await self._proc.stdout.readline()
+            if not line:
+                break  # host exited
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if msg.get("op") == "stats":
+                # stats reply: liveness for the health loop + the full
+                # scheduler breakdown for engine_stats() consumers
+                self._engine_alive = bool(msg.get("engine_alive", True))
+                waiters, self._stats_waiters = self._stats_waiters, []
+                for w in waiters:
+                    if not w.done():
+                        w.set_result(msg)
+                continue
+            if msg.get("op") != "event":
+                continue
+            q = self._queues.get(str(msg.get("id", "")))
+            if q is not None:
+                q.put_nowait(msg)
+        # fail every open stream — the host is gone. _host_dead also fences
+        # NEW streams (they would otherwise register a queue nobody feeds
+        # and hang forever).
+        self._host_dead = True
+        for q in self._queues.values():
+            q.put_nowait({"op": "event", "done": True,
+                          "finish_reason": "error",
+                          "error": "engine host exited", "text": ""})
+
+    async def _host_send(self, obj: dict) -> None:
+        assert self._proc is not None and self._proc.stdin is not None
+        self._proc.stdin.write(
+            (json.dumps(obj, separators=(",", ":")) + "\n").encode())
+        await self._proc.stdin.drain()
+
+    async def stop(self) -> None:
+        self._started = False
+        if self._proc is not None:
+            import contextlib
+            import os
+
+            with contextlib.suppress(ConnectionError, OSError):
+                await self._host_send({"op": "shutdown"})
+            try:
+                await asyncio.wait_for(self._proc.wait(), 30)
+            except asyncio.TimeoutError:
+                self._proc.kill()
+                await self._proc.wait()  # reap — no zombie
+            if self._reader is not None:
+                self._reader.cancel()
+                self._reader = None
+            if self._cfg_path:
+                with contextlib.suppress(OSError):
+                    os.unlink(self._cfg_path)
+            self._proc = None
+        if self._scheduler is not None:
+            await asyncio.to_thread(self._scheduler.stop)
+            if self._command_loop is not None:
+                self._command_loop.stop()  # release worker ranks
+                self._command_loop = None
+            self._scheduler = None
+            self._engine = None
+
+    async def _probe_host_stats(self, timeout: float = 10.0) -> dict | None:
+        """One fresh stats round-trip to the host; None on timeout/failure
+        (a fire-and-forget probe would return the PREVIOUS probe's answer,
+        delaying wedge detection by a health-loop period)."""
+        import contextlib
+
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._stats_waiters.append(fut)
+        try:
+            with contextlib.suppress(ConnectionError, OSError):
+                await self._host_send({"op": "stats"})
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return None
+        finally:
+            if fut in self._stats_waiters:
+                self._stats_waiters.remove(fut)
+
+    async def engine_stats(self) -> dict | None:
+        """The scheduler's serving breakdown (counters, engine-side TTFT,
+        admission dispatch and block-interval percentiles) — surfaced
+        through provider METRICS so a benchmark capture can attribute
+        stalls to engine vs relay/wire (round-3 verdict #1/#3)."""
+        if self._proc is not None:
+            if self._host_dead or self._proc.returncode is not None:
+                return None
+            msg = await self._probe_host_stats()
+            if msg is None:
+                return None
+            return {k: v for k, v in msg.items() if k != "op"}
+        if self._scheduler is None:
+            return None
+        stats = getattr(self._scheduler, "stats", None)
+        return stats() if stats is not None else dict(self._scheduler.metrics)
+
+    async def healthy(self) -> bool:
+        """Engine liveness: a wedged decode loop must fail this (SURVEY §5.3
+        — an engine wedge unregisters the provider). In process mode the
+        host reports its scheduler thread's liveness through the stats op
+        (engine_alive); a dead host or dead engine thread both fail."""
+        if self._proc is not None:
+            if self._host_dead or self._proc.returncode is not None:
+                return False
+            if await self._probe_host_stats() is None:
+                return False
+            return self._engine_alive
+        if self._engine is None or self._scheduler is None:
+            return False
+        thread = self._scheduler._thread
+        return thread is not None and thread.is_alive()
+
+    def _chunk_line(self, request_id: str, created: int, delta: dict,
+                    finish: str | None = None) -> str:
+        payload = {
+            "id": request_id,
+            "object": "chat.completion.chunk",
+            "created": created,
+            "model": self._model_name,
+            "choices": [{"index": 0, "delta": delta,
+                         "finish_reason": finish}],
+        }
+        return f"data: {json.dumps(payload)}"
+
+    async def stream(self, request: InferenceRequest) -> AsyncIterator[StreamChunk]:
+        if not self._started:
+            raise BackendError("tpu_native backend not started")
+        max_new = (request.max_tokens if request.max_tokens is not None
+                   else DEFAULT_MAX_NEW_TOKENS)
+        if max_new < 1:
+            raise BackendError(f"max_tokens must be >= 1, got {max_new}")
+        request_id = f"chatcmpl-{uuid.uuid4().hex[:16]}"
+        created = int(time.time())
+
+        if self._proc is not None:
+            async for chunk in self._stream_host(request, request_id,
+                                                 created, max_new):
+                yield chunk
+            return
+
+        engine = self._engine
+        try:
+            prompt_ids = engine.tokenizer.apply_chat_template(request.messages)
+        except Exception as exc:  # tokenizer/template failure
+            raise BackendError(f"tokenization failed: {exc}") from exc
+
+        session = AsyncSession(self._scheduler,
+                               loop=asyncio.get_running_loop())
+        session.submit(prompt_ids, SamplingParams.from_request(request),
+                       max_new, request_id=request_id)
+
+        def chunk_line(delta: dict, finish: str | None = None) -> str:
+            return self._chunk_line(request_id, created, delta, finish)
+
+        try:
+            yield StreamChunk(raw=chunk_line({"role": "assistant"}), text="")
+            reported = 0
+            async for ev in session.events():
+                if ev.error is not None:
+                    raise BackendError(ev.error)
+                if ev.text:
+                    # exact token accounting: tokens_generated is
+                    # cumulative, a block chunk carries the delta
+                    n_new = max(ev.tokens_generated - reported, 0)
+                    reported = max(ev.tokens_generated, reported)
+                    yield StreamChunk(raw=chunk_line({"content": ev.text}),
+                                      text=ev.text, tokens=n_new)
+                if ev.done:
+                    yield StreamChunk(
+                        raw=chunk_line({}, finish=ev.finish_reason or "stop"),
+                        text="")
+                    yield StreamChunk(raw="data: [DONE]", text="", done=True)
+        finally:
+            session.cancel()  # no-op if complete; frees the slot if client left
+
+    async def _stream_host(self, request: InferenceRequest, request_id: str,
+                           created: int, max_new: int
+                           ) -> AsyncIterator[StreamChunk]:
+        """Host-process path: submit over the pipe, relay its events."""
+        if self._host_dead:
+            raise BackendError("engine host exited")
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[request_id] = queue
+        completed = False
+        try:
+            await self._host_send({
+                "op": "submit", "id": request_id,
+                "messages": request.messages, "max_new": max_new,
+                "sampling": {"temperature": request.temperature or 0.0,
+                             "top_p": (request.top_p
+                                       if request.top_p is not None else 1.0),
+                             "top_k": getattr(request, "top_k", None) or 0,
+                             "seed": request.seed}})
+            yield StreamChunk(
+                raw=self._chunk_line(request_id, created,
+                                     {"role": "assistant"}), text="")
+            while True:
+                # Generous ceiling: even a deep chunked prefill emits
+                # within minutes; a host that is alive-but-wedged would
+                # otherwise hang this stream forever (health checks
+                # deregister the provider, but open streams must end too).
+                try:
+                    ev = await asyncio.wait_for(queue.get(), 600)
+                except asyncio.TimeoutError:
+                    raise BackendError(
+                        "engine host produced no event for 600s") from None
+                err = ev.get("error")
+                if err and ev.get("finish_reason") == "error":
+                    raise BackendError(err)
+                text = ev.get("text", "")
+                if text:
+                    yield StreamChunk(
+                        raw=self._chunk_line(request_id, created,
+                                             {"content": text}),
+                        text=text, tokens=int(ev.get("tokens_new", 0)))
+                if ev.get("done"):
+                    completed = True
+                    yield StreamChunk(
+                        raw=self._chunk_line(
+                            request_id, created, {},
+                            finish=ev.get("finish_reason") or "stop"),
+                        text="")
+                    yield StreamChunk(raw="data: [DONE]", text="",
+                                      done=True)
+                    return
+        finally:
+            self._queues.pop(request_id, None)
+            if (not completed and self._proc is not None
+                    and self._proc.returncode is None):
+                # client abandoned the stream: free the slot host-side
+                import contextlib
+
+                with contextlib.suppress(ConnectionError, OSError):
+                    await self._host_send({"op": "cancel", "id": request_id})

@@ -1,0 +1,204 @@
+"""Provider configuration: YAML file, validated, typed access.
+
+Same `provider.yaml` surface as the reference's ConfigManager
+(reference: src/config.ts:5-51, schema src/types.ts:4-21) — fields
+`apiHostname/apiPath/apiPort/apiProtocol/apiProvider/modelName/name/path/
+public/serverKey/dataCollectionEnabled/maxConnections/apiKey` and `-c` CLI
+override — extended with a `tpu` section for the native engine (mesh shape,
+dtype, KV budget, checkpoint path) per the BASELINE.json north star.
+
+Differences from the reference, on purpose:
+  - `api*` fields are required only for HTTP-proxy backends; the flagship
+    `tpu_native` backend needs none of them.
+  - `apiKey` is never forwarded to the network (the reference sends the whole
+    config, apiKey included, to the server at join — src/provider.ts:103-108).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+# Reference provider registry (src/constants.ts:22-29) + the TPU-native backends.
+PROXY_PROVIDERS = ("litellm", "llamacpp", "lmstudio", "ollama", "oobabooga", "openwebui")
+NATIVE_PROVIDERS = ("tpu_native", "echo")
+API_PROVIDERS = PROXY_PROVIDERS + NATIVE_PROVIDERS
+
+_REQUIRED_ALWAYS = ("apiProvider", "modelName", "name", "public", "serverKey")
+# Reference's required list (src/config.ts:20-30) minus what tpu_native doesn't need.
+_REQUIRED_PROXY = ("apiHostname", "apiPath", "apiPort", "apiProtocol")
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class TpuConfig:
+    """Engine settings for the `tpu_native` backend."""
+
+    mesh: dict[str, int] = field(default_factory=lambda: {"data": 1, "model": 1})
+    dtype: str = "bfloat16"            # parameter/compute dtype
+    quantization: str | None = None    # None | "int8" (weights)
+    kv_quantization: str | None = None  # None | "int8" (KV cache)
+    max_batch_size: int = 8            # decode slots (continuous batching)
+    max_seq_len: int = 2048            # KV capacity per slot
+    prefill_buckets: tuple[int, ...] = (128, 512, 2048)
+    prefill_chunk: int | None = 256    # chunked-prefill step; None disables
+    # Coalesced-prefill width cap per bucket: batch × bucket ≤ budget
+    # (engine.prefill_batches_for). None → engine default (2048 tokens).
+    prefill_token_budget: int | None = None
+    # Decode steps per device dispatch. 16 measured throughput-equal to
+    # 64 at the llama3-8b/128-slot point (double-buffered dispatch hides
+    # the round-trips) with ~2x lower TTFT and inter-chunk latency.
+    decode_block: int = 16
+    # "process" (default, production): the engine runs in a host
+    # subprocess behind a pipe — its GIL-held device syncs would
+    # otherwise starve the provider's event loop and every stream's
+    # latency with it (engine/host.py). "inproc": same-process engine
+    # thread (tests, debugging).
+    engine_isolation: str = "process"
+    pipeline_microbatches: int = 1     # GPipe microbatches (mesh stage > 1)
+    checkpoint_path: str | None = None  # HF safetensors dir; None → random init
+    # Cache the finished (stacked/transposed/quantized) param tree beside
+    # the checkpoint on first load; restarts skip the whole conversion
+    # (engine/weights.py save_warm_cache). SURVEY §5.4 warm restart.
+    warm_cache: bool = True
+    # Persistent XLA compilation cache (utils/compile_cache.py): True →
+    # ~/.cache/symmetry_tpu/xla, a string → that directory, False → off.
+    # A config-identical engine restart then compiles ~nothing.
+    compile_cache: Any = True
+    tokenizer_path: str | None = None   # tokenizer.json; None → byte tokenizer
+    # Informational: every supported family (llama 3.x, mistral, qwen2,
+    # mixtral-MoE, gemma) shares the decoder in models/llama.py, selected
+    # by ModelConfig flags; checkpoints self-describe via config.json.
+    model_family: str = "llama"
+    model_preset: str | None = None     # e.g. "llama3-8b", "tiny" (tests)
+    # Multi-host provider (SURVEY §7 stage 6): one logical provider backed
+    # by N JAX processes. Keys: coordinator ("host:port"), num_processes,
+    # process_id, dcn_data (hosts on the data axis). Rank 0 fronts the
+    # network; other ranks run `python -m symmetry_tpu.provider --worker`.
+    multihost: dict[str, Any] | None = None
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "TpuConfig":
+        kwargs = {}
+        for f in cls.__dataclass_fields__:
+            if f in raw:
+                kwargs[f] = tuple(raw[f]) if f == "prefill_buckets" else raw[f]
+        unknown = set(raw) - set(cls.__dataclass_fields__)
+        if unknown:
+            raise ConfigError(f"unknown tpu config keys: {sorted(unknown)}")
+        return cls(**kwargs)
+
+
+class ConfigManager:
+    """Reads + validates a provider.yaml (reference: src/config.ts:5-51)."""
+
+    def __init__(self, config_path: str | None = None,
+                 config: dict[str, Any] | None = None) -> None:
+        if config is not None:
+            self._config = dict(config)
+        else:
+            if config_path is None:
+                config_path = default_config_path()
+            with open(os.path.expanduser(config_path), "r", encoding="utf-8") as fh:
+                loaded = yaml.safe_load(fh)
+            if not isinstance(loaded, dict):
+                raise ConfigError(f"config at {config_path} is not a mapping")
+            self._config = loaded
+        self._tpu = TpuConfig.from_dict(self._config.get("tpu") or {})
+        self.validate()
+
+    def validate(self) -> None:
+        missing = [k for k in _REQUIRED_ALWAYS if self._config.get(k) is None]
+        provider = self._config.get("apiProvider")
+        if provider in PROXY_PROVIDERS:
+            missing += [k for k in _REQUIRED_PROXY if self._config.get(k) is None]
+        if missing:
+            raise ConfigError(f"missing required config: {sorted(missing)}")
+        if provider not in API_PROVIDERS:
+            raise ConfigError(
+                f"unknown apiProvider {provider!r}; expected one of {API_PROVIDERS}"
+            )
+        if not isinstance(self._config["public"], bool):
+            # Reference enforces the same (src/config.ts:40-44).
+            raise ConfigError("config field 'public' must be a boolean")
+        if "maxConnections" in self._config and (
+            not isinstance(self._config["maxConnections"], int)
+            or self._config["maxConnections"] < 1
+        ):
+            raise ConfigError("maxConnections must be a positive integer")
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._config.get(key, default)
+
+    def get_all(self) -> dict[str, Any]:
+        return dict(self._config)
+
+    def public_view(self) -> dict[str, Any]:
+        """Config as announced to server/clients — secrets stripped."""
+        view = {k: v for k, v in self._config.items() if k not in ("apiKey", "tpu")}
+        return view
+
+    @property
+    def tpu(self) -> TpuConfig:
+        return self._tpu
+
+    # Convenience typed accessors for the hot fields.
+    @property
+    def name(self) -> str:
+        return self._config["name"]
+
+    @property
+    def model_name(self) -> str:
+        return self._config["modelName"]
+
+    @property
+    def api_provider(self) -> str:
+        return self._config["apiProvider"]
+
+    @property
+    def public(self) -> bool:
+        return self._config["public"]
+
+    @property
+    def server_key(self) -> bytes:
+        return bytes.fromhex(self._config["serverKey"])
+
+    @property
+    def max_connections(self) -> int:
+        return self._config.get("maxConnections", 10)
+
+    @property
+    def data_collection_enabled(self) -> bool:
+        return bool(self._config.get("dataCollectionEnabled", False))
+
+
+def default_config_path() -> str:
+    """~/.config/symmetry/provider.yaml (reference: src/symmetry.ts:13-17)."""
+    return os.path.join(
+        os.path.expanduser("~"), ".config", "symmetry", "provider.yaml"
+    )
+
+
+def write_default_config(path: str, *, name: str, server_key_hex: str,
+                         model_name: str = "llama3:8b") -> None:
+    """Scaffold a provider.yaml (reference: install.sh:35-50)."""
+    cfg = {
+        "name": name,
+        "public": True,
+        "serverKey": server_key_hex,
+        "modelName": model_name,
+        "apiProvider": "tpu_native",
+        "maxConnections": 10,
+        "dataCollectionEnabled": False,
+        "path": os.path.dirname(os.path.expanduser(path)),
+        "tpu": {"mesh": {"data": 1, "model": 1}, "dtype": "bfloat16"},
+    }
+    os.makedirs(os.path.dirname(os.path.expanduser(path)), exist_ok=True)
+    with open(os.path.expanduser(path), "w", encoding="utf-8") as fh:
+        yaml.safe_dump(cfg, fh, sort_keys=False)

@@ -1,0 +1,604 @@
+"""The provider node: the heart of the framework.
+
+Re-creation of the reference's `SymmetryProvider` lifecycle
+(src/provider.ts:21-323) — swarm presence, server registration with challenge
+auth, per-peer inference streaming with backpressure, data collection — with
+the deliberate upgrades SURVEY §§3-5 call for:
+
+  - enforced mutual auth (reference's server verification is advisory,
+    src/provider.ts:157-171)
+  - session tokens verified offline against the trusted serverKey
+  - accurate connection accounting reported to the server (the reference's
+    `_providerConnections` counter is decremented but never incremented —
+    latent bug, src/provider.ts:76-80)
+  - reconnect-with-backoff to the server; the reference never reconnects
+  - graceful drain on shutdown + explicit `leave` (the reference defines the
+    key but never sends it, src/constants.ts:11)
+  - backend health checks: a wedged engine deregisters the provider
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import time
+from typing import Any
+
+from symmetry_tpu.identity import Identity
+from symmetry_tpu.network.peer import Peer
+from symmetry_tpu.protocol.keys import MessageKey
+from symmetry_tpu.provider.backends.base import (
+    BackendError,
+    InferenceBackend,
+    InferenceRequest,
+    get_backend,
+)
+from symmetry_tpu.provider.collect import DataCollector
+from symmetry_tpu.provider.config import ConfigManager
+from symmetry_tpu.server import tokens as session_tokens
+from symmetry_tpu.transport.base import Connection, Listener, Transport
+from symmetry_tpu.utils.logging import logger
+from symmetry_tpu.utils.trace import Tracer
+
+RECONNECT_BASE_S = 1.0
+RECONNECT_MAX_S = 60.0
+HEALTH_INTERVAL_S = 15.0
+
+
+def _load_or_create_secret(path: str) -> bytes:
+    """Per-node secret salting the name-derived identity seed.
+
+    Keeps the reference's UX (stable identity from the configured name,
+    src/provider.ts:41-43) without its guessable-identity flaw.
+    """
+    path = os.path.expanduser(path)
+    if os.path.exists(path):
+        with open(path, "rb") as fh:
+            return fh.read()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    secret = os.urandom(32)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+    with os.fdopen(fd, "wb") as fh:
+        fh.write(secret)
+    return secret
+
+
+class SymmetryProvider:
+    def __init__(
+        self,
+        config: ConfigManager | str | None = None,
+        *,
+        transport: Transport | None = None,
+        identity: Identity | None = None,
+        backend: InferenceBackend | None = None,
+        server_address: str | None = None,
+    ) -> None:
+        if isinstance(config, ConfigManager):
+            self.config = config
+        else:
+            self.config = ConfigManager(config_path=config)
+        if transport is None:
+            from symmetry_tpu.transport import transport_for
+
+            # Scheme-select from the server address — constructor override
+            # first, then config (udp:// engages the native udpstream
+            # transport; default tcp).
+            transport = transport_for(
+                server_address or self.config.get("serverAddress") or "")
+        self._transport = transport
+        if identity is None:
+            seed_hex = self.config.get("privateSeed")
+            if seed_hex:
+                identity = Identity.from_seed(bytes.fromhex(seed_hex))
+            else:
+                secret_path = self.config.get(
+                    "secretPath",
+                    os.path.join(self.config.get("path", "~/.config/symmetry"),
+                                 "identity.secret"),
+                )
+                identity = Identity.from_name(
+                    self.config.name, _load_or_create_secret(secret_path)
+                )
+        self.identity = identity
+        self.backend = backend if backend is not None else get_backend(self.config)
+        self.collector = DataCollector(
+            self.config.get("path", "~/.config/symmetry"),
+            self.config.data_collection_enabled,
+        )
+        self._server_address = server_address or self.config.get("serverAddress")
+        self._listener: Listener | None = None
+        self._server_peer: Peer | None = None
+        self._dht: Any = None  # network/dht.py DHTNode when dht: configured
+        self._client_peers: set[Peer] = set()
+        self._conversation_index: dict[str, int] = {}
+        # multiplexed inference: (peer, requestId) -> pump task, so an
+        # inferenceCancel can abort exactly one stream
+        self._inference_tasks: dict[tuple[int, str], asyncio.Task] = {}
+        self._tasks: set[asyncio.Task] = set()
+        self._draining = False
+        self._in_flight = 0
+        self._stopped = asyncio.Event()
+        self._server_ready = asyncio.Event()
+        # Metrics (SURVEY §5.5: tok/s, queue depth first-class). Latency
+        # distributions live in this provider's Tracer (utils/trace.py):
+        # spans feed the same log-bucketed histograms stats() reads, so
+        # there is exactly one aggregation path — p50/p99 TTFT is the
+        # BASELINE.json headline metric.
+        self.tracer = Tracer()
+        self.metrics: dict[str, Any] = {
+            "requests": 0, "tokens_out": 0, "errors": 0,
+        }
+        self._started_at = time.monotonic()
+
+    # ----- lifecycle (reference: init(), src/provider.ts:37-81) -----
+
+    @property
+    def address(self) -> str:
+        assert self._listener is not None, "provider not started"
+        return self._listener.address
+
+    async def start(self, listen_address: str | None = None) -> None:
+        await self.backend.start()
+        listen_address = listen_address or (
+            f"{self._transport.scheme}://"
+            f"{self.config.get('listenHost', '0.0.0.0')}"
+            f":{self.config.get('listenPort', 0)}"
+        )
+        self._listener = await self._transport.listen(listen_address, self._on_peer)
+        logger.info(
+            f"provider {self.config.name!r} listening on {self.address} "
+            f"key={self.identity.public_hex} model={self.config.model_name!r}"
+        )
+        if self.config.public:
+            self._spawn(self._server_loop())
+        self._spawn(self._health_loop())
+        await self._join_dht()
+        self._start_puncher()
+
+    def _start_puncher(self) -> None:
+        """NAT hole punching (network/natpunch.py): keep this provider
+        registered at a rendezvous and answer punch invites, so clients
+        behind NATs can reach the UDP listener directly. Requires the
+        native udp transport (the raw side channel rides its socket)."""
+        self._puncher = None
+        punch_cfg = self.config.get("natPunch")
+        if not punch_cfg:
+            return
+        raw_factory = getattr(self._listener, "raw_channel", None)
+        if raw_factory is None:
+            logger.warning("natPunch configured but the transport has no "
+                           "raw channel (udp:// required); punching disabled")
+            return
+        from symmetry_tpu.network.dht import parse_host_port
+        from symmetry_tpu.network.natpunch import ProviderPuncher
+
+        try:
+            rdv = parse_host_port(punch_cfg["rendezvous"])
+        except (KeyError, ValueError) as exc:
+            logger.error(f"natPunch disabled: {exc}")
+            return
+        self._puncher = ProviderPuncher(raw_factory(), rdv, self.identity)
+        self._puncher.start()
+
+    async def _join_dht(self) -> None:
+        """Announce on the Kademlia DHT (network/dht.py) so clients can
+        discover this provider WITHOUT the central server — the reference's
+        hyperswarm topic-announce (src/provider.ts:44-48), decentralized
+        leg. Topic = discovery_key(our public key)."""
+        dht_cfg = self.config.get("dht")
+        if not dht_cfg:
+            return
+        from symmetry_tpu.network.dht import DHTNode, parse_host_port
+
+        # Discovery is an add-on: NO failure here (bad config, occupied
+        # UDP port, unreachable bootstrap) may take down an otherwise
+        # healthy provider.
+        try:
+            bootstrap = [parse_host_port(e)
+                         for e in dht_cfg.get("bootstrap", [])]
+            # The identity signs announce records: DHT nodes verify them
+            # against our publicKey, so nobody can shadow or evict this
+            # provider's discovery record (network/dht.py).
+            self._dht = DHTNode(identity=self.identity)
+            await self._dht.start(dht_cfg.get("host", "0.0.0.0"),
+                                  int(dht_cfg.get("port", 0)),
+                                  bootstrap=bootstrap)
+            stored = await self._dht.announce(self.identity.discovery_key, {
+                "address": self.address,
+                "publicKey": self.identity.public_hex,
+                "modelName": self.config.model_name,
+            })
+        except (ValueError, TypeError, OSError) as exc:
+            logger.error(f"dht disabled: {exc}")
+            if self._dht is not None:
+                await self._dht.stop()
+                self._dht = None
+            return
+        logger.info(f"dht: announced on {stored} node(s) "
+                    f"(topic {self.identity.discovery_key.hex()[:12]}…)")
+
+    async def wait_registered(self, timeout: float = 10.0) -> None:
+        await asyncio.wait_for(self._server_ready.wait(), timeout)
+
+    async def stop(self, drain_timeout_s: float = 30.0) -> None:
+        """Graceful drain: stop accepting, finish in-flight, leave, close."""
+        self._draining = True
+        if getattr(self, "_puncher", None) is not None:
+            await self._puncher.stop()
+            self._puncher = None
+        if self._dht is not None:
+            with contextlib.suppress(Exception):
+                await self._dht.unannounce(self.identity.discovery_key)
+            await self._dht.stop()
+            self._dht = None
+        deadline = time.monotonic() + drain_timeout_s
+        while self._in_flight > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        if self._server_peer is not None and not self._server_peer.closed:
+            with contextlib.suppress(ConnectionError, OSError):
+                await self._server_peer.send(MessageKey.LEAVE)
+            await self._server_peer.close()
+        self._stopped.set()
+        for task in list(self._tasks):
+            task.cancel()
+        for peer in list(self._client_peers):
+            await peer.close()
+        if self._listener is not None:
+            await self._listener.close()
+        await self.backend.stop()
+
+    def _spawn(self, coro) -> asyncio.Task:
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    # ----- server registration (reference: joinServer(), src/provider.ts:83-131) -----
+
+    async def _server_loop(self) -> None:
+        """Maintain the server connection with exponential backoff."""
+        backoff = RECONNECT_BASE_S
+        while not self._stopped.is_set() and not self._draining:
+            try:
+                await self._join_server()
+                backoff = RECONNECT_BASE_S  # reset after a successful session
+            except asyncio.CancelledError:
+                return
+            except Exception as exc:
+                if not (self._draining or self._stopped.is_set()):
+                    logger.warning(f"server connection lost: {exc}")
+            self._server_ready.clear()
+            if self._stopped.is_set() or self._draining:
+                return
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, RECONNECT_MAX_S)
+
+    async def _join_server(self) -> None:
+        if not self._server_address:
+            raise RuntimeError("public provider requires serverAddress in config")
+        conn = await self._transport.dial(self._server_address)
+        # The handshake pins the serverKey from config — a MITM or imposter
+        # server fails here and we disconnect (not advisory).
+        peer = await Peer.connect(
+            conn, self.identity, initiator=True,
+            expected_remote_key=self.config.server_key,
+        )
+        self._server_peer = peer
+        # Wire-parity challenge flow on top (reference src/provider.ts:95-101).
+        challenge = os.urandom(32)
+        await peer.send(MessageKey.CHALLENGE, {"challenge": challenge.hex()})
+        await peer.send(
+            MessageKey.JOIN,
+            {
+                # Sanitized config — never the apiKey (the reference leaks it,
+                # src/provider.ts:103-108).
+                "config": self.config.public_view(),
+                "discoveryKey": self.identity.discovery_key.hex(),
+                "address": self.address,
+                "modelName": self.config.model_name,
+            },
+        )
+        async for msg in peer:
+            if msg.key == MessageKey.CHALLENGE_RESPONSE:
+                sig = bytes.fromhex((msg.data or {}).get("signature", ""))
+                if not Identity.verify(challenge, sig, self.config.server_key):
+                    await peer.close()
+                    raise ConnectionError("server failed challenge verification")
+                logger.debug("server signature verified")
+            elif msg.key == MessageKey.JOIN_ACK:
+                logger.info("registered with server ✅")
+                self._server_ready.set()
+            elif msg.key == MessageKey.PING:
+                await peer.send(MessageKey.PONG)
+            elif msg.key == MessageKey.RELAY_OPEN:
+                # NAT fallback (network/relay.py): a client that cannot
+                # reach us directly asked the server to splice. Dial the
+                # server back on a fresh connection and serve the client
+                # through it — end-to-end encrypted, server sees only
+                # ciphertext.
+                relay_id = str((msg.data or {}).get("id", ""))
+                if relay_id:
+                    self._spawn(self._serve_relay(relay_id))
+            else:
+                logger.debug(f"provider: unhandled server key {msg.key!r}")
+        raise ConnectionError("server closed connection")
+
+    async def _serve_relay(self, relay_id: str) -> None:
+        from symmetry_tpu.network.relay import RelayedConnection, await_ready
+
+        try:
+            conn = await self._transport.dial(self._server_address)
+            peer = await Peer.connect(
+                conn, self.identity, initiator=True,
+                expected_remote_key=self.config.server_key)
+            await peer.send(MessageKey.RELAY_ACCEPT, {"id": relay_id})
+            await await_ready(peer, relay_id)
+        except (ConnectionError, OSError, asyncio.TimeoutError) as exc:
+            logger.warning(f"relay {relay_id[:8]} setup failed: {exc}")
+            return
+        # From here the relayed channel is an ordinary inbound connection:
+        # the client's Noise handshake (with OUR key pinned) runs through
+        # it, maxConnections and session checks included.
+        await self._on_peer(RelayedConnection(peer, relay_id))
+
+    async def _report_connections(self) -> None:
+        if self._server_peer is not None and not self._server_peer.closed:
+            with contextlib.suppress(ConnectionError, OSError):
+                await self._server_peer.send(
+                    MessageKey.CONNECTION_SIZE, len(self._client_peers)
+                )
+
+    def stats(self) -> dict[str, Any]:
+        """Serving metrics snapshot: counters, tok/s, TTFT/e2e percentiles."""
+        uptime = max(time.monotonic() - self._started_at, 1e-9)
+        return {
+            "requests": self.metrics["requests"],
+            "tokens_out": self.metrics["tokens_out"],
+            "errors": self.metrics["errors"],
+            "in_flight": self._in_flight,
+            "connections": len(self._client_peers),
+            "uptime_s": round(uptime, 1),
+            "tok_s": round(self.metrics["tokens_out"] / uptime, 2),
+            "ttft_s": self.tracer.histogram("ttft_s").to_dict(),
+            "e2e_s": self.tracer.histogram("inference_s").to_dict(),
+            # False when recent DHT announce rounds were fully rejected
+            # (clock skew → silently undiscoverable; network/dht.py).
+            **({"dht_discoverable":
+                self._dht.consecutive_rejected_rounds < 2}
+               if self._dht is not None else {}),
+        }
+
+    async def _health_loop(self) -> None:
+        """Backend health → presence (SURVEY §5.3: engine wedge must
+        unregister the provider); piggybacks the load-metrics report the
+        protocol reserves the `metrics` key for."""
+        while not self._stopped.is_set():
+            await asyncio.sleep(HEALTH_INTERVAL_S)
+            try:
+                ok = await self.backend.healthy()
+            except Exception:
+                ok = False
+            if self._server_peer is not None and not self._server_peer.closed:
+                if not ok:
+                    logger.error("backend unhealthy; leaving server")
+                    with contextlib.suppress(ConnectionError, OSError):
+                        await self._server_peer.send(MessageKey.LEAVE)
+                else:
+                    with contextlib.suppress(ConnectionError, OSError):
+                        await self._server_peer.send(MessageKey.METRICS,
+                                                     self.stats())
+
+    # ----- client peers (reference: listeners(), src/provider.ts:173-193) -----
+
+    async def _on_peer(self, conn: Connection) -> None:
+        if self._draining or len(self._client_peers) >= self.config.max_connections:
+            await conn.close()  # maxConnections cap (src/provider.ts:38-40)
+            return
+        peer = await Peer.connect(conn, self.identity, initiator=False)
+        self._client_peers.add(peer)
+        await self._report_connections()
+        peer_key = peer.remote_public_hex
+        logger.debug(f"client peer connected: {peer_key[:12]}")
+        try:
+            async for msg in peer:
+                if msg.key == MessageKey.NEW_CONVERSATION:
+                    # src/provider.ts:181-183
+                    self._conversation_index[peer_key] = (
+                        self._conversation_index.get(peer_key, 0) + 1
+                    )
+                elif msg.key == MessageKey.INFERENCE:
+                    data = msg.data or {}
+                    req_id = data.get("requestId")
+                    peer_load = sum(1 for (pid, _) in self._inference_tasks
+                                    if pid == id(peer))
+                    if req_id and (id(peer), str(req_id)) in                             self._inference_tasks:
+                        # duplicate id: accepting it would overwrite the
+                        # task entry (bypassing the cap below, orphaning
+                        # the first task's cancel handle) and interleave
+                        # two streams into one client queue
+                        await peer.send(MessageKey.INFERENCE_ERROR, {
+                            "error": "duplicate requestId",
+                            "requestId": req_id})
+                    elif req_id and peer_load >= self.config.get(
+                            "maxConcurrentRequests", 32):
+                        # multiplexing removed the implicit one-per-peer
+                        # serialization; an explicit PER-PEER cap replaces
+                        # it so one client's request flood cannot spawn
+                        # unbounded tasks (other peers are unaffected —
+                        # their aggregate is already bounded by
+                        # maxConnections × this cap)
+                        await peer.send(MessageKey.INFERENCE_ERROR, {
+                            "error": "too many concurrent requests",
+                            "requestId": req_id})
+                    elif req_id:
+                        # Multiplexed mode (round-2 verdict weak #8: the
+                        # wire lacked request ids, forcing one in-flight
+                        # chat per peer): each request pumps in its own
+                        # task, stream messages echo the id, the client
+                        # demultiplexes.
+                        key = (id(peer), str(req_id))
+                        task = self._spawn(
+                            self._handle_inference(peer, data))
+                        self._inference_tasks[key] = task
+                        task.add_done_callback(
+                            lambda _t, k=key:
+                            self._inference_tasks.pop(k, None))
+                    else:
+                        # legacy: one at a time, in-order (reference
+                        # parity, src/provider.ts:195)
+                        await self._handle_inference(peer, data)
+                elif msg.key == MessageKey.INFERENCE_CANCEL:
+                    req_id = str((msg.data or {}).get("requestId", ""))
+                    task = self._inference_tasks.get((id(peer), req_id))
+                    if task is not None:
+                        task.cancel()
+                elif msg.key == MessageKey.PING:
+                    await peer.send(MessageKey.PONG)
+                elif msg.key == MessageKey.METRICS:
+                    # Clients may query the serving snapshot (tok/s, TTFT
+                    # percentiles) — same payload the server receives —
+                    # plus the engine scheduler's own breakdown when the
+                    # backend exposes one (tpu_native.engine_stats), so a
+                    # wire-side stall can be attributed engine vs relay.
+                    payload = self.stats()
+                    engine_stats = getattr(self.backend, "engine_stats",
+                                           None)
+                    if engine_stats is not None:
+                        with contextlib.suppress(Exception):
+                            payload["engine"] = await engine_stats()
+                    await peer.send(MessageKey.METRICS, payload)
+                elif msg.key == MessageKey.LEAVE:
+                    break
+        finally:
+            self._client_peers.discard(peer)
+            await peer.close()
+            await self._report_connections()
+
+    # ----- the hot path (reference: handleInferenceRequest, src/provider.ts:195-275) -----
+
+    def _check_session(self, peer: Peer, data: dict) -> str | None:
+        """Validate the session token offline against the trusted serverKey.
+
+        Private providers (public: false) accept direct unsessioned peers, as
+        the reference's direct-connection mode does.
+        """
+        if not self.config.public or not self.config.get("requireSessions", True):
+            return None
+        payload = session_tokens.verify(
+            data.get("sessionToken"),
+            self.config.server_key,
+            client_key=peer.remote_public_hex,
+            model_name=self.config.model_name,
+        )
+        if payload is None:
+            return "invalid or expired session token"
+        return None
+
+    async def _handle_inference(self, peer: Peer, data: dict) -> None:
+        start = time.monotonic()
+        req_id = data.get("requestId")
+        # echoed on every message of this stream so a multiplexing client
+        # can route chunks; absent for legacy single-stream peers
+        tag = {"requestId": req_id} if req_id else {}
+        messages = data.get("messages")
+        if not isinstance(messages, list):
+            await peer.send(MessageKey.INFERENCE_ERROR,
+                            {"error": "missing messages", **tag})
+            return
+        err = self._check_session(peer, data)
+        if err is not None:
+            await peer.send(MessageKey.INFERENCE_ERROR,
+                            {"error": err, **tag})
+            return
+        request = InferenceRequest(
+            messages=messages,
+            max_tokens=data.get("max_tokens"),
+            temperature=data.get("temperature"),
+            top_p=data.get("top_p"),
+            top_k=data.get("top_k"),
+            seed=data.get("seed"),
+        )
+        self._in_flight += 1
+        self.metrics["requests"] += 1
+        request_id = f"{peer.remote_public_hex[:12]}:{self.metrics['requests']}"
+        completion_parts: list[str] = []
+        first_token_s: float | None = None
+        # hoisted above the try: the cancel handler reports them, and a
+        # cancellation can land before the stream loop assigns anything
+        n_chunks = 0
+        n_tokens = 0
+        try:
+            # Stream-start marker (reference src/provider.ts:234-238).
+            await peer.send(
+                MessageKey.INFERENCE,
+                {"status": "start", "provider": self.backend.name,
+                 "model": self.config.model_name, **tag},
+            )
+            async for chunk in self.backend.stream(request):
+                if peer.closed:
+                    # Mid-stream client death tolerated (src/provider.ts:242,253-254).
+                    logger.debug("client gone mid-stream; aborting pump")
+                    break
+                if chunk.text:
+                    completion_parts.append(chunk.text)
+                    # Engine backends report exact per-chunk token counts;
+                    # proxies leave 0 and we fall back to the reference's
+                    # one-chunk≈one-token accounting.
+                    n_tokens += chunk.tokens or 1
+                    if first_token_s is None:
+                        first_token_s = time.monotonic() - start
+                        self.tracer.record("ttft", start, first_token_s,
+                                           request_id=request_id)
+                # Raw passthrough; Connection.send awaits drain = backpressure
+                # (reference's write/drain discipline, src/provider.ts:248-252).
+                await peer.send(MessageKey.TOKEN_CHUNK,
+                                {"raw": chunk.raw, **tag})
+                n_chunks += 1
+            completion = "".join(completion_parts)
+            if not peer.closed:
+                await peer.send(
+                    MessageKey.INFERENCE_ENDED,
+                    {"chunks": n_chunks, "tokens": n_tokens, **tag},
+                )
+            self.metrics["tokens_out"] += n_tokens
+            self.tracer.record("inference", start, time.monotonic() - start,
+                               request_id=request_id,
+                               tokens=n_tokens, chunks=n_chunks)
+            # Data collection (reference: saveCompletion, src/provider.ts:277-297).
+            peer_key = peer.remote_public_hex
+            await self.collector.save(
+                peer_key=peer_key,
+                conversation_index=self._conversation_index.get(peer_key, 0),
+                messages=messages,
+                completion=completion,
+            )
+            await self._report_completion(data, n_tokens)
+        except BackendError as exc:
+            self.metrics["errors"] += 1
+            logger.error(f"backend error: {exc}")
+            if not peer.closed:
+                with contextlib.suppress(ConnectionError, OSError):
+                    await peer.send(MessageKey.INFERENCE_ERROR,
+                                    {"error": str(exc), **tag})
+        except asyncio.CancelledError:
+            # inferenceCancel (or shutdown): closing the generator frees
+            # the engine slot; tell the client the stream is over
+            if not peer.closed:
+                with contextlib.suppress(ConnectionError, OSError):
+                    await peer.send(MessageKey.INFERENCE_ENDED,
+                                    {"cancelled": True, "chunks": n_chunks,
+                                     "tokens": n_tokens, **tag})
+            raise
+        finally:
+            self._in_flight -= 1
+
+    async def _report_completion(self, data: dict, tokens: int) -> None:
+        token = data.get("sessionToken") or {}
+        session_id = (token.get("payload") or {}).get("sessionId") if isinstance(token, dict) else None
+        if self._server_peer is not None and not self._server_peer.closed:
+            with contextlib.suppress(ConnectionError, OSError):
+                await self._server_peer.send(
+                    MessageKey.REPORT_COMPLETION,
+                    {"sessionId": session_id, "tokens": tokens},
+                )

@@ -1,0 +1,4 @@
+from symmetry_tpu.server.registry import Registry
+from symmetry_tpu.server.broker import SymmetryServer
+
+__all__ = ["Registry", "SymmetryServer"]

@@ -1,0 +1,74 @@
+"""Transport abstractions.
+
+The reference's transport is the hyperswarm native stack (UDX reliable-UDP →
+Noise secret-stream → DHT; SURVEY §1 layers A–C), reached only through
+`swarm.join` + connection events. We make the transport an explicit, injectable
+seam — the one good idea in the reference's test (it mocks hyperswarm whole,
+__test__/cli.test.ts:4-13), generalized: protocol and node logic run unchanged
+over in-memory pipes (tests), TCP (production), or a future C++/UDP transport.
+
+A Connection carries opaque *frames* (bytes in, bytes out, boundaries
+preserved); encryption layers above it (see symmetry_tpu.network.peer).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import AsyncIterator, Awaitable, Callable
+
+
+class Connection(abc.ABC):
+    """A reliable, ordered, frame-boundary-preserving duplex channel."""
+
+    @abc.abstractmethod
+    async def send(self, frame: bytes) -> None:
+        """Send one frame. Applies backpressure (awaits drain) when buffers fill."""
+
+    @abc.abstractmethod
+    async def recv(self) -> bytes | None:
+        """Receive one frame, or None on clean EOF."""
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+    @property
+    @abc.abstractmethod
+    def closed(self) -> bool: ...
+
+    @property
+    def remote_address(self) -> str:
+        return "?"
+
+    async def __aiter__(self) -> AsyncIterator[bytes]:
+        while True:
+            frame = await self.recv()
+            if frame is None:
+                return
+            yield frame
+
+
+ConnectionHandler = Callable[[Connection], Awaitable[None]]
+
+
+class Listener(abc.ABC):
+    """An accepting endpoint bound to an address."""
+
+    @property
+    @abc.abstractmethod
+    def address(self) -> str:
+        """Dialable address string, e.g. 'tcp://10.0.0.2:31337' or 'mem://a'."""
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+
+class Transport(abc.ABC):
+    """Factory for listeners and outbound connections."""
+
+    scheme: str = "?"
+
+    @abc.abstractmethod
+    async def listen(self, address: str, handler: ConnectionHandler) -> Listener: ...
+
+    @abc.abstractmethod
+    async def dial(self, address: str) -> Connection: ...

@@ -1,0 +1,4 @@
+from symmetry_tpu.utils.logging import Logger, LogLevel, logger
+from symmetry_tpu.utils.json import safe_parse_json, dumps
+
+__all__ = ["Logger", "LogLevel", "logger", "safe_parse_json", "dumps"]

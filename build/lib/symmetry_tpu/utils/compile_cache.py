@@ -1,0 +1,61 @@
+"""Persistent XLA compilation cache for production engine processes.
+
+The serving warmup compiles the full (prefill-batch × bucket) grid plus
+the decode program — ~90 s of a measured ~94 s provider startup on a real
+chip (round-3 verdict #4). JAX's persistent compilation cache keys entries
+by HLO + compile options + backend, so a shared directory is safe across
+configs: a different mesh/dtype/bucket grid simply misses and fills its
+own entries. tests/conftest.py wires the same cache for the test suite;
+this module is the production-path equivalent (engine host, in-process
+backend, bench).
+
+The cache is advisory: a backend whose executables can't be serialized
+(or an unwritable directory) degrades to cold compiles with a warning,
+never a failure.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+DEFAULT_CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "symmetry_tpu", "xla")
+
+
+def enable_compile_cache(tpu_cfg: Any = None) -> str | None:
+    """Point JAX's persistent compilation cache at a stable directory.
+
+    `tpu_cfg.compile_cache` (provider.yaml `tpu:` section): True → the
+    default directory, a string → that directory, False → disabled.
+    Returns the directory in use, or None when disabled/unavailable.
+    Call before the first jit compile (startup) for full effect.
+    """
+    setting = True if tpu_cfg is None else getattr(tpu_cfg, "compile_cache",
+                                                   True)
+    if setting is False:
+        return None
+    # An environment-provided cache wins (tests propagate theirs to engine
+    # subprocesses through JAX_COMPILATION_CACHE_DIR; jax reads it at
+    # import, so it is already in effect — don't repoint it).
+    env_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if env_dir:
+        return env_dir
+    cache_dir = setting if isinstance(setting, str) else DEFAULT_CACHE_DIR
+    cache_dir = os.path.expanduser(cache_dir)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Serving compiles are worth persisting even when fast: the grid
+        # is wide, and the default 1 s floor would skip the small-bucket
+        # insert programs that still add up across a restart.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        return cache_dir
+    except Exception as exc:  # noqa: BLE001 — cache is advisory
+        from symmetry_tpu.utils.logging import logger
+
+        logger.warning(f"persistent compile cache unavailable: {exc}")
+        return None

@@ -1,0 +1,102 @@
+"""Leveled singleton logger.
+
+Capability parity with the reference logger (reference: src/logger.ts:11-47):
+four levels, emoji-prefixed colored console output, global singleton. Unlike
+the reference — where only `info` respects the level and warning/error/debug
+always print (src/logger.ts:29-44) — every level here is gated consistently,
+and output is structured enough to grep.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import sys
+import threading
+import time
+
+
+class LogLevel(enum.IntEnum):
+    ERROR = 0
+    WARNING = 1
+    INFO = 2
+    DEBUG = 3
+
+
+_COLORS = {
+    LogLevel.ERROR: "\x1b[31m",    # red
+    LogLevel.WARNING: "\x1b[33m",  # yellow
+    LogLevel.INFO: "\x1b[36m",     # cyan
+    LogLevel.DEBUG: "\x1b[2m",     # dim
+}
+_EMOJI = {
+    LogLevel.ERROR: "❌",
+    LogLevel.WARNING: "⚠️ ",
+    LogLevel.INFO: "ℹ️ ",
+    LogLevel.DEBUG: "🔍",
+}
+_RESET = "\x1b[0m"
+
+
+def _level_from_env(value: str | None) -> LogLevel:
+    """Tolerant parse: number or name; bad values fall back to INFO."""
+    if not value:
+        return LogLevel.INFO
+    try:
+        return LogLevel(int(value))
+    except ValueError:
+        pass
+    try:
+        return LogLevel[value.strip().upper()]
+    except KeyError:
+        print(f"⚠️  ignoring invalid SYMMETRY_LOG_LEVEL={value!r}", file=sys.stderr)
+        return LogLevel.INFO
+
+
+class Logger:
+    """Singleton leveled logger (reference: src/logger.ts:11-24 singleton pattern)."""
+
+    _instance: "Logger | None" = None
+    _lock = threading.Lock()
+
+    def __new__(cls) -> "Logger":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = super().__new__(cls)
+                cls._instance._level = _level_from_env(
+                    os.environ.get("SYMMETRY_LOG_LEVEL")
+                )
+                cls._instance._color = sys.stderr.isatty()
+            return cls._instance
+
+    def set_log_level(self, level: LogLevel | int) -> None:
+        self._level = LogLevel(level)
+
+    @property
+    def level(self) -> LogLevel:
+        return self._level
+
+    def _emit(self, level: LogLevel, *parts: object) -> None:
+        if level > self._level:
+            return
+        ts = time.strftime("%H:%M:%S")
+        msg = " ".join(str(p) for p in parts)
+        line = f"{_EMOJI[level]} [{ts}] {msg}"
+        if self._color:
+            line = f"{_COLORS[level]}{line}{_RESET}"
+        print(line, file=sys.stderr, flush=True)
+
+    def error(self, *parts: object) -> None:
+        self._emit(LogLevel.ERROR, *parts)
+
+    def warning(self, *parts: object) -> None:
+        self._emit(LogLevel.WARNING, *parts)
+
+    def info(self, *parts: object) -> None:
+        self._emit(LogLevel.INFO, *parts)
+
+    def debug(self, *parts: object) -> None:
+        self._emit(LogLevel.DEBUG, *parts)
+
+
+logger = Logger()

@@ -1,0 +1,189 @@
+"""Tracing and serving metrics (SURVEY §5.1).
+
+The reference ships no tracing of its own — its dependency stack embeds
+hypertrace hooks it never enables, and the only counters are wire-level
+byte counts on the peer object. Here the equivalents are first-class:
+
+  - Span/Tracer: per-request spans (receive → first-token → end) with a
+    bounded in-memory ring, cheap enough to leave on. Each provider owns
+    one Tracer instance (provider/provider.py) whose histograms back its
+    stats() snapshot.
+  - Histogram: log-bucketed latency/throughput distributions with
+    percentile estimates — p50/p99 TTFT is the BASELINE.json north-star
+    metric, so it must be computable from a running provider, not from
+    offline logs.
+  - device_trace: on-demand jax.profiler capture for the TPU engine (the
+    "trace capture endpoint" of SURVEY §5.1); writes a TensorBoard-loadable
+    trace directory.
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+
+def _log_buckets(lo: float, hi: float, per_decade: int = 5) -> list[float]:
+    n = max(1, int(math.ceil(math.log10(hi / lo) * per_decade)))
+    ratio = (hi / lo) ** (1.0 / n)
+    return [lo * ratio**i for i in range(n + 1)]
+
+
+class Histogram:
+    """Log-bucketed histogram with percentile estimation.
+
+    Fixed memory, O(log buckets) observe, thread-safe. Default span covers
+    0.1 ms .. 100 s — every latency this framework measures.
+    """
+
+    def __init__(self, lo: float = 1e-4, hi: float = 100.0,
+                 per_decade: int = 5) -> None:
+        self._edges = _log_buckets(lo, hi, per_decade)
+        self._counts = [0] * (len(self._edges) + 1)
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+
+    def observe(self, value: float) -> None:
+        idx = bisect.bisect_right(self._edges, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self.count += 1
+            self.total += value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
+
+    def percentile(self, p: float) -> float | None:
+        """Estimated p-th percentile (0-100); None when empty."""
+        with self._lock:
+            if self.count == 0:
+                return None
+            rank = p / 100.0 * self.count
+            seen = 0.0
+            for i, c in enumerate(self._counts):
+                seen += c
+                if seen >= rank:
+                    if i == 0:
+                        return self._edges[0]
+                    if i > len(self._edges) - 1:
+                        return self.max
+                    return self._edges[i - 1]
+            return self.max
+
+    @property
+    def mean(self) -> float | None:
+        return self.total / self.count if self.count else None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+        }
+
+
+@dataclass(slots=True)
+class Span:
+    """One completed timed section."""
+
+    name: str
+    start: float          # time.monotonic()
+    duration_s: float
+    request_id: str = ""
+    attrs: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"name": self.name, "start": self.start,
+                "duration_s": self.duration_s,
+                "request_id": self.request_id, **self.attrs}
+
+
+class Tracer:
+    """Bounded ring of completed spans + named histograms.
+
+    Instantiate one per component that needs isolated metrics (the
+    provider owns one); hot-path cost when disabled is a single attribute
+    check.
+    """
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self.enabled = True
+        self._spans: deque[Span] = deque(maxlen=capacity)
+        self._hists: dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def span(self, name: str, request_id: str = "",
+             **attrs: Any) -> Iterator[dict[str, Any]]:
+        """Times the enclosed block. Yields the attrs dict so the block can
+        annotate the span (e.g. token counts) before it closes."""
+        if not self.enabled:
+            yield attrs
+            return
+        t0 = time.monotonic()
+        try:
+            yield attrs
+        finally:
+            self.record(name, t0, time.monotonic() - t0,
+                        request_id=request_id, **attrs)
+
+    def record(self, name: str, start: float, duration_s: float,
+               request_id: str = "", **attrs: Any) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._spans.append(Span(name=name, start=start,
+                                    duration_s=duration_s,
+                                    request_id=request_id, attrs=dict(attrs)))
+        self.histogram(f"{name}_s").observe(duration_s)
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            if name not in self._hists:
+                self._hists[name] = Histogram()
+            return self._hists[name]
+
+    def export(self, request_id: str | None = None) -> list[dict[str, Any]]:
+        with self._lock:
+            spans = list(self._spans)
+        if request_id is not None:
+            spans = [s for s in spans if s.request_id == request_id]
+        return [s.to_dict() for s in spans]
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            hists = dict(self._hists)
+        return {name: h.to_dict() for name, h in hists.items()}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._hists.clear()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler device trace for the enclosed block.
+
+    The TPU-era answer to the reference stack's dormant hypertrace hooks:
+    wraps engine work in an XLA/TPU profile (HLO timelines, HBM usage),
+    viewable in TensorBoard or Perfetto."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -11,6 +11,10 @@ REPO_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
 
 echo "Installing symmetry-tpu from $REPO_DIR ..."
 python3 -m pip install --user "$REPO_DIR"
+# Checkout-free alternatives (reference parity: npm global + `pkg` binary):
+#   python3 tools/build_dist.py        -> dist/symmetry_tpu-*.whl (pipx/pip
+#                                         installable) + dist/symmetry-tpu.pyz
+#   python3 symmetry-tpu.pyz provider  -> run any role from the single file
 
 mkdir -p "$CONFIG_DIR"
 if [ -f "$CONFIG_PATH" ]; then

@@ -76,7 +76,8 @@ class Scheduler:
     def __init__(self, engine: InferenceEngine, *,
                  debug_invariants: bool = False,
                  prefill_chunks_per_block: int = 4,
-                 admit_groups_per_block: int = 2) -> None:
+                 admit_groups_per_block: int = 4,
+                 admit_seconds_per_block: float = 0.65) -> None:
         self.engine = engine
         self._inbox: queue.Queue[GenRequest | None] = queue.Queue()
         self._slots: dict[int, _ActiveSlot] = {}
@@ -88,6 +89,19 @@ class Scheduler:
         self._prefill_jobs: list[tuple[Any, GenRequest]] = []
         self._chunks_per_block = prefill_chunks_per_block
         self._admit_groups = admit_groups_per_block
+        # The binding admission bound while streams are active is TIME, not
+        # count, shared by burst admissions and chunked-prefill advances:
+        # stop admitting once the block's admission work exceeds this many
+        # seconds (one dispatch may overshoot — admissions are atomic).
+        # Measured on-chip (round 4): prefill dispatches overlap the
+        # in-flight decode block (async dispatch), so engine-side block
+        # intervals stay <= ~1.6x block time even at 2 wide admissions
+        # per block — while halving the budget to one dispatch per block
+        # only stretched the ramp (TTFT p50 5.0 -> 7.0 s) without moving
+        # the client-observed gap. 0.65 allows ~2 batch-16 prefills per
+        # block; the count caps remain as secondary bounds.
+        self._admit_budget_s = admit_seconds_per_block
+        self._spent_this_block = 0.0
         self._debug = debug_invariants
         self._thread: threading.Thread | None = None
         self._stopping = threading.Event()
@@ -186,6 +200,7 @@ class Scheduler:
         # the old request's block into the new one.
         pending: tuple[Any, dict[int, _ActiveSlot]] | None = None
         while True:
+            self._spent_this_block = 0.0
             drained = self._admit_new()
             if not self._slots and pending is None and not self._prefill_jobs:
                 # Idle boundary: the next block interval would span the
@@ -309,7 +324,9 @@ class Scheduler:
         groups_left = (self._admit_groups
                        if (self._slots or self._prefill_jobs) else None)
         while self._free:
-            if groups_left is not None and groups_left <= 0:
+            if groups_left is not None and (
+                    groups_left <= 0
+                    or self._spent_this_block >= self._admit_budget_s):
                 break
             group: list[tuple[int, GenRequest]] = []
             while self._free and len(group) < batch_cap:
@@ -405,6 +422,7 @@ class Scheduler:
                             slot0, req0.prompt_ids, req0.sampling)]
                 except Exception as exc:  # noqa: BLE001 — engine errors → stream error
                     n_dispatches += 1  # a failed dispatch still cost time
+                    self._spent_this_block += time.perf_counter() - t0
                     for slot, req in sub:
                         self._free.append(slot)
                         log.error(
@@ -415,6 +433,7 @@ class Scheduler:
                     continue
                 dt = time.perf_counter() - t0
                 n_dispatches += 1
+                self._spent_this_block += dt
                 self.metrics["admit_dispatches"] += 1
                 self.metrics["admit_s"] += dt
                 self._admit_hist.observe(dt)
@@ -430,7 +449,17 @@ class Scheduler:
             return
         budget = (self._chunks_per_block if self._slots
                   else max(16, self._chunks_per_block))
+        progressed = 0
         while budget > 0 and self._prefill_jobs:
+            if (self._slots and progressed > 0
+                    and self._spent_this_block >= self._admit_budget_s):
+                # Shared per-block admission time budget exhausted — but
+                # only AFTER at least one chunk ran: _admit_new always
+                # lands at least one group per block, so without this
+                # floor a sustained arrival stream would starve in-flight
+                # chunked prefills (their TTFT growing unboundedly while
+                # later short prompts keep being admitted).
+                break
             job, req = self._prefill_jobs[0]
             if req.cancelled():
                 self._prefill_jobs.pop(0)
@@ -450,8 +479,11 @@ class Scheduler:
                     text="", token_id=None, done=True, finish_reason="error",
                     error=str(exc)))
                 continue
+            dt = time.perf_counter() - t0
             self.metrics["chunk_dispatches"] += 1
-            self.metrics["chunk_s"] += time.perf_counter() - t0
+            self.metrics["chunk_s"] += dt
+            self._spent_this_block += dt
+            progressed += 1
             budget -= 1
             if first is not None:
                 self._prefill_jobs.pop(0)

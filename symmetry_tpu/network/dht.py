@@ -50,7 +50,13 @@ ID_BITS = 256
 VALUE_TTL_S = 10 * 60  # announced peers expire unless re-announced
 REANNOUNCE_S = 4 * 60
 RPC_TIMEOUT_S = 2.0
-MAX_SIG_SKEW_S = VALUE_TTL_S  # wall-clock tolerance on signed records
+# Wall-clock tolerance on signed records: announcer and storing node must
+# agree within this window (10 min), or stores are rejected — signed
+# discovery REQUIRES loosely NTP-synced clocks. A provider whose clock is
+# skewed past this is undiscoverable on remote nodes; DHTNode escalates
+# repeated all-rejected announce rounds to an error and exposes
+# `consecutive_rejected_rounds` for health consumers (round-3 advisor).
+MAX_SIG_SKEW_S = VALUE_TTL_S
 
 
 def _xor_distance(a: bytes, b: bytes) -> int:
@@ -184,6 +190,22 @@ class DHTNode:
         self._seq = 0
         self._announcing: dict[str, dict] = {}
         self._tasks: set[asyncio.Task] = set()
+        # Announce rounds in a row where every reachable node rejected the
+        # record and none stored it (clock skew / bad signature). See
+        # is_discoverable / _announce_once.
+        self.consecutive_rejected_rounds = 0
+
+    # Fully-rejected announce rounds tolerated before this node is
+    # considered undiscoverable (health error + is_discoverable False).
+    REJECTED_ROUNDS_UNHEALTHY = 2
+
+    @property
+    def is_discoverable(self) -> bool:
+        """False once repeated announce rounds were fully rejected — the
+        single health predicate consumed by provider.stats() and the
+        escalation log (keep them in sync by construction)."""
+        return (self.consecutive_rejected_rounds
+                < self.REJECTED_ROUNDS_UNHEALTHY)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -297,6 +319,7 @@ class DHTNode:
         await self._iterative_find(topic)
         targets = self.table.closest(topic, K_BUCKET) or []
         ok = 0
+        rejected = 0
         for node in targets[:K_BUCKET]:
             try:
                 resp = await self._rpc(node.addr, {
@@ -308,11 +331,28 @@ class DHTNode:
                 if resp.get("type") == "stored":
                     ok += 1
                 else:
+                    rejected += 1
                     logger.warning(
                         f"dht announce rejected by {node.addr}: "
                         f"{resp.get('error', resp.get('type'))}")
             except asyncio.TimeoutError:
                 self.table.remove(node.node_id)
+        # Every reachable node rejecting while none stores is a HEALTH
+        # condition, not noise: the classic cause is a skewed local clock
+        # (> MAX_SIG_SKEW_S), which leaves this announcer silently
+        # undiscoverable while its own log shows routine re-announces.
+        if rejected and not ok:
+            self.consecutive_rejected_rounds += 1
+            if not self.is_discoverable:
+                logger.error(
+                    f"dht: {self.consecutive_rejected_rounds} consecutive "
+                    f"announce rounds fully rejected — this node is NOT "
+                    f"discoverable. Most likely cause: local clock skewed "
+                    f"more than {MAX_SIG_SKEW_S / 60:.0f} min from the "
+                    f"storing nodes (signed records require NTP-synced "
+                    f"clocks)")
+        elif ok:
+            self.consecutive_rejected_rounds = 0
         # Always store locally too: a 1-node network must still resolve.
         self._store_value(topic.hex(), self._record_key(payload), payload)
         return ok

@@ -90,6 +90,17 @@ def resolve_endpoint(addr: tuple[str, int]) -> tuple[str, int]:
 
 MAX_REGISTRY = 4096
 REGISTER_SKEW_S = 90.0
+# Source-address proof for `request` (round-3 advisor): UDP sources are
+# spoofable, so an unauthenticated request would let an attacker point a
+# provider's 6-packet punch burst at a victim (small reflection vector)
+# and learn reflexive addresses. A requester must first echo a stateless
+# cookie (keyed hash of its source address + time window) — proving it
+# RECEIVES at the claimed source — before the rendezvous brokers a punch.
+COOKIE_WINDOW_S = 30.0
+# Per-source invite budget: even a cookie-proven source can't grind a
+# provider with endless punch bursts.
+MAX_INVITES_PER_SOURCE = 8
+INVITE_WINDOW_S = 30.0
 
 
 def _register_sig_msg(key_hex: str, ts: float) -> bytes:
@@ -108,12 +119,16 @@ class PunchRendezvous:
     class the DHT's signed announces close."""
 
     def __init__(self) -> None:
+        import os
+
         self._registry: dict[str, tuple[tuple[str, int], float]] = {}
         # replay fence: last accepted signed ts per key — a captured
         # register datagram re-sent from another address must not move
         # the record
         self._last_ts: dict[str, float] = {}
         self._transport: asyncio.DatagramTransport | None = None
+        self._cookie_secret = os.urandom(16)
+        self._invites: dict[tuple[str, int], list[float]] = {}
 
     async def start(self, host: str = "0.0.0.0", port: int = 0) -> None:
         loop = asyncio.get_running_loop()
@@ -166,6 +181,22 @@ class PunchRendezvous:
                     self._send(_msg("registered", addr=list(addr)), addr)
         elif op == "request":
             key = str(msg.get("key", ""))
+            if not self._cookie_ok(str(msg.get("cookie", "")), addr):
+                # Source unproven: answer with a cookie only. A spoofed
+                # source never sees this reply, so it can never present
+                # the cookie — no burst is ever pointed at a bystander.
+                self._send(_msg("challenge", key=key,
+                                cookie=self._cookie_for(addr)), addr)
+                return
+            if not self._invite_allowed(addr):
+                # Proven source, but over its punch budget. Reply
+                # explicitly (safe — the source is cookie-proven) so the
+                # dialer fails fast instead of resending into silence for
+                # its whole timeout; one persistent dial socket serves all
+                # of a client's dials (transport/udp.py), so a reconnect
+                # loop CAN legitimately hit this.
+                self._send(_msg("busy", key=key), addr)
+                return
             entry = self._registry.get(key)
             if entry is None or entry[1] + ENTRY_TTL_S < time.monotonic():
                 self._send(_msg("unknown", key=key), addr)
@@ -176,6 +207,40 @@ class PunchRendezvous:
             self._send(_msg("peer", key=key, addr=list(target_addr)), addr)
             self._send(_msg("invite", addr=list(addr)), target_addr)
         # "punch"/"registered"/"peer"/"invite" arriving here are strays
+
+    def _cookie_for(self, addr: tuple[str, int],
+                    window_off: int = 0) -> str:
+        import hashlib
+
+        window = int(time.time() // COOKIE_WINDOW_S) + window_off
+        return hashlib.blake2b(
+            f"{addr[0]}|{addr[1]}|{window}".encode(),
+            key=self._cookie_secret, digest_size=16).hexdigest()
+
+    def _cookie_ok(self, cookie: str, addr: tuple[str, int]) -> bool:
+        import hmac
+
+        if not cookie:
+            return False
+        # current or previous window: a cookie issued just before a
+        # window boundary must not bounce its echo
+        return any(hmac.compare_digest(cookie, self._cookie_for(addr, off))
+                   for off in (0, -1))
+
+    def _invite_allowed(self, addr: tuple[str, int]) -> bool:
+        now = time.monotonic()
+        if len(self._invites) >= MAX_REGISTRY:  # bound the tracker itself
+            self._invites = {
+                a: ts for a, ts in self._invites.items()
+                if ts and now - ts[-1] < INVITE_WINDOW_S}
+        recent = [t for t in self._invites.get(addr, [])
+                  if now - t < INVITE_WINDOW_S]
+        if len(recent) >= MAX_INVITES_PER_SOURCE:
+            self._invites[addr] = recent
+            return False
+        recent.append(now)
+        self._invites[addr] = recent
+        return True
 
     @staticmethod
     def _verify_register(key_hex: str, msg: dict) -> bool:
@@ -281,8 +346,16 @@ async def punch_dial(transport, rendezvous: tuple[str, int],
     raw = transport.dial_raw_channel()
     deadline = time.monotonic() + timeout_s
     peer_addr: tuple[str, int] | None = None
-    if not raw.send(rendezvous[0], rendezvous[1],
-                    _msg("request", key=target_key_hex)):
+    cookie: str | None = None  # source-address proof (challenge echo)
+
+    def _request() -> bool:
+        body = {"key": target_key_hex}
+        if cookie is not None:
+            body["cookie"] = cookie
+        return raw.send(rendezvous[0], rendezvous[1],
+                        _msg("request", **body))
+
+    if not _request():
         raise ConnectionError(f"cannot send to rendezvous {rendezvous}")
     last_req = time.monotonic()
     burst_task: asyncio.Task | None = None
@@ -292,8 +365,7 @@ async def punch_dial(transport, rendezvous: tuple[str, int],
             now = time.monotonic()
             if got is None:
                 if peer_addr is None and now - last_req > 1.0:
-                    raw.send(rendezvous[0], rendezvous[1],
-                             _msg("request", key=target_key_hex))
+                    _request()
                     last_req = now
                 continue
             payload, host, port = got
@@ -301,9 +373,20 @@ async def punch_dial(transport, rendezvous: tuple[str, int],
             if msg is None:
                 continue
             op = msg.get("op")
+            if op == "challenge" and (host, port) == rendezvous:
+                # Echo the cookie straight back: receiving it at our
+                # claimed source IS the proof the rendezvous wants.
+                cookie = str(msg.get("cookie", "")) or None
+                _request()
+                last_req = now
+                continue
             if op == "unknown" and (host, port) == rendezvous:
                 raise ConnectionError(
                     f"rendezvous does not know provider {target_key_hex[:12]}")
+            if op == "busy" and (host, port) == rendezvous:
+                raise ConnectionError(
+                    "rendezvous rate-limited this source (invite budget); "
+                    "back off before re-dialing")
             if op == "peer" and (host, port) == rendezvous:
                 addr = msg.get("addr") or []
                 if len(addr) == 2 and peer_addr is None:

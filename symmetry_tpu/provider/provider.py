@@ -362,6 +362,10 @@ class SymmetryProvider:
             "tok_s": round(self.metrics["tokens_out"] / uptime, 2),
             "ttft_s": self.tracer.histogram("ttft_s").to_dict(),
             "e2e_s": self.tracer.histogram("inference_s").to_dict(),
+            # False when recent DHT announce rounds were fully rejected
+            # (clock skew → silently undiscoverable; network/dht.py).
+            **({"dht_discoverable": self._dht.is_discoverable}
+               if self._dht is not None else {}),
         }
 
     async def _health_loop(self) -> None:

@@ -224,6 +224,124 @@ class TestRelayFallback:
         run(main())
 
 
+class TestRequestHardening:
+    """The `request` op must not be a reflection primitive: a source must
+    echo a cookie (proving it receives at its claimed address) before any
+    punch is brokered, and even proven sources have an invite budget
+    (round-3 advisor finding)."""
+
+    @staticmethod
+    async def _udp_probe():
+        loop = asyncio.get_running_loop()
+        inbox: asyncio.Queue = asyncio.Queue()
+
+        class _P(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                inbox.put_nowait(data)
+
+        transport, _ = await loop.create_datagram_endpoint(
+            _P, local_addr=("127.0.0.1", 0))
+        return transport, inbox
+
+    def test_uncookied_request_gets_challenge_only(self):
+        async def main():
+            import json as _json
+            import time as _time
+
+            from symmetry_tpu.network.natpunch import _msg, _register_sig_msg
+
+            rdv = PunchRendezvous()
+            await rdv.start("127.0.0.1", 0)
+            prov = Identity.from_name("cookie-prov")
+            ptrans, pinbox = await self._udp_probe()
+            ts = _time.time()
+            ptrans.sendto(
+                wrap_raw(_msg("register", key=prov.public_hex,
+                              ts=round(ts, 3),
+                              sig=prov.sign(_register_sig_msg(
+                                  prov.public_hex, ts)).hex())),
+                ("127.0.0.1", rdv.port))
+            assert _json.loads(unwrap_raw(
+                await asyncio.wait_for(pinbox.get(), 5)))["op"] == "registered"
+
+            ctrans, cinbox = await self._udp_probe()
+            ctrans.sendto(wrap_raw(_msg("request", key=prov.public_hex)),
+                          ("127.0.0.1", rdv.port))
+            reply = _json.loads(unwrap_raw(
+                await asyncio.wait_for(cinbox.get(), 5)))
+            # no peer, no invite — only a challenge back to the source
+            assert reply["op"] == "challenge" and reply["cookie"]
+            assert pinbox.empty(), "provider must NOT be invited yet"
+
+            # echoing the cookie completes the round-trip
+            ctrans.sendto(
+                wrap_raw(_msg("request", key=prov.public_hex,
+                              cookie=reply["cookie"])),
+                ("127.0.0.1", rdv.port))
+            peer = _json.loads(unwrap_raw(
+                await asyncio.wait_for(cinbox.get(), 5)))
+            assert peer["op"] == "peer"
+            invite = _json.loads(unwrap_raw(
+                await asyncio.wait_for(pinbox.get(), 5)))
+            assert invite["op"] == "invite"
+            ptrans.close()
+            ctrans.close()
+            await rdv.stop()
+
+        run(main())
+
+    def test_invite_budget_per_source(self):
+        from symmetry_tpu.network.natpunch import MAX_INVITES_PER_SOURCE
+
+        rdv = PunchRendezvous()
+        addr = ("198.51.100.7", 40000)
+        for _ in range(MAX_INVITES_PER_SOURCE):
+            assert rdv._invite_allowed(addr)
+        assert not rdv._invite_allowed(addr)
+        # other sources are unaffected
+        assert rdv._invite_allowed(("198.51.100.8", 40000))
+
+
+class TestRelayCap:
+    def test_relay_connect_capped_per_client(self):
+        """One client key cannot hold more than MAX_RELAYS_PER_CLIENT
+        pending/active splices (round-3 advisor: unbounded _relays growth
+        + provider-side dial/task per RELAY_OPEN)."""
+        async def main():
+            from symmetry_tpu.protocol.keys import MessageKey
+
+            class _FakePeer:
+                closed = False
+
+                def __init__(self):
+                    self.sent = []
+
+                async def send(self, key, data=None):
+                    self.sent.append((key, data))
+
+            hub = MemoryTransport()
+            ident = Identity.from_name("cap-server")
+            server = SymmetryServer(ident, hub, ping_interval_s=30.0)
+            control = _FakePeer()
+            server._provider_peers["prov-key"] = control
+            client = _FakePeer()
+            for i in range(server.MAX_RELAYS_PER_CLIENT):
+                await server._handle_relay_connect(
+                    client, "client-key", {"providerKey": "prov-key"})
+            assert len(server._relays) == server.MAX_RELAYS_PER_CLIENT
+            assert all(k == MessageKey.RELAY_OPEN for k, _ in control.sent)
+            await server._handle_relay_connect(
+                client, "client-key", {"providerKey": "prov-key"})
+            assert len(server._relays) == server.MAX_RELAYS_PER_CLIENT
+            assert client.sent[-1][0] == MessageKey.RELAY_CLOSE
+            # a different client key is unaffected
+            await server._handle_relay_connect(
+                _FakePeer(), "other-key", {"providerKey": "prov-key"})
+            assert len(server._relays) == server.MAX_RELAYS_PER_CLIENT + 1
+
+        run(main())
+
+
 class TestServerHostedRendezvous:
     def test_server_starts_punch_rendezvous(self):
         """The routing server hosts the punch rendezvous (punch_port=0

@@ -1,0 +1,90 @@
+"""Build distributables: wheel + single-file zipapp (round-3 verdict #9).
+
+The reference ships an npm global install plus a `pkg` single-binary
+build (reference: package.json:8-16, install.sh:21-27). The Python-era
+equivalents here:
+
+  dist/symmetry_tpu-<ver>-py3-none-any.whl   pip/pipx-installable wheel
+                                             (console scripts: provider,
+                                             server, client)
+  dist/symmetry-tpu.pyz                      single-FILE app: run any role
+                                             with `python symmetry-tpu.pyz
+                                             {provider|server|client} ...`
+                                             on any machine whose Python
+                                             env has the deps (jax etc. —
+                                             the TPU runtime cannot be
+                                             bundled into an archive, so
+                                             unlike `pkg` the interpreter
+                                             + deps come from the host)
+
+Run: python tools/build_dist.py   (writes ./dist; no network needed)
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import zipapp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DIST = os.path.join(REPO, "dist")
+
+ZIPAPP_MAIN = '''\
+"""Single-file entry: symmetry-tpu.pyz {provider|server|client} [args...]"""
+import runpy
+import sys
+
+ROLES = ("provider", "server", "client")
+if len(sys.argv) < 2 or sys.argv[1] not in ROLES:
+    print(f"usage: {sys.argv[0]} {{{'|'.join(ROLES)}}} [args...]",
+          file=sys.stderr)
+    sys.exit(2)
+role = sys.argv.pop(1)
+runpy.run_module(f"symmetry_tpu.{role}", run_name="__main__")
+'''
+
+
+def build_wheel() -> str:
+    """Pure-python wheel via pip (offline: no deps resolved)."""
+    subprocess.run(
+        [sys.executable, "-m", "pip", "wheel", "--no-deps", "--no-build-isolation",
+         "-w", DIST, REPO],
+        check=True, cwd=REPO)
+    wheels = sorted(f for f in os.listdir(DIST) if f.endswith(".whl"))
+    assert wheels, "no wheel produced"
+    return os.path.join(DIST, wheels[-1])
+
+
+def build_zipapp() -> str:
+    staging = tempfile.mkdtemp(prefix="symmetry_zipapp_")
+    try:
+        shutil.copytree(
+            os.path.join(REPO, "symmetry_tpu"),
+            os.path.join(staging, "symmetry_tpu"),
+            ignore=shutil.ignore_patterns("__pycache__", "*.pyc"))
+        with open(os.path.join(staging, "__main__.py"), "w") as fh:
+            fh.write(ZIPAPP_MAIN)
+        out = os.path.join(DIST, "symmetry-tpu.pyz")
+        zipapp.create_archive(staging, out,
+                              interpreter="/usr/bin/env python3")
+        return out
+    finally:
+        shutil.rmtree(staging, ignore_errors=True)
+
+
+def main() -> None:
+    os.makedirs(DIST, exist_ok=True)
+    wheel = build_wheel()
+    pyz = build_zipapp()
+    print(f"wheel:  {wheel}")
+    print(f"zipapp: {pyz}")
+    print("install:  pipx install " + os.path.basename(wheel)
+          + "   (or pip install)")
+    print("run:      python symmetry-tpu.pyz provider -c provider.yaml")
+
+
+if __name__ == "__main__":
+    main()

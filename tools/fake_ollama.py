@@ -1,0 +1,90 @@
+"""A minimal OpenAI-compatible streaming backend (fake Ollama).
+
+Serves POST {apiPath} with `stream: true`, emitting `max_tokens` SSE
+chunks in the OpenAI chat.completion.chunk dialect the proxy backend
+parses (symmetry_tpu/provider/backends/proxy.py; reference hot loop
+src/provider.ts:240-258). Used by `bench.py --proxy` to measure the PR1
+reference point — the reference's own architecture (P2P glue around an
+external HTTP inference server) — without needing a real Ollama install:
+the fake emits instantly (token_delay_s=0), so the measured number is the
+proxy/wire path's own overhead ceiling, not the model's speed.
+
+Standalone: python tools/fake_ollama.py [--port 11434] [--delay 0.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+
+def make_app(token_delay_s: float = 0.0):
+    from aiohttp import web
+
+    async def chat(request: "web.Request") -> "web.StreamResponse":
+        body = await request.json()
+        n = int(body.get("max_tokens") or 64)
+        model = body.get("model", "fake")
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        created = int(time.time())
+        for i in range(n):
+            chunk = {
+                "id": "chatcmpl-fake", "object": "chat.completion.chunk",
+                "created": created, "model": model,
+                "choices": [{"index": 0,
+                             "delta": {"content": f"tok{i} "},
+                             "finish_reason": None}],
+            }
+            await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+            if token_delay_s:
+                await asyncio.sleep(token_delay_s)
+        final = {"id": "chatcmpl-fake", "object": "chat.completion.chunk",
+                 "created": created, "model": model,
+                 "choices": [{"index": 0, "delta": {},
+                              "finish_reason": "stop"}]}
+        await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    app = web.Application()
+    # Accept any path: the provider config points apiPath wherever.
+    app.router.add_post("/{tail:.*}", chat)
+    return app
+
+
+async def start_server(host: str = "127.0.0.1", port: int = 0,
+                       token_delay_s: float = 0.0):
+    """Returns (runner, bound_port); `await runner.cleanup()` to stop."""
+    from aiohttp import web
+
+    runner = web.AppRunner(make_app(token_delay_s))
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    bound = site._server.sockets[0].getsockname()[1]
+    return runner, bound
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=11434)
+    ap.add_argument("--delay", type=float, default=0.0,
+                    help="seconds between chunks (0 = flat out)")
+    args = ap.parse_args()
+
+    async def run() -> None:
+        _, port = await start_server(args.host, args.port, args.delay)
+        print(f"fake ollama listening on http://{args.host}:{port}")
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,225 @@
+"""Real-weights serving demo ON the chip (round-3 verdict #5).
+
+One command that proves the north-star claim end to end on hardware:
+"loads HF weights and serves them on TPU". It
+
+  1. authors a REAL checkpoint with `transformers.LlamaForCausalLM
+     .save_pretrained` and a REAL byte-level-BPE `tokenizer.json`
+     (tokenizers library) — the same independent-implementation fixtures
+     tests/test_weights_real.py pins golden logits against;
+  2. serves it through the FULL stack — routing server + tpu_native
+     provider subprocess (engine host on the default JAX backend, i.e.
+     the real TPU when one is attached) + streaming client over TCP;
+  3. asserts the streamed TEXT equals transformers' own greedy
+     continuation of the same rendered chat prompt, and that the wire's
+     token accounting (inferenceEnded.tokens) matches exactly.
+
+Run: python tools/serve_real_weights.py          (uses the default JAX
+backend — the real chip under axon; CPU elsewhere)
+
+The engine runs float32 with highest matmul precision so greedy argmax
+agrees with torch's float32 reference — this is a correctness demo, not
+a perf configuration.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_fixtures(path: str):
+    """Checkpoint + tokenizer files, authored by transformers/tokenizers.
+    Returns (model, tokenizer_dir). Vocab covers every tokenizer id."""
+    import tokenizers
+    import torch
+    import transformers
+
+    tok = tokenizers.Tokenizer(tokenizers.models.BPE(unk_token=None))
+    tok.pre_tokenizer = tokenizers.pre_tokenizers.ByteLevel(
+        add_prefix_space=False)
+    tok.decoder = tokenizers.decoders.ByteLevel()
+    trainer = tokenizers.trainers.BpeTrainer(
+        vocab_size=384, special_tokens=["<|bos|>", "<|eos|>"],
+        initial_alphabet=tokenizers.pre_tokenizers.ByteLevel.alphabet())
+    tok.train_from_iterator(
+        ["hello world", "the quick brown fox", "symmetry on tpu",
+         "user and assistant talk"], trainer)
+    tok.save(os.path.join(path, "tokenizer.json"))
+    with open(os.path.join(path, "tokenizer_config.json"), "w") as fh:
+        json.dump({
+            "tokenizer_class": "PreTrainedTokenizerFast",
+            "bos_token": "<|bos|>",
+            "eos_token": "<|eos|>",
+            "chat_template": (
+                "{% for m in messages %}{{ m['role'] }}: {{ m['content'] }}"
+                "\n{% endfor %}assistant: "),
+        }, fh)
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=tok.get_vocab_size(),
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attention_bias=False,
+        eos_token_id=1,  # <|eos|>
+    )
+    torch.manual_seed(7)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    model.save_pretrained(path, safe_serialization=True)
+    return model
+
+
+async def main() -> int:
+    import yaml
+
+    from symmetry_tpu.client.client import SymmetryClient
+    from symmetry_tpu.engine.tokenizer import HFTokenizer
+    from symmetry_tpu.identity import Identity
+    from symmetry_tpu.server.broker import SymmetryServer
+    from symmetry_tpu.transport.tcp import TcpTransport
+
+    workdir = tempfile.mkdtemp(prefix="symmetry_real_weights_")
+    print(f"[demo] authoring HF checkpoint + tokenizer in {workdir}")
+    model = build_fixtures(workdir)
+    tok = HFTokenizer(workdir)
+
+    server_ident = Identity.from_name("real-weights-server")
+    server = SymmetryServer(server_ident, TcpTransport(),
+                            ping_interval_s=60.0)
+    await server.start("tcp://127.0.0.1:0")
+
+    model_name = "tiny-llama-hf:demo"
+    max_new = 24
+    cfg = {
+        "name": "real-weights-prov",
+        "public": True,
+        "serverKey": server_ident.public_hex,
+        "serverAddress": server.address,
+        "modelName": model_name,
+        "apiProvider": "tpu_native",
+        "dataCollectionEnabled": False,
+        "maxConnections": 4,
+        "listenHost": "127.0.0.1",
+        "privateSeed": hashlib.blake2b(
+            b"real-weights-demo", digest_size=32).hexdigest(),
+        "tpu": {
+            "checkpoint_path": workdir,
+            "tokenizer_path": workdir,
+            "dtype": "float32",
+            "max_batch_size": 2,
+            "max_seq_len": 128,
+            "prefill_buckets": [32],
+            "decode_block": 4,
+            # fresh conversion every run: the demo is about the load path
+            "warm_cache": False,
+        },
+    }
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                     delete=False) as fh:
+        yaml.safe_dump(cfg, fh)
+        cfg_path = fh.name
+
+    env = dict(os.environ)
+    # Greedy argmax must agree with torch's float32 reference: TPU matmuls
+    # default to bf16 passes, which is enough to flip a tiny model's
+    # near-ties.
+    env["JAX_DEFAULT_MATMUL_PRECISION"] = "highest"
+    log_path = os.path.join(workdir, "provider.log")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "symmetry_tpu.provider", "-c", cfg_path],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, stdout=open(log_path, "w"), stderr=subprocess.STDOUT)
+    print("[demo] provider starting (weight load + compile)...")
+    t0 = time.monotonic()
+    try:
+        while server.registry.select_provider(model_name) is None:
+            if proc.poll() is not None:
+                print(open(log_path).read()[-2000:], file=sys.stderr)
+                raise RuntimeError(f"provider exited rc={proc.returncode}")
+            if time.monotonic() - t0 > 900:
+                raise TimeoutError("provider never registered")
+            await asyncio.sleep(1.0)
+        print(f"[demo] provider registered after "
+              f"{time.monotonic() - t0:.0f}s")
+
+        messages = [{"role": "user", "content": "hello"}]
+        client = SymmetryClient(Identity.from_name("real-weights-cli"),
+                                TcpTransport())
+        details = await client.request_provider(
+            server.address, server_ident.public_key, model_name)
+        session = await client.connect(details)
+        deltas = []
+        async for d in session.chat(messages, max_tokens=max_new,
+                                    temperature=0.0):
+            deltas.append(d)
+        usage = dict(session.last_usage or {})
+        await session.close()
+        got_text = "".join(deltas)
+        print(f"[demo] streamed text ({len(deltas)} chunks): {got_text!r}")
+        print(f"[demo] wire usage: {usage}")
+
+        # Golden reference: transformers' own greedy continuation of the
+        # SAME rendered prompt.
+        import torch
+
+        prompt_ids = tok.apply_chat_template(messages)
+        with torch.no_grad():
+            out = model.generate(
+                torch.tensor([prompt_ids]).long(), max_new_tokens=max_new,
+                do_sample=False, use_cache=True, pad_token_id=0)
+        cont = out[0, len(prompt_ids):].tolist()
+        if any(t in tok.eos_ids for t in cont):
+            cut = next(i for i, t in enumerate(cont) if t in tok.eos_ids)
+            n_expected = cut + 1  # engine counts the EOS token it stopped at
+            cont = cont[:cut]
+        else:
+            n_expected = len(cont)
+        want_text = tok.decode(cont)
+        print(f"[demo] transformers greedy: {want_text!r}")
+
+        ok = True
+        if got_text.rstrip("�") != want_text.rstrip("�"):
+            print("[demo] FAIL: streamed text != transformers greedy")
+            ok = False
+        if int(usage.get("tokens", -1)) != n_expected:
+            print(f"[demo] FAIL: wire reported {usage.get('tokens')} "
+                  f"tokens, expected exactly {n_expected}")
+            ok = False
+        if ok:
+            import jax
+
+            backend = jax.default_backend()
+            print(f"[demo] PASS: HF checkpoint served through "
+                  f"server+provider+client, greedy text golden-matched, "
+                  f"exact token accounting ({n_expected} tokens) — bench "
+                  f"process backend: {backend} (engine host runs the same "
+                  f"default backend)")
+        return 0 if ok else 1
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        os.unlink(cfg_path)
+        await server.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))
