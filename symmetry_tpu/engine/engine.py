@@ -255,7 +255,11 @@ class InferenceEngine:
                     prefill_flash=prefill_flash)
             return forward_hidden(params, cfg, tokens, cache,
                                   seq_lens=seq_lens,
-                                  prefill_flash=prefill_flash)
+                                  prefill_flash=prefill_flash,
+                                  # The fused Pallas KV append has no
+                                  # GSPMD partitioning rule; sharded
+                                  # caches keep the XLA scatter path.
+                                  kv_append_ok=self.mesh is None)
 
         def prefill(params, tokens, true_len, temp, top_p, top_k, rng):
             """tokens [N, Sb] padded; returns (first tokens [N], prefix KV).

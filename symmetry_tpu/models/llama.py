@@ -317,6 +317,7 @@ def _layer(
     prefill_flash: bool,        # static: flash self-attention (fresh cache)
     ring_mesh=None,             # static: Mesh => sequence-parallel prefill
     sp_mode: str = "ring",      # static: "ring" | "ulysses" (SURVEY §5.7)
+    kv_append_ok: bool = True,  # static: False for sharded caches (TP/PP)
 ) -> tuple[jnp.ndarray, KVCache]:
     B, S, E = h.shape
     D, nq, nkv = config.dim_per_head, config.num_heads, config.num_kv_heads
@@ -343,19 +344,33 @@ def _layer(
     b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
     l_idx = jnp.full((B, S), layer, jnp.int32)
     if cache.quantized:
+        from symmetry_tpu.ops import kv_append as kva
         from symmetry_tpu.ops.quant import quantize_kv
 
-        kq, ks = quantize_kv(k)  # ks [B, S, K]
-        vq, vs = quantize_kv(v)
-        # Scale planes are [L, B, K, T] (position minor, see KVCache): the
-        # mixed advanced/slice index puts the advanced dims (B, S) in
-        # front, matching the [B, S, K] scale values.
-        cache = cache._replace(
-            k=cache.k.at[l_idx, b_idx, positions].set(kq),
-            v=cache.v.at[l_idx, b_idx, positions].set(vq),
-            k_scale=cache.k_scale.at[l_idx, b_idx, :, positions].set(ks),
-            v_scale=cache.v_scale.at[l_idx, b_idx, :, positions].set(vs),
-        )
+        if (S == 1 and kv_append_ok
+                and kva.supports(cache.k.shape[2], D,
+                                 jax.default_backend(),
+                                 sharded=False)):
+            # Decode: one fused Pallas call quantizes and writes the row
+            # in place — the XLA path below costs ~14 kernels/layer incl.
+            # a full-plane select on the position-minor scale planes
+            # (ops/kv_append.py; round-4 decode-floor work).
+            ck, cv, ks_, vs_ = kva.kv_append(
+                cache.k, cache.v, cache.k_scale, cache.v_scale,
+                k[:, 0], v[:, 0], layer, positions[:, 0])
+            cache = cache._replace(k=ck, v=cv, k_scale=ks_, v_scale=vs_)
+        else:
+            kq, ks = quantize_kv(k)  # ks [B, S, K]
+            vq, vs = quantize_kv(v)
+            # Scale planes are [L, B, K, T] (position minor, see KVCache):
+            # the mixed advanced/slice index puts the advanced dims (B, S)
+            # in front, matching the [B, S, K] scale values.
+            cache = cache._replace(
+                k=cache.k.at[l_idx, b_idx, positions].set(kq),
+                v=cache.v.at[l_idx, b_idx, positions].set(vq),
+                k_scale=cache.k_scale.at[l_idx, b_idx, :, positions].set(ks),
+                v_scale=cache.v_scale.at[l_idx, b_idx, :, positions].set(vs),
+            )
     else:
         cache = cache._replace(
             k=cache.k.at[l_idx, b_idx, positions].set(k.astype(cache.k.dtype)),
@@ -453,6 +468,7 @@ def forward_hidden(
     prefill_flash: bool = False,  # static: caller guarantees cache is empty
     ring_mesh=None,               # static: context-parallel prefill mesh
     sp_mode: str = "ring",        # static: "ring" | "ulysses"
+    kv_append_ok: bool = True,    # static: False when the cache is sharded
 ) -> tuple[jnp.ndarray, KVCache]:
     """Decoder trunk: returns (final-norm hidden states [B, S, E], cache).
 
@@ -506,7 +522,7 @@ def forward_hidden(
     h, new_cache = run_layers(params["layers"], h, cache, positions,
                               kv_valid, seq_lens, config,
                               use_flash=use_flash, use_ring=use_ring,
-                              sp_mode=sp_mode)
+                              sp_mode=sp_mode, kv_append_ok=kv_append_ok)
     h = rms_norm(h, _norm_w(params["final_norm"], config), config.rms_eps)
     return h, new_cache._replace(lengths=kv_valid)
 
@@ -523,6 +539,7 @@ def run_layers(
     use_flash: bool = False,
     use_ring=None,
     sp_mode: str = "ring",
+    kv_append_ok: bool = True,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Scan a stack of decoder layers over `h`. Factored out of
     forward_hidden so pipeline parallelism (parallel/pipeline.py) can run a
@@ -538,7 +555,7 @@ def run_layers(
         lp, l = xs
         h, c = _layer(h, lp, c, l, positions, kv_valid,
                       seq_lens, config, use_flash, ring_mesh=use_ring,
-                      sp_mode=sp_mode)
+                      sp_mode=sp_mode, kv_append_ok=kv_append_ok)
         return (h, c), None
 
     n_layers = jax.tree.leaves(layers_params)[0].shape[0]

@@ -188,18 +188,22 @@ class PunchRendezvous:
                 self._send(_msg("challenge", key=key,
                                 cookie=self._cookie_for(addr)), addr)
                 return
-            if not self._invite_allowed(addr):
-                # Proven source, but over its punch budget. Reply
-                # explicitly (safe — the source is cookie-proven) so the
-                # dialer fails fast instead of resending into silence for
-                # its whole timeout; one persistent dial socket serves all
-                # of a client's dials (transport/udp.py), so a reconnect
-                # loop CAN legitimately hit this.
-                self._send(_msg("busy", key=key), addr)
-                return
             entry = self._registry.get(key)
             if entry is None or entry[1] + ENTRY_TTL_S < time.monotonic():
                 self._send(_msg("unknown", key=key), addr)
+                return
+            # Budget is charged per BROKERED punch (after the registry
+            # hit), not per request datagram: punch_dial retransmits the
+            # request every second while replies are lost, and one
+            # persistent dial socket serves all of a client's dials
+            # (transport/udp.py) — charging retransmissions or
+            # unknown-key probes would burn the whole window on a single
+            # lossy dial and hard-fail the next legitimate one.
+            if not self._invite_allowed(addr):
+                # Proven source, but over its punch budget. Reply
+                # explicitly (safe — the source is cookie-proven) so the
+                # dialer fails fast instead of resending into silence.
+                self._send(_msg("busy", key=key), addr)
                 return
             target_addr = entry[0]
             # Tell the requester where the target is, AND the target where

@@ -1,0 +1,151 @@
+"""Fused decode-step KV append: quantize + in-place cache row write.
+
+At decode (S=1) the XLA path for writing one token's K/V into the int8
+cache costs ~14 kernels per layer — abs/max/div/round/cast chains, four
+`kCustom` scatters, and (for the position-minor scale planes) a
+full-plane select that streams ~5 MB per layer (round-4 HLO audit; the
+quantize_kv ablation alone is ~4.4 ms of the 34.6 ms step at B=128,
+tools/bisect_decode.py). This Pallas kernel replaces the whole cluster
+with ONE call per layer: a B-slot grid where each program quantizes the
+slot's new K/V row (identical math to ops/quant.quantize_kv: scale =
+max(|x|, 1e-8)/127, q = clip(round(x/scale))) and writes payload + scale
+in place through aliased output blocks addressed by scalar-prefetched
+per-slot positions — no scatters, no full-plane traffic.
+
+Out-of-range positions (a retired slot whose stale length reached
+capacity) clamp to the last row, mirroring XLA scatter's drop-OOB
+semantics closely enough: such rows are garbage either way and are
+re-initialized by the next insert. Active slots never exceed capacity
+(scheduler's finish guard).
+
+Numerics: the kernel quantizes the bf16-ROUNDED activations (its operand
+dtype), where the XLA fusion it replaces quantizes pre-rounding values
+(rope's f32 intermediates survive into the fused quantize under
+--xla_allow_excess_precision). Measured on-chip at layer 0: scales
+within one bf16 ULP (0.36% rel), payloads within ±1 int8 step — inside
+the int8-KV quantization noise floor by construction.
+
+TPU-only (supports()); the XLA scatter path remains for CPU, prefill
+(S>1), and sharded caches — a pallas_call has no GSPMD partitioning rule,
+so under a kv_heads-sharded mesh XLA would gather the cache to one
+device. Parity with the XLA path is pinned by tests/test_kv_append.py in
+interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Scale-plane block width along the position (lane) axis: the TPU
+# lowering requires the minor block dim be a multiple of 128 (or the full
+# dim), so the read-modify-write block is K x 128 f32 = 4 KB — still
+# trivial next to the full-plane select it replaces. Capacities that
+# aren't 128-multiples get a partial trailing block (masked write-back).
+SCALE_BLOCK_T = 128
+
+
+def _scale_block_t(capacity: int) -> int:
+    return capacity if capacity < SCALE_BLOCK_T else SCALE_BLOCK_T
+
+
+def supports(cache_capacity: int, head_dim: int, backend: str,
+             sharded: bool) -> bool:
+    if os.environ.get("SYMMETRY_NO_KV_APPEND"):
+        return False
+    return (backend == "tpu"
+            and not sharded
+            and head_dim % 128 == 0
+            # A partial trailing scale block (capacity not 128-aligned)
+            # sends Mosaic down a masked-writeback path measured 4 ms/step
+            # SLOWER than the XLA scatter at the 128x672 point — while the
+            # aligned 128x640 point wins 3 ms. (Unaligned capacities are a
+            # bad idea for the XLA path too: 672 costs ~2 ms/step over 640
+            # before any kernel enters the picture.)
+            and (cache_capacity < SCALE_BLOCK_T
+                 or cache_capacity % SCALE_BLOCK_T == 0))
+
+
+def _kernel(pos_ref, layer_ref,            # scalar prefetch
+            k_ref, v_ref,                  # [1, K, D] new row (post-rope)
+            ck_in, cv_in, ks_in, vs_in,    # aliased cache blocks (in)
+            ck_out, cv_out, ks_out, vs_out):
+    b = pl.program_id(0)
+    block_t = ks_in.shape[3]               # min(128, T)
+    lane = pos_ref[b] % block_t
+    # Mosaic cannot store a vector at a dynamic lane offset ("index in
+    # dimension 3 is a multiple of 128" check) — poke the written lane
+    # with a masked select over the whole (K, block_t) block instead.
+    lane_iota = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, block_t), 3)
+    lane_mask = lane_iota == lane          # [1, 1, 1, block_t]
+    for x_ref, q_out, s_in, s_out in ((k_ref, ck_out, ks_in, ks_out),
+                                      (v_ref, cv_out, vs_in, vs_out)):
+        x = x_ref[0].astype(jnp.float32)                   # [K, D]
+        amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)  # [K, 1]
+        scale = jnp.maximum(amax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        q_out[0, 0, 0] = q
+        # Read-copy-modify: the scale block holds block_t positions'
+        # scales; neighbours must survive the write-back.
+        s_out[...] = jnp.where(lane_mask, scale[None, None, :, :], s_in[...])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def kv_append(
+    cache_k: jnp.ndarray,    # [L, B, T, K, D] int8
+    cache_v: jnp.ndarray,
+    k_scale: jnp.ndarray,    # [L, B, K, T] f32 (position minor)
+    v_scale: jnp.ndarray,
+    k_new: jnp.ndarray,      # [B, K, D] post-rope K for this step
+    v_new: jnp.ndarray,
+    layer: jnp.ndarray,      # scalar int32
+    positions: jnp.ndarray,  # [B] int32 write position per slot
+    *,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    L, B, T, K, D = cache_k.shape
+    pos = jnp.minimum(positions.astype(jnp.int32), T - 1)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape((1,))
+
+    block_t = _scale_block_t(T)
+
+    def payload_map(b, pos_ref, layer_ref):
+        return (layer_ref[0], b, pos_ref[b], 0, 0)
+
+    def scale_map(b, pos_ref, layer_ref):
+        return (layer_ref[0], b, 0, pos_ref[b] // block_t)
+
+    def new_map(b, pos_ref, layer_ref):
+        return (b, 0, 0)
+
+    payload_spec = pl.BlockSpec((1, 1, 1, K, D), payload_map)
+    scale_spec = pl.BlockSpec((1, 1, K, block_t), scale_map)
+    new_spec = pl.BlockSpec((1, K, D), new_map)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[new_spec, new_spec,
+                  payload_spec, payload_spec, scale_spec, scale_spec],
+        out_specs=[payload_spec, payload_spec, scale_spec, scale_spec],
+    )
+    out_k, out_v, out_ks, out_vs = pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(cache_k.shape, cache_k.dtype),
+            jax.ShapeDtypeStruct(cache_v.shape, cache_v.dtype),
+            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        ],
+        # Operand index space includes the 2 scalar-prefetch args: cache_k
+        # is operand 4. In-place row writes, no copies of the ~GB caches.
+        input_output_aliases={4: 0, 5: 1, 6: 2, 7: 3},
+        interpret=interpret,
+    )(pos, layer_arr, k_new, v_new, cache_k, cache_v, k_scale, v_scale)
+    return out_k, out_v, out_ks, out_vs

@@ -96,7 +96,10 @@ def _pp_shard_fn(params, tokens, cache: KVCache, seq_lens,
         h_out, new_mb_cache = run_layers(
             params["layers"], h_in, mb_cache,
             _mb_slice(positions, m, n_micro), _mb_slice(kv_valid, m, n_micro),
-            _mb_slice(seq_lens, m, n_micro), config, use_flash=use_flash)
+            _mb_slice(seq_lens, m, n_micro), config, use_flash=use_flash,
+            # Stage-sharded cache under shard_map: keep the XLA scatter
+            # path (the fused append kernel is gated to unsharded caches).
+            kv_append_ok=False)
 
         # Inactive rounds ran on garbage: select at MICROBATCH granularity
         # (old slice vs new slice) and do one in-place-able update — a

@@ -281,6 +281,10 @@ class SymmetryServer:
     # applies (round-3 advisor) — the same per-peer discipline as the
     # provider's inference cap.
     MAX_RELAYS_PER_CLIENT = 4
+    # A splice the provider never accepts (dial-back failed) must expire,
+    # or 4 such attempts would permanently lock the client out of the
+    # relay fallback for the life of its connection.
+    PENDING_RELAY_TTL_S = 30.0
 
     async def _handle_relay_connect(self, peer: Peer, client_key: str,
                                     data: dict) -> None:
@@ -291,6 +295,14 @@ class SymmetryServer:
                             {"error": f"provider {provider_key[:12]} not "
                                       f"connected; cannot relay"})
             return
+        import time as _time
+
+        now = _time.monotonic()
+        for rid, r in list(self._relays.items()):
+            if (r["b"] is None
+                    and now - r.get("opened_at", now)
+                    > self.PENDING_RELAY_TTL_S):
+                await self._teardown_relay(rid, peer)
         held = sum(1 for r in self._relays.values()
                    if r.get("client_key") == client_key)
         if held >= self.MAX_RELAYS_PER_CLIENT:
@@ -299,7 +311,8 @@ class SymmetryServer:
             return
         relay_id = str(uuid.uuid4())
         self._relays[relay_id] = {"a": peer, "b": None,
-                                  "client_key": client_key}
+                                  "client_key": client_key,
+                                  "opened_at": now}
         try:
             await control.send(MessageKey.RELAY_OPEN, {"id": relay_id})
         except (ConnectionError, OSError):

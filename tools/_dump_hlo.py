@@ -1,0 +1,12 @@
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp
+from symmetry_tpu.models import llama
+cfg = llama.preset("llama3-8b")
+B, T = 128, 640
+params = jax.eval_shape(lambda: llama.init_params(cfg, jax.random.key(0), jnp.bfloat16, quantize=True))
+cache = jax.eval_shape(lambda: llama.init_cache(cfg, B, T, jnp.bfloat16, quantized=True))
+tok = jax.ShapeDtypeStruct((B, 1), jnp.int32)
+trunk = jax.jit(lambda p, t, c: llama.forward_hidden(p, cfg, t, c), donate_argnums=(2,))
+open("/tmp/trunk_hlo.txt", "w").write(trunk.lower(params, tok, cache).compile().as_text())
+print("written")

@@ -1,0 +1,18 @@
+"""A/B the fused KV-append kernel in the full decode trunk on-chip."""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp
+from tools.bisect_decode import time_trunk
+from symmetry_tpu.models import llama
+
+cfg = llama.preset("llama3-8b")
+B, T = 128, int(sys.argv[1]) if len(sys.argv) > 1 else 640
+params = llama.init_params(cfg, jax.random.key(0), jnp.bfloat16, quantize=True)
+
+os.environ["SYMMETRY_NO_KV_APPEND"] = "1"
+off = time_trunk(cfg, params, B, T)
+print(f"kv_append OFF: {off:7.2f} ms", flush=True)
+del os.environ["SYMMETRY_NO_KV_APPEND"]
+on = time_trunk(cfg, params, B, T)
+print(f"kv_append ON:  {on:7.2f} ms  ({off - on:+.2f})", flush=True)

@@ -1,0 +1,41 @@
+"""Does the ragged decode-attention kernel win at 640 capacity under
+PARTIAL occupancy (the serving regime), not just full (round-3's gate
+measurement)? Times the trunk at several occupancies, kernel vs einsum."""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time
+import jax, jax.numpy as jnp
+from _bench_util import sync
+from symmetry_tpu.models import llama
+from symmetry_tpu.ops import decode_attention as da
+
+cfg = llama.preset("llama3-8b")
+B, T = 128, 640
+params = llama.init_params(cfg, jax.random.key(0), jnp.bfloat16, quantize=True)
+
+def time_at(occ, use_kernel, n=15):
+    real = da.supports
+    da.supports = (lambda *a, **k: True) if use_kernel else (lambda *a, **k: False)
+    try:
+        cache = llama.init_cache(cfg, B, T, jnp.bfloat16, quantized=True)
+        cache = cache._replace(lengths=jnp.full((B,), occ, jnp.int32))
+        tok = jnp.ones((B, 1), jnp.int32)
+        trunk = jax.jit(lambda p, t, c: llama.forward_hidden(p, cfg, t, c),
+                        donate_argnums=(2,))
+        for _ in range(3):
+            h, cache = trunk(params, tok, cache)
+        sync(h)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            h, cache = trunk(params, tok, cache)
+        sync(h)
+        return (time.perf_counter() - t0) / n * 1e3
+    finally:
+        da.supports = real
+
+for occ in (128, 320, 512, 620):
+    ein = time_at(occ, False)
+    ker = time_at(occ, True)
+    print(f"occ {occ:4d}/640: einsum {ein:6.2f} ms  kernel {ker:6.2f} ms  "
+          f"({ein - ker:+.2f})", flush=True)

@@ -49,10 +49,16 @@ runpy.run_module(f"symmetry_tpu.{role}", run_name="__main__")
 
 def build_wheel() -> str:
     """Pure-python wheel via pip (offline: no deps resolved)."""
-    subprocess.run(
-        [sys.executable, "-m", "pip", "wheel", "--no-deps", "--no-build-isolation",
-         "-w", DIST, REPO],
-        check=True, cwd=REPO)
+    try:
+        subprocess.run(
+            [sys.executable, "-m", "pip", "wheel", "--no-deps",
+             "--no-build-isolation", "-w", DIST, REPO],
+            check=True, cwd=REPO)
+    finally:
+        # setuptools litters the source tree; keep the checkout clean
+        shutil.rmtree(os.path.join(REPO, "build"), ignore_errors=True)
+        shutil.rmtree(os.path.join(REPO, "symmetry_tpu.egg-info"),
+                      ignore_errors=True)
     wheels = sorted(f for f in os.listdir(DIST) if f.endswith(".whl"))
     assert wheels, "no wheel produced"
     return os.path.join(DIST, wheels[-1])

@@ -1,0 +1,50 @@
+"""Count XLA computations in the compiled decode trunk (what the ~150
+small-kernels-per-step claim is made of). Run on any backend."""
+import os, sys, collections
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp
+from symmetry_tpu.models import llama
+
+cfg = llama.preset(sys.argv[1] if len(sys.argv) > 1 else "llama3-8b")
+B, T = 128, 640
+params = jax.eval_shape(lambda: llama.init_params(cfg, jax.random.key(0),
+                                                  jnp.bfloat16, quantize=True))
+cache = jax.eval_shape(lambda: llama.init_cache(cfg, B, T, jnp.bfloat16,
+                                                quantized=True))
+tok = jax.ShapeDtypeStruct((B, 1), jnp.int32)
+trunk = jax.jit(lambda p, t, c: llama.forward_hidden(p, cfg, t, c),
+                donate_argnums=(2,))
+lowered = trunk.lower(params, tok, cache)
+compiled = lowered.compile()
+txt = compiled.as_text()
+ops = collections.Counter()
+for line in txt.splitlines():
+    line = line.strip()
+    if line.startswith("ROOT "):
+        line = line[5:]
+    if "= " in line and "fusion(" in line:
+        ops["fusion"] += 1
+    elif "custom-call" in line and "= " in line:
+        ops["custom-call"] += 1
+    elif any(f"= {k}" in line for k in ("while(", "dynamic-update-slice(",
+                                        "dynamic-slice(", "scatter(",
+                                        "convolution(", "dot(", "copy(")):
+        for k in ("while", "dynamic-update-slice", "dynamic-slice",
+                  "scatter", "convolution", "dot", "copy"):
+            if f"= {k}(" in line:
+                ops[k] += 1
+print(dict(ops))
+# the while body (the layer scan) is where the per-step kernels live:
+import re
+bodies = re.findall(r"%while_body[^{]*\{(.*?)\n\}", txt, re.S)
+for b in bodies[:1]:
+    inner = collections.Counter()
+    for line in b.splitlines():
+        line = line.strip()
+        if "fusion(" in line and "= " in line:
+            inner["fusion"] += 1
+        for k in ("dot(", "custom-call(", "scatter(", "copy(",
+                  "dynamic-update-slice(", "dynamic-slice("):
+            if f"= {k}" in line or f" {k}" in line and "= " in line:
+                inner[k.rstrip("(")] += 1
+    print("while body:", dict(inner))
