@@ -231,6 +231,9 @@ class InferenceEngine:
         self._base_key = jax.random.key(
             int.from_bytes(os.urandom(4), "little"))
         self._requests_served = 0
+        # (batch, bucket) -> persistent donated prefix buffer; see
+        # _prefill_scratch_for.
+        self._prefill_scratch: dict[tuple[int, int], Any] = {}
 
         self._build_jits()
 
@@ -261,16 +264,28 @@ class InferenceEngine:
                                   # caches keep the XLA scatter path.
                                   kv_append_ok=self.mesh is None)
 
-        def prefill(params, tokens, true_len, temp, top_p, top_k, rng):
+        def prefill(params, tokens, true_len, temp, top_p, top_k, rng,
+                    scratch):
             """tokens [N, Sb] padded; returns (first tokens [N], prefix KV).
 
             N > 1 is COALESCED prefill (scheduler batches concurrent
             arrivals into one dispatch — each dispatch costs a full
             host↔device round-trip, so admission bursts would otherwise
-            serialize into p99 TTFT)."""
-            N, S = tokens.shape
-            cache = init_cache(cfg, N, S, self.cache_dtype,
-                               quantized=self.kv_quant)
+            serialize into p99 TTFT).
+
+            `scratch` is the PERSISTENT prefix buffer for this (batch,
+            bucket) shape, donated in and returned as the prefix: a fresh
+            init_cache per dispatch allocated+freed the largest transient
+            in serving (hundreds of MB per dispatch), and that churn on a
+            ~95%-full HBM intermittently wedged mid-traffic prefills in a
+            multi-minute allocation retry (round-4 stagger run). The
+            prefill-from-empty trunk overwrites EVERY position/scale of
+            the buffer (flash attention never reads it), so dirty reuse
+            is sound — EXCEPT lengths, which position the writes and
+            carry the previous use's values: reset to the empty-cache
+            contract first."""
+            cache = scratch._replace(
+                lengths=jnp.zeros_like(scratch.lengths))
             h, cache = trunk(params, tokens, cache,
                              seq_lens=true_len, prefill_flash=True)
             # Project ONLY the last valid position through the LM head —
@@ -401,7 +416,7 @@ class InferenceEngine:
                 k_scale=psc, v_scale=psc,
             )
             self._prefix_shard = prefix_shard
-            self._prefill = jax.jit(prefill,
+            self._prefill = jax.jit(prefill, donate_argnums=(7,),
                                     out_shardings=(rep, prefix_shard))
             self._decode = jax.jit(decode_block, donate_argnums=(1,),
                                    out_shardings=(state_shard, rep))
@@ -410,7 +425,7 @@ class InferenceEngine:
             self._chunk_final = jax.jit(chunk_final, donate_argnums=(2,),
                                         out_shardings=(rep, prefix_shard))
         else:
-            self._prefill = jax.jit(prefill)
+            self._prefill = jax.jit(prefill, donate_argnums=(7,))
             self._decode = jax.jit(decode_block, donate_argnums=(1,))
             self._chunk_step = jax.jit(chunk_step, donate_argnums=(2,))
             self._chunk_final = jax.jit(chunk_final, donate_argnums=(2,))
@@ -527,12 +542,17 @@ class InferenceEngine:
         decode_keys_arr = jnp.stack(decode_keys)
         toks, prefix = self._prefill(
             self.params, jnp.asarray(padded), lens_arr, temps_arr,
-            top_ps_arr, top_ks_arr, jnp.stack(prefill_keys))
+            top_ps_arr, top_ks_arr, jnp.stack(prefill_keys),
+            self._prefill_scratch_for(batch, bucket))
         # One dispatch installs every row; pad rows re-write the last
         # real slot with bit-identical data (same prompt AND keys above).
         self.state = self._insert_all(
             self.state, prefix, jnp.asarray(slots_arr), lens_arr,
             toks, temps_arr, top_ps_arr, top_ks_arr, decode_keys_arr)
+        # insert_all READS prefix (no donation): the buffer is free for
+        # the next same-shape prefill the moment the insert executes —
+        # device-order sequencing makes immediate reuse safe.
+        self._store_prefill_scratch(batch, bucket, prefix)
         host_toks = np.asarray(toks)
         return [int(host_toks[i]) for i in range(n_req)]
 
@@ -603,18 +623,50 @@ class InferenceEngine:
             job.temp, job.top_p, job.top_k, job.decode_key)
         return int(np.asarray(toks)[0])
 
-    def _new_prefix_cache(self, capacity: int):
-        """Fresh batch-1 prefix cache, created sharded-in-place (jit with
+    def _new_prefix_cache(self, capacity: int, batch: int = 1):
+        """Fresh batch-N prefix cache, created sharded-in-place (jit with
         out_shardings) so multi-process meshes work like _init_state."""
         c = self.config
 
         def make():
-            return init_cache(c, 1, capacity, self.cache_dtype,
+            return init_cache(c, batch, capacity, self.cache_dtype,
                               quantized=self.kv_quant)
 
         if self.mesh is not None:
             return jax.jit(make, out_shardings=self._prefix_shard)()
         return jax.jit(make)()
+
+    def _prefill_scratch_for(self, batch: int, bucket: int):
+        """The persistent prefix buffer for this (batch, bucket) prefill
+        shape — donated through each prefill dispatch and stored back, so
+        a shape in active use performs no HBM allocation (see `prefill`
+        in _build_jits)."""
+        key = (batch, bucket)
+        scratch = self._prefill_scratch.pop(key, None)
+        if scratch is None:
+            scratch = self._new_prefix_cache(bucket, batch)
+        return scratch
+
+    def _store_prefill_scratch(self, batch: int, bucket: int,
+                               prefix) -> None:
+        """Return a prefix buffer to the pool, LRU-bounded: retaining
+        EVERY (batch, bucket) grid shape would pin ~5x the token budget
+        in KV lanes permanently (~630 MB for the default three-bucket
+        llama3-8b grid) — worse steady-state pressure than the per-
+        dispatch churn the pool exists to remove. The cap keeps the
+        shapes actually in use warm (a serving workload concentrates on
+        one or two) and lets rare shapes churn their small buffers."""
+        key = (batch, bucket)
+        self._prefill_scratch.pop(key, None)
+        self._prefill_scratch[key] = prefix  # most-recently-used last
+        cap = 2 * max(self.prefill_token_budget,
+                      batch * bucket)
+        total = sum(b * bk for (b, bk) in self._prefill_scratch)
+        for old_key in list(self._prefill_scratch):
+            if total <= cap or old_key == key:
+                continue
+            self._prefill_scratch.pop(old_key)  # dropped ref frees HBM
+            total -= old_key[0] * old_key[1]
 
     def release_slot(self, slot: int) -> None:
         """A finished slot's cache lane is garbage until reuse (insert
@@ -639,7 +691,9 @@ class InferenceEngine:
                     jnp.zeros((batch,), jnp.float32),
                     jnp.ones((batch,), jnp.float32),
                     jnp.zeros((batch,), jnp.int32),
-                    jax.random.split(jax.random.key(0), batch))
+                    jax.random.split(jax.random.key(0), batch),
+                    self._prefill_scratch_for(batch, bucket))
+                self._store_prefill_scratch(batch, bucket, prefix)
                 # insert_all compiles per (batch, bucket) too; slot 0
                 # with true_len 0 leaves the state semantically untouched.
                 self.state = self._insert_all(

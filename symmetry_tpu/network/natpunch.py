@@ -101,6 +101,9 @@ COOKIE_WINDOW_S = 30.0
 # provider with endless punch bursts.
 MAX_INVITES_PER_SOURCE = 8
 INVITE_WINDOW_S = 30.0
+# Retransmissions of the same (source, key) dial within this window are
+# answered but charged to the invite budget only once.
+DIAL_DEDUP_S = 10.0
 
 
 def _register_sig_msg(key_hex: str, ts: float) -> bytes:
@@ -129,6 +132,9 @@ class PunchRendezvous:
         self._transport: asyncio.DatagramTransport | None = None
         self._cookie_secret = os.urandom(16)
         self._invites: dict[tuple[str, int], list[float]] = {}
+        # (source addr, target key) -> last brokered ts (retransmission
+        # dedup for the invite budget; see the `request` handler)
+        self._recent_dials: dict[tuple[tuple[str, int], str], float] = {}
 
     async def start(self, host: str = "0.0.0.0", port: int = 0) -> None:
         loop = asyncio.get_running_loop()
@@ -192,14 +198,23 @@ class PunchRendezvous:
             if entry is None or entry[1] + ENTRY_TTL_S < time.monotonic():
                 self._send(_msg("unknown", key=key), addr)
                 return
-            # Budget is charged per BROKERED punch (after the registry
-            # hit), not per request datagram: punch_dial retransmits the
-            # request every second while replies are lost, and one
-            # persistent dial socket serves all of a client's dials
-            # (transport/udp.py) — charging retransmissions or
-            # unknown-key probes would burn the whole window on a single
-            # lossy dial and hard-fail the next legitimate one.
-            if not self._invite_allowed(addr):
+            # Budget accounting: unknown-key probes never charge (the
+            # lookup above short-circuits), and RETRANSMISSIONS of the
+            # same (source, key) dial within a short window charge only
+            # once — punch_dial resends every second while replies are
+            # lost, one persistent dial socket serves all of a client's
+            # dials (transport/udp.py), and charging each resend would
+            # burn the whole window on a single lossy dial.
+            now_m = time.monotonic()
+            dial_key = (addr, key)
+            last = self._recent_dials.get(dial_key, -1e9)
+            is_retransmit = now_m - last < DIAL_DEDUP_S
+            self._recent_dials[dial_key] = now_m
+            if len(self._recent_dials) > MAX_REGISTRY:
+                self._recent_dials = {
+                    k: t for k, t in self._recent_dials.items()
+                    if now_m - t < DIAL_DEDUP_S}
+            if not is_retransmit and not self._invite_allowed(addr):
                 # Proven source, but over its punch budget. Reply
                 # explicitly (safe — the source is cookie-proven) so the
                 # dialer fails fast instead of resending into silence.

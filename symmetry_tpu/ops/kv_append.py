@@ -56,6 +56,20 @@ def _scale_block_t(capacity: int) -> int:
 
 def supports(cache_capacity: int, head_dim: int, backend: str,
              sharded: bool) -> bool:
+    # OPT-IN (SYMMETRY_KV_APPEND=1), not default — the full measured
+    # verdict (BASELINE.md round 4):
+    #   + bare trunk (one step per dispatch): 34.6 -> 31.6 ms
+    #   o inside the block-decode scan (production): NEUTRAL — the small
+    #     kernels' launch overhead pipelines behind compute there
+    #   - HBM: with the kernel in the decode scan, the llama3-8b
+    #     128-slot config OOMs deterministically in an isolated probe
+    #     and intermittently mid-serving (staggered-arrival runs) —
+    #     consistent with the aliased pallas call costing the while
+    #     loop's buffer assignment a second cache-sized buffer. Zero
+    #     in-scan win is not worth that; same precedent as ops/qmm.py
+    #     (kernel kept, measured, not routed).
+    if not os.environ.get("SYMMETRY_KV_APPEND"):
+        return False
     if os.environ.get("SYMMETRY_NO_KV_APPEND"):
         return False
     return (backend == "tpu"

@@ -95,7 +95,8 @@ class TestKvAppendParity:
         np.testing.assert_array_equal(np.asarray(got[0][0, 0, T - 1]),
                                       np.asarray(want_q[0, 0]))
 
-    def test_supports_gate(self):
+    def test_supports_gate(self, monkeypatch):
+        monkeypatch.setenv("SYMMETRY_KV_APPEND", "1")
         assert not kva.supports(64, 128, "cpu", sharded=False)
         assert not kva.supports(64, 128, "tpu", sharded=True)
         assert not kva.supports(64, 64, "tpu", sharded=False)
@@ -103,3 +104,6 @@ class TestKvAppendParity:
         # measured slower via the partial trailing scale block (BASELINE)
         assert not kva.supports(672, 128, "tpu", sharded=False)
         assert kva.supports(64, 128, "tpu", sharded=False)  # < one block
+        monkeypatch.delenv("SYMMETRY_KV_APPEND")
+        # opt-in: off by default (measured HBM cost in the decode scan)
+        assert not kva.supports(640, 128, "tpu", sharded=False)

@@ -301,6 +301,34 @@ class TestRequestHardening:
         # other sources are unaffected
         assert rdv._invite_allowed(("198.51.100.8", 40000))
 
+    def test_retransmissions_charge_budget_once(self):
+        """punch_dial resends its request every second while replies are
+        lost; those retransmissions must not burn the invite budget (one
+        lossy dial would otherwise hard-fail the next legitimate one)."""
+        import time as _time
+
+        rdv = PunchRendezvous()
+        sent = []
+        rdv._send = lambda payload, addr: sent.append((payload, addr))
+        prov_addr = ("203.0.113.5", 50000)
+        rdv._registry["provkey"] = (prov_addr, _time.monotonic())
+        addr = ("198.51.100.7", 40000)
+        from symmetry_tpu.network.natpunch import _msg, wrap_raw
+
+        cookie = rdv._cookie_for(addr)
+        for _ in range(12):  # > MAX_INVITES_PER_SOURCE resends
+            rdv._on_datagram(
+                wrap_raw(_msg("request", key="provkey", cookie=cookie)),
+                addr)
+        import json as _json
+
+        ops = [_json.loads(p.decode())["op"] for p, _ in sent]
+        # every retransmission was ANSWERED (peer+invite), none rejected
+        assert "busy" not in ops
+        assert ops.count("peer") == 12 and ops.count("invite") == 12
+        # and the budget was charged only once
+        assert len(rdv._invites[addr]) == 1
+
 
 class TestRelayCap:
     def test_relay_connect_capped_per_client(self):

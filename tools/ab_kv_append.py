@@ -1,5 +1,9 @@
 """A/B the fused KV-append kernel in the full decode trunk on-chip."""
 import os, sys
+
+# The kernel is OPT-IN (measured HBM cost in the decode scan — see
+# ops/kv_append.py supports()); without this the tool measures OFF vs OFF.
+os.environ["SYMMETRY_KV_APPEND"] = "1"
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax, jax.numpy as jnp
