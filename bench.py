@@ -621,24 +621,23 @@ def main() -> None:
     ap.add_argument("--stagger", type=float, default=0.0,
                     help="seconds between client arrivals (--e2e); 0 = "
                          "thundering-herd burst, the worst-case TTFT")
-    ap.add_argument("--max-new", type=int, default=480,
-                    help="tokens per client request (--e2e). ~500 keeps the "
-                         "decode phase dominant over the admission ramp, so "
-                         "the aggregate number measures serving throughput "
-                         "rather than mostly ramp (round-3 verdict #1); 480 "
-                         "exactly fills the 640 capacity with the 128 "
-                         "bucket + 2 lookahead blocks")
+    ap.add_argument("--max-new", type=int, default=None,
+                    help="tokens per client request (--e2e). Default 480: "
+                         "~500 keeps the decode phase dominant over the "
+                         "admission ramp, so the aggregate number measures "
+                         "serving throughput rather than mostly ramp "
+                         "(round-3 verdict #1); 480 exactly fills the 640 "
+                         "capacity with the 128 bucket + 2 lookahead blocks")
     ap.add_argument("--prompt-len", type=int, default=128)
-    ap.add_argument("--max-seq", type=int, default=640,
-                    help="KV capacity per slot. 640 = 128-token bucket + "
-                         "480 new tokens + 2 lookahead blocks (the "
-                         "scheduler's capacity guard) AND 128-aligned: a "
-                         "non-multiple-of-128 capacity costs ~2 ms/step "
-                         "in the XLA attention path (672 vs 640 measured) "
-                         "and disables the fused KV-append kernel; 704 "
-                         "additionally tripped a marginal HBM "
-                         "RESOURCE_EXHAUSTED under a simultaneous "
-                         "128-burst")
+    ap.add_argument("--max-seq", type=int, default=None,
+                    help="KV capacity per slot. Default 640 = 128-token "
+                         "bucket + 480 new tokens + 2 lookahead blocks "
+                         "(the scheduler's capacity guard) AND "
+                         "128-aligned: a non-multiple-of-128 capacity "
+                         "costs ~2 ms/step in the XLA attention path "
+                         "(672 vs 640 measured); 704 additionally tripped "
+                         "a marginal HBM RESOURCE_EXHAUSTED under a "
+                         "simultaneous 128-burst")
     ap.add_argument("--dtype", default="bfloat16",
                     choices=("bfloat16", "float32"))
     ap.add_argument("--mesh-model", type=int, default=1,
@@ -656,6 +655,16 @@ def main() -> None:
     user_block = args.block
     if args.block is None:
         args.block = 64 if (args.engine or args.smoke) else 16
+    # Track whether the caller sized the run explicitly: the e2e failure
+    # ladder only swaps in its conservative point for DEFAULT-sized runs
+    # (prompt-len and block participate — the retry point's capacity
+    # arithmetic assumes the default 128-token bucket and block 16).
+    user_sized = (args.max_seq is not None or args.max_new is not None
+                  or args.prompt_len != 128 or user_block is not None)
+    if args.max_seq is None:
+        args.max_seq = 640
+    if args.max_new is None:
+        args.max_new = 480
 
     def engine_bench() -> dict:
         # engine numbers are recorded at block 64; when the user didn't
@@ -684,25 +693,49 @@ def main() -> None:
                            token_delay_s=args.proxy_delay)
     else:
         # Default = the north-star serving measurement (round-2 verdict
-        # item 1: wire tok/s + TTFT percentiles). If the serving stack
-        # fails in this environment, fall back to the engine bench
-        # rather than reporting nothing.
-        try:
-            result = run_e2e(
+        # item 1: wire tok/s + TTFT percentiles). Failure ladder: the
+        # 640-ctx point runs the chip ~95% HBM-full, and the effective
+        # headroom VARIES across runs on the shared tunnel (identical
+        # configs measured green 6x then RESOURCE_EXHAUSTED at first
+        # traffic) — so a failed run retries ONCE at an HBM-conservative
+        # point (512 ctx / 352 tok/req, ~1.1 GB more slack, still well
+        # over baseline) before the engine-only fallback. The scoreboard
+        # must never be empty, and should stay an e2e number if at all
+        # possible.
+        def e2e_attempt(max_seq: int, max_new: int) -> dict:
+            return run_e2e(
                 args.preset, clients=args.clients, slots=args.slots,
                 # ~24 tokens of headroom for the chat template + BOS so
                 # the rendered prompt still fits the --prompt-len bucket
-                max_new=args.max_new,
+                max_new=max_new,
                 prompt_chars=max(1, args.prompt_len - 24),
-                max_seq=args.max_seq, dtype_name=args.dtype,
+                max_seq=max_seq, dtype_name=args.dtype,
                 block=args.block,
                 quant=None if args.quant == "none" else args.quant,
                 kv_quant=args.kv_quant == "int8", bucket=args.prompt_len,
                 stagger_s=args.stagger)
+
+        try:
+            result = e2e_attempt(args.max_seq, args.max_new)
         except Exception as exc:  # noqa: BLE001 — scoreboard must not be empty
-            print(f"e2e serving bench failed ({exc!r}); "
-                  f"falling back to engine-only", file=sys.stderr)
-            result = engine_bench()
+            print(f"e2e serving bench failed ({exc!r})", file=sys.stderr)
+            result = None
+            if not user_sized:
+                # 512 = prompt bucket (128) + max_new + 2 lookahead
+                # blocks; derived so the scheduler's capacity guard never
+                # silently truncates the retry's streams.
+                cons_new = 512 - args.prompt_len - 2 * args.block
+                print(f"[bench] retrying once at the HBM-conservative "
+                      f"point (512 ctx / {cons_new} tok/req)",
+                      file=sys.stderr)
+                try:
+                    result = e2e_attempt(512, cons_new)
+                except Exception as exc2:  # noqa: BLE001
+                    print(f"conservative e2e retry failed ({exc2!r})",
+                          file=sys.stderr)
+            if result is None:
+                print("falling back to engine-only", file=sys.stderr)
+                result = engine_bench()
     print(json.dumps(result))
 
 

@@ -703,6 +703,41 @@ class InferenceEngine:
                     jnp.ones((batch,), jnp.float32),
                     jnp.zeros((batch,), jnp.int32),
                     jax.random.split(jax.random.key(0), batch))
+        # Exercise the CONCURRENT decode+prefill peak once PER BUCKET:
+        # serving overlaps an in-flight decode block with a prefill
+        # dispatch, and their workspaces coexist in HBM — a configuration
+        # that fits each program alone can still OOM at first traffic
+        # (observed on a ~95%-full chip: warmup green, first burst
+        # prefill RESOURCE_EXHAUSTED 3 s later). Every bucket's widest
+        # batch is probed because the peak transient lives at the LARGE
+        # buckets (round-2's OOM was batch 4 × 2048, not 16 × 128).
+        # Failing HERE turns a mid-traffic wedge into a clean startup
+        # failure the caller can react to. Side benefit, measured: the
+        # overlapped-execution path is warmed, so in-serving admission
+        # dispatches stop paying a first-overlap cost (admit p99 2.5 s →
+        # 0.4 s, burst ramp 5.9 s → 4.3 s).
+        for bucket in self.prefill_buckets:
+            widest = max(b for b in self.prefill_batches_for(bucket)
+                         if b <= self.max_slots)
+            pending = self._decode(self.params, self.state)
+            self.state = pending[0]
+            toks, prefix = self._prefill(
+                self.params,
+                jnp.zeros((widest, bucket), jnp.int32),
+                jnp.ones((widest,), jnp.int32),
+                jnp.zeros((widest,), jnp.float32),
+                jnp.ones((widest,), jnp.float32),
+                jnp.zeros((widest,), jnp.int32),
+                jax.random.split(jax.random.key(0), widest),
+                self._prefill_scratch_for(widest, bucket))
+            self._store_prefill_scratch(widest, bucket, prefix)
+            # Sync on the PREFILL output: the device queue is FIFO, so
+            # its completion implies the decode's too — and JAX surfaces
+            # async failures only on the poisoned output, so syncing the
+            # decode alone would let a prefill OOM stay pending until
+            # first traffic.
+            np.asarray(toks)
+
         # Chunked-prefill programs: one (step, final) pair per bucket that
         # can hold a multi-chunk prompt. A mid-traffic compile would be the
         # exact stall chunking exists to prevent.
