@@ -295,49 +295,59 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
         provider_stats: dict | None = None
         # Engine build + warmup runs in the provider process (minutes for
         # 8B cold: weight init + XLA compiles); none of it counts toward
-        # the measured window. Registration marks readiness.
-        async with _provider_process(cfg, server, model_name,
-                                     timeout_s=1800,
-                                     stdout=log_fh) as (_proc, startup_s):
-            print(f"[bench] provider registered after {startup_s:.0f}s "
-                  f"(weight init + XLA compile + warmup; excluded from "
-                  f"the measured window)", file=sys.stderr)
-            tasks = [asyncio.ensure_future(one_client(i))
-                     for i in range(clients)]
-            # Release the burst only once every session is connected; a
-            # wedged/failed connection surfaces through the gather below.
-            t_connect0 = _time.perf_counter()
-            done_any = asyncio.ensure_future(
-                asyncio.wait(tasks, return_when=asyncio.FIRST_EXCEPTION))
-            await asyncio.wait(
-                [asyncio.ensure_future(all_connected.wait()), done_any],
-                timeout=120, return_when=asyncio.FIRST_COMPLETED)
-            connect_s = _time.perf_counter() - t_connect0
-            print(f"[bench] {connected}/{clients} sessions connected in "
-                  f"{connect_s:.1f}s; releasing the burst", file=sys.stderr)
-            t0 = _time.perf_counter()
-            ready.set()
-            results = await asyncio.gather(*tasks)
-            elapsed = _time.perf_counter() - t0
-            # Engine-side breakdown (scheduler phase counters, engine TTFT,
-            # admission dispatch + block-interval percentiles) — fetched
-            # while the provider is still up, so the capture can attribute
-            # a slow run to engine vs relay/wire.
-            try:
-                stats_client = SymmetryClient(
-                    Identity.from_name("bench-stats"), TcpTransport())
-                details = await stats_client.request_provider(
-                    server.address, server_ident.public_key, model_name)
-                stats_session = await stats_client.connect(details)
-                try:
-                    provider_stats = await stats_session.stats()
-                    engine_stats = provider_stats.get("engine")
-                finally:
-                    await stats_session.close()
-            except Exception as exc:  # noqa: BLE001 — diagnostics only
-                print(f"[bench] engine stats fetch failed: {exc!r}",
+        # the measured window. Registration marks readiness. The log fh is
+        # closed in the finally — the early-exception paths (provider
+        # never registers, client failure) must not leak the fd, and the
+        # tail read below needs the buffer flushed.
+        try:
+            async with _provider_process(cfg, server, model_name,
+                                         timeout_s=1800,
+                                         stdout=log_fh) as (_proc,
+                                                            startup_s):
+                print(f"[bench] provider registered after {startup_s:.0f}s "
+                      f"(weight init + XLA compile + warmup; excluded from "
+                      f"the measured window)", file=sys.stderr)
+                tasks = [asyncio.ensure_future(one_client(i))
+                         for i in range(clients)]
+                # Release the burst only once every session is connected; a
+                # wedged/failed connection surfaces through the gather
+                # below.
+                t_connect0 = _time.perf_counter()
+                done_any = asyncio.ensure_future(
+                    asyncio.wait(tasks,
+                                 return_when=asyncio.FIRST_EXCEPTION))
+                await asyncio.wait(
+                    [asyncio.ensure_future(all_connected.wait()), done_any],
+                    timeout=120, return_when=asyncio.FIRST_COMPLETED)
+                connect_s = _time.perf_counter() - t_connect0
+                print(f"[bench] {connected}/{clients} sessions connected "
+                      f"in {connect_s:.1f}s; releasing the burst",
                       file=sys.stderr)
-        await server.stop()
+                t0 = _time.perf_counter()
+                ready.set()
+                results = await asyncio.gather(*tasks)
+                elapsed = _time.perf_counter() - t0
+                # Engine-side breakdown (scheduler phase counters, engine
+                # TTFT, admission dispatch + block-interval percentiles) —
+                # fetched while the provider is still up, so the capture
+                # can attribute a slow run to engine vs relay/wire.
+                try:
+                    stats_client = SymmetryClient(
+                        Identity.from_name("bench-stats"), TcpTransport())
+                    details = await stats_client.request_provider(
+                        server.address, server_ident.public_key, model_name)
+                    stats_session = await stats_client.connect(details)
+                    try:
+                        provider_stats = await stats_session.stats()
+                        engine_stats = provider_stats.get("engine")
+                    finally:
+                        await stats_session.close()
+                except Exception as exc:  # noqa: BLE001 — diagnostics only
+                    print(f"[bench] engine stats fetch failed: {exc!r}",
+                          file=sys.stderr)
+            await server.stop()
+        finally:
+            log_fh.close()
 
         # Exact wire token counts: inferenceEnded carries the engine's
         # per-request totals (ByteTokenizer chars under-count — multi-byte

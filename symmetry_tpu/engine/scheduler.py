@@ -408,41 +408,58 @@ class Scheduler:
                 self.engine.bucket_for(len(req.prompt_ids)), []).append(
                     (slot, req))
         batches_for = getattr(self.engine, "prefill_batches_for", None)
-        n_dispatches = 0
+        units: list[list[tuple[int, GenRequest]]] = []
         for bucket, subgroup in by_bucket.items():
             cap = (max(batches_for(bucket)) if batches_for is not None
                    else len(subgroup))
             for start in range(0, len(subgroup), cap):
-                sub = subgroup[start:start + cap]
-                t0 = time.perf_counter()
-                try:
-                    if len(sub) > 1:
-                        firsts = self.engine.prefill_and_insert_many(
-                            [(slot, req.prompt_ids, req.sampling)
-                             for slot, req in sub])
-                    else:
-                        slot0, req0 = sub[0]
-                        firsts = [self.engine.prefill_and_insert(
-                            slot0, req0.prompt_ids, req0.sampling)]
-                except Exception as exc:  # noqa: BLE001 — engine errors → stream error
-                    n_dispatches += 1  # a failed dispatch still cost time
-                    self._spent_this_block += time.perf_counter() - t0
-                    for slot, req in sub:
-                        self._free.append(slot)
-                        log.error(
-                            f"prefill failed for request {req.id}: {exc}")
-                        self._emit_cb(req, TokenEvent(
-                            text="", token_id=None, done=True,
-                            finish_reason="error", error=str(exc)))
-                    continue
-                dt = time.perf_counter() - t0
-                n_dispatches += 1
-                self._spent_this_block += dt
-                self.metrics["admit_dispatches"] += 1
-                self.metrics["admit_s"] += dt
-                self._admit_hist.observe(dt)
-                for (slot, req), first in zip(sub, firsts):
-                    self._activate(slot, req, first)
+                units.append(subgroup[start:start + cap])
+        n_dispatches = 0
+        for unit_idx, sub in enumerate(units):
+            if (unit_idx > 0 and self._slots
+                    and self._spent_this_block >= self._admit_budget_s):
+                # The shared per-block time budget ran out mid-group: a
+                # 16-request group spanning the 512 bucket splits into
+                # 4-5 dispatches, and running them all back-to-back would
+                # overshoot the budget several-fold and stall every
+                # active stream. Defer the unstarted subgroups — slots
+                # back to the pool, requests back to the queue — and let
+                # the next block pick them up. (unit_idx > 0 guarantees
+                # forward progress: one dispatch always lands.)
+                for slot, req in (pair for u in units[unit_idx:]
+                                  for pair in u):
+                    self._free.append(slot)
+                    self._inbox.put(req)
+                break
+            t0 = time.perf_counter()
+            try:
+                if len(sub) > 1:
+                    firsts = self.engine.prefill_and_insert_many(
+                        [(slot, req.prompt_ids, req.sampling)
+                         for slot, req in sub])
+                else:
+                    slot0, req0 = sub[0]
+                    firsts = [self.engine.prefill_and_insert(
+                        slot0, req0.prompt_ids, req0.sampling)]
+            except Exception as exc:  # noqa: BLE001 — engine errors → stream error
+                n_dispatches += 1  # a failed dispatch still cost time
+                self._spent_this_block += time.perf_counter() - t0
+                for slot, req in sub:
+                    self._free.append(slot)
+                    log.error(
+                        f"prefill failed for request {req.id}: {exc}")
+                    self._emit_cb(req, TokenEvent(
+                        text="", token_id=None, done=True,
+                        finish_reason="error", error=str(exc)))
+                continue
+            dt = time.perf_counter() - t0
+            n_dispatches += 1
+            self._spent_this_block += dt
+            self.metrics["admit_dispatches"] += 1
+            self.metrics["admit_s"] += dt
+            self._admit_hist.observe(dt)
+            for (slot, req), first in zip(sub, firsts):
+                self._activate(slot, req, first)
         return n_dispatches
 
     def _advance_prefills(self) -> None:

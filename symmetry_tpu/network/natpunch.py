@@ -209,7 +209,14 @@ class PunchRendezvous:
             dial_key = (addr, key)
             last = self._recent_dials.get(dial_key, -1e9)
             is_retransmit = now_m - last < DIAL_DEDUP_S
-            self._recent_dials[dial_key] = now_m
+            # Keep the FIRST-seen time: refreshing on every resend would
+            # let a proven source stay "retransmitting" forever and never
+            # be charged to the invite budget. With first-seen semantics a
+            # sustained resender is re-charged once per DIAL_DEDUP_S
+            # window, so MAX_INVITES_PER_SOURCE actually bounds the punch
+            # bursts it can aim at a provider.
+            if not is_retransmit:
+                self._recent_dials[dial_key] = now_m
             if len(self._recent_dials) > MAX_REGISTRY:
                 self._recent_dials = {
                     k: t for k, t in self._recent_dials.items()

@@ -6,17 +6,29 @@ from symmetry_tpu.utils.trace import Histogram, Tracer
 
 
 class TestHistogram:
-    def test_percentiles_ordered(self):
+    def test_percentiles_exact_within_reservoir(self):
         h = Histogram()
         for ms in range(1, 1001):
             h.observe(ms / 1000.0)
         assert h.count == 1000
         p50, p90, p99 = h.percentile(50), h.percentile(90), h.percentile(99)
-        assert p50 is not None and p90 is not None and p99 is not None
-        assert p50 <= p90 <= p99
-        # log buckets at 5/decade: estimates within a bucket ratio (~1.58x)
-        assert 0.3 <= p50 <= 0.8
-        assert 0.55 <= p90 <= 1.0
+        # 1000 samples fit the reservoir: percentiles are EXACT order
+        # statistics, not bucket edges (the round-4 p50==p99 artifact).
+        assert p50 == 0.5
+        assert p90 == 0.9
+        assert p99 == 0.99
+        assert p50 < p90 < p99
+
+    def test_reservoir_estimate_beyond_cap(self):
+        h = Histogram(reservoir=256)
+        for i in range(10_000):
+            h.observe((i % 1000 + 1) / 1000.0)
+        assert h.count == 10_000
+        p50 = h.percentile(50)
+        # Uniform subsample of a uniform(0.001, 1.0) stream: the estimate
+        # must land near the true median, far tighter than a 1.58x bucket.
+        assert 0.35 <= p50 <= 0.65
+        assert h.percentile(1) < p50 < h.percentile(99)
 
     def test_empty(self):
         h = Histogram()
