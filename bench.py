@@ -167,10 +167,102 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
     }
 
 
+def run_e2e_client_worker() -> int:
+    """One shard of the e2e bench's client fleet, in its OWN process.
+
+    Round 4 measured the 128-client wire tail through a saturated
+    instrument: 128 concurrent Noise-decrypting asyncio streams in ONE
+    event loop meant the reported inter-chunk gap p99 (1.25-2.0 s) partly
+    measured the bench client itself — the engine-side histogram said
+    p99 ≤ 0.63 s. Sharding the fleet over N OS processes removes the
+    client loop from the measurement.
+
+    Protocol (parent = run_e2e): read one JSON config line on stdin →
+    connect every assigned session → print "READY <n>" → block for the
+    "GO" line (the cross-process burst barrier) → run the clients →
+    print "RESULTS <json>". All timestamps are time.monotonic(), which is
+    CLOCK_MONOTONIC — one clock across processes on Linux, so the parent
+    can aggregate absolute stamps from every shard."""
+    import asyncio
+    import time as _time
+
+    from symmetry_tpu.client.client import ProviderBusyError, SymmetryClient
+    from symmetry_tpu.identity import Identity
+    from symmetry_tpu.transport.tcp import TcpTransport
+
+    spec = json.loads(sys.stdin.readline())
+    server_address = spec["server_address"]
+    server_key = bytes.fromhex(spec["server_key_hex"])
+    model_name = spec["model_name"]
+    indices: list[int] = spec["indices"]
+    prompt: str = spec["prompt"]
+    max_new: int = spec["max_new"]
+    stagger_s: float = spec["stagger_s"]
+
+    async def main() -> list[dict]:
+        ready = asyncio.Event()
+
+        async def one_client(i: int) -> dict:
+            client = SymmetryClient(Identity.from_name(f"bench-cli-{i}"),
+                                    TcpTransport())
+            details = await client.request_provider(
+                server_address, server_key, model_name)
+            session = await client.connect(details)
+            sessions_up[0] += 1
+            if sessions_up[0] == len(indices):
+                all_connected.set()
+            await ready.wait()
+            # Global arrival order by GLOBAL index — the shards together
+            # reproduce exactly the single-process arrival pattern.
+            await asyncio.sleep(i * stagger_s)
+            t_send = _time.monotonic()
+            t_first = None
+            chars = 0
+            stamps: list[tuple[float, int]] = []
+            try:
+                async for delta in session.chat(
+                        [{"role": "user", "content": prompt}],
+                        max_tokens=max_new, temperature=0.7, seed=i):
+                    now = _time.monotonic()
+                    if t_first is None and delta:
+                        t_first = now
+                    chars += len(delta)
+                    stamps.append((now, len(delta)))
+                tokens = int((session.last_usage or {}).get("tokens", 0))
+            except ProviderBusyError as exc:
+                return {"rejected": True,
+                        "reject_s": _time.monotonic() - t_send,
+                        "queue_depth": exc.queue_depth}
+            finally:
+                await session.close()
+            t_done = _time.monotonic()
+            return {"ttft": (t_first or t_done) - t_send,
+                    "e2e": t_done - t_send, "chars": chars,
+                    "tokens": tokens, "t_first": t_first or t_done,
+                    "t_done": t_done, "stamps": stamps}
+
+        sessions_up = [0]
+        all_connected = asyncio.Event()
+        tasks = [asyncio.ensure_future(one_client(i)) for i in indices]
+        await asyncio.wait_for(all_connected.wait(), timeout=120)
+        print(f"READY {len(indices)}", flush=True)
+        loop = asyncio.get_running_loop()
+        line = await loop.run_in_executor(None, sys.stdin.readline)
+        if not line.startswith("GO"):
+            raise RuntimeError(f"expected GO, got {line!r}")
+        ready.set()
+        return list(await asyncio.gather(*tasks))
+
+    results = asyncio.new_event_loop().run_until_complete(main())
+    print("RESULTS " + json.dumps(results), flush=True)
+    return 0
+
+
 def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             prompt_chars: int, max_seq: int, dtype_name: str, block: int,
             quant: str | None, kv_quant: bool, bucket: int,
-            stagger_s: float = 0.0) -> dict:
+            stagger_s: float = 0.0, max_queue: int | None = None,
+            max_ttft_s: float | None = None, client_procs: int = 1) -> dict:
     """The NORTH-STAR measurement (BASELINE.json metric): aggregate WIRE
     tok/s and p50/p99 TTFT through the full serving path — server +
     tpu_native provider + N concurrent streaming clients over TCP
@@ -193,7 +285,7 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
 
     import yaml
 
-    from symmetry_tpu.client.client import SymmetryClient
+    from symmetry_tpu.client.client import ProviderBusyError, SymmetryClient
     from symmetry_tpu.identity import Identity
     from symmetry_tpu.server.broker import SymmetryServer
     from symmetry_tpu.transport.tcp import TcpTransport
@@ -227,6 +319,10 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 "max_seq_len": max_seq,
                 "prefill_buckets": [bucket],
                 "decode_block": block,
+                **({"max_queue": max_queue} if max_queue is not None
+                   else {}),
+                **({"max_ttft_s": max_ttft_s} if max_ttft_s is not None
+                   else {}),
             },
         }
         # Provider log is ALWAYS captured (round-3 verdict #1: a 6-line
@@ -254,6 +350,70 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
         ready = asyncio.Event()
         all_connected = asyncio.Event()
         connected = 0
+
+        async def run_sharded_fleet() -> tuple[list, float, float]:
+            """The client fleet split over `client_procs` OS processes
+            (run_e2e_client_worker), so the measured tails are the
+            SERVICE's, not the client event loop's. Returns (results, t0,
+            elapsed) with all stamps on the shared CLOCK_MONOTONIC."""
+            shards = [list(range(k, clients, client_procs))
+                      for k in range(client_procs)]
+            shards = [s for s in shards if s]
+            t_connect0 = _time.monotonic()
+            procs = []
+            try:
+                for shard in shards:
+                    p = await asyncio.create_subprocess_exec(
+                        sys.executable, os.path.abspath(__file__),
+                        "--e2e-client-worker",
+                        stdin=asyncio.subprocess.PIPE,
+                        stdout=asyncio.subprocess.PIPE,
+                        limit=1 << 26)  # RESULTS line >> 64 KiB default
+                    spec = {"server_address": server.address,
+                            "server_key_hex": server_ident.public_hex,
+                            "model_name": model_name, "indices": shard,
+                            "prompt": prompt, "max_new": max_new,
+                            "stagger_s": stagger_s}
+                    p.stdin.write((json.dumps(spec) + "\n").encode())
+                    await p.stdin.drain()
+                    procs.append(p)
+
+                async def read_until(p, prefix: str) -> str:
+                    while True:
+                        raw = await p.stdout.readline()
+                        if not raw:
+                            raise RuntimeError(
+                                f"client worker exited before {prefix}")
+                        line = raw.decode()
+                        if line.startswith(prefix):
+                            return line
+
+                counts = await asyncio.gather(*(
+                    asyncio.wait_for(read_until(p, "READY"), 120)
+                    for p in procs))
+                n_conn = sum(int(c.split()[1]) for c in counts)
+                print(f"[bench] {n_conn}/{clients} sessions connected "
+                      f"across {len(procs)} client processes in "
+                      f"{_time.monotonic() - t_connect0:.1f}s; releasing "
+                      f"the burst", file=sys.stderr)
+                t0 = _time.monotonic()
+                for p in procs:
+                    p.stdin.write(b"GO\n")
+                await asyncio.gather(*(p.stdin.drain() for p in procs))
+                payloads = await asyncio.gather(*(
+                    read_until(p, "RESULTS ") for p in procs))
+            finally:
+                for p in procs:
+                    if p.returncode is None and p.stdin is not None:
+                        p.stdin.close()
+            shard_results = [json.loads(pl[len("RESULTS "):])
+                             for pl in payloads]
+            await asyncio.gather(*(p.wait() for p in procs))
+            results = [r for shard in shard_results for r in shard]
+            done_ts = [r["t_done"] for r in results
+                       if not r.get("rejected")]
+            elapsed = (max(done_ts) - t0) if done_ts else 0.0
+            return results, t0, elapsed
 
         async def one_client(i: int) -> dict:
             # stagger_s > 0 = steady-operation arrival pattern (one client
@@ -283,6 +443,13 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                     chars += len(delta)
                     stamps.append((now, len(delta)))
                 tokens = int((session.last_usage or {}).get("tokens", 0))
+            except ProviderBusyError as exc:
+                # Overload shedding: an explicit, immediate rejection —
+                # the bounded-latency alternative to unbounded queueing.
+                # Counted separately; never mixed into serving latency.
+                return {"rejected": True,
+                        "reject_s": _time.perf_counter() - t_send,
+                        "queue_depth": exc.queue_depth}
             finally:
                 await session.close()
             t_done = _time.perf_counter()
@@ -307,26 +474,30 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 print(f"[bench] provider registered after {startup_s:.0f}s "
                       f"(weight init + XLA compile + warmup; excluded from "
                       f"the measured window)", file=sys.stderr)
-                tasks = [asyncio.ensure_future(one_client(i))
-                         for i in range(clients)]
-                # Release the burst only once every session is connected; a
-                # wedged/failed connection surfaces through the gather
-                # below.
-                t_connect0 = _time.perf_counter()
-                done_any = asyncio.ensure_future(
-                    asyncio.wait(tasks,
-                                 return_when=asyncio.FIRST_EXCEPTION))
-                await asyncio.wait(
-                    [asyncio.ensure_future(all_connected.wait()), done_any],
-                    timeout=120, return_when=asyncio.FIRST_COMPLETED)
-                connect_s = _time.perf_counter() - t_connect0
-                print(f"[bench] {connected}/{clients} sessions connected "
-                      f"in {connect_s:.1f}s; releasing the burst",
-                      file=sys.stderr)
-                t0 = _time.perf_counter()
-                ready.set()
-                results = await asyncio.gather(*tasks)
-                elapsed = _time.perf_counter() - t0
+                if client_procs > 1:
+                    results, t0, elapsed = await run_sharded_fleet()
+                else:
+                    tasks = [asyncio.ensure_future(one_client(i))
+                             for i in range(clients)]
+                    # Release the burst only once every session is
+                    # connected; a wedged/failed connection surfaces
+                    # through the gather below.
+                    t_connect0 = _time.perf_counter()
+                    done_any = asyncio.ensure_future(
+                        asyncio.wait(tasks,
+                                     return_when=asyncio.FIRST_EXCEPTION))
+                    await asyncio.wait(
+                        [asyncio.ensure_future(all_connected.wait()),
+                         done_any],
+                        timeout=120, return_when=asyncio.FIRST_COMPLETED)
+                    connect_s = _time.perf_counter() - t_connect0
+                    print(f"[bench] {connected}/{clients} sessions "
+                          f"connected in {connect_s:.1f}s; releasing the "
+                          f"burst", file=sys.stderr)
+                    t0 = _time.perf_counter()
+                    ready.set()
+                    results = await asyncio.gather(*tasks)
+                    elapsed = _time.perf_counter() - t0
                 # Engine-side breakdown (scheduler phase counters, engine
                 # TTFT, admission dispatch + block-interval percentiles) —
                 # fetched while the provider is still up, so the capture
@@ -348,6 +519,20 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             await server.stop()
         finally:
             log_fh.close()
+
+        # Shed requests got an explicit busy rejection (bounded-latency
+        # admission) — reported separately, excluded from every serving
+        # percentile. reject_s records how fast the rejection came back.
+        rejected = [r for r in results if r.get("rejected")]
+        results = [r for r in results if not r.get("rejected")]
+        if rejected:
+            rj = sorted(r["reject_s"] for r in rejected)
+            print(f"[bench] {len(rejected)}/{clients} requests shed "
+                  f"(busy), rejection latency p50/p99 "
+                  f"{rj[len(rj) // 2]:.2f}/{rj[-1]:.2f}s", file=sys.stderr)
+        if not results:
+            raise RuntimeError("every request was shed — queue bound too "
+                               "tight for this arrival pattern")
 
         # Exact wire token counts: inferenceEnded carries the engine's
         # per-request totals (ByteTokenizer chars under-count — multi-byte
@@ -490,6 +675,11 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             "inter_chunk_gap_p99_s": (round(gap_p99, 3)
                                       if gap_p99 is not None else None),
             "phases": phases,
+            **({"client_procs": client_procs} if client_procs > 1 else {}),
+            **({"admitted": len(results), "rejected": len(rejected),
+                "reject_p99_s": round(
+                    sorted(r["reject_s"] for r in rejected)[-1], 3)}
+               if rejected else {}),
             **({"engine": diag} if diag else {}),
         }
 
@@ -661,7 +851,29 @@ def main() -> None:
                     help="weight quantization")
     ap.add_argument("--kv-quant", default="int8", choices=("none", "int8"),
                     help="KV cache quantization")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="requests allowed to queue beyond the decode "
+                         "slots before the provider sheds with a busy "
+                         "error (--e2e; default: one full extra wave = "
+                         "slots). Small values + --stagger model the "
+                         "bounded-latency overload row")
+    ap.add_argument("--max-ttft", type=float, default=None,
+                    help="TTFT-bounded admission (--e2e): shed when the "
+                         "provider's estimated first-token wait exceeds "
+                         "this many seconds (tpu.max_ttft_s). Default: "
+                         "disabled")
+    ap.add_argument("--client-procs", type=int, default=None,
+                    help="shard the client fleet over N OS processes so "
+                         "wire tails measure the service, not one client "
+                         "event loop (default: 8 when clients >= 64, "
+                         "else 1)")
+    ap.add_argument("--e2e-client-worker", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: one fleet shard
     args = ap.parse_args()
+    if args.e2e_client_worker:
+        return run_e2e_client_worker()
+    if args.client_procs is None:
+        args.client_procs = 8 if args.clients >= 64 else 1
     user_block = args.block
     if args.block is None:
         args.block = 64 if (args.engine or args.smoke) else 16
@@ -723,7 +935,8 @@ def main() -> None:
                 block=args.block,
                 quant=None if args.quant == "none" else args.quant,
                 kv_quant=args.kv_quant == "int8", bucket=args.prompt_len,
-                stagger_s=args.stagger)
+                stagger_s=args.stagger, max_queue=args.max_queue,
+                max_ttft_s=args.max_ttft, client_procs=args.client_procs)
 
         try:
             result = e2e_attempt(args.max_seq, args.max_new)

@@ -39,6 +39,19 @@ class ProviderGoneError(ClientError):
     burn the pool on a deterministically-bad request."""
 
 
+class ProviderBusyError(ClientError):
+    """The provider shed the request before serving it (its backlog is
+    over queue_limit) — retryable on ANOTHER provider: nothing streamed,
+    and the request itself is fine. Carries the provider's reported
+    queue depth/limit for backoff decisions."""
+
+    def __init__(self, message: str, queue_depth: int | None = None,
+                 queue_limit: int | None = None) -> None:
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.queue_limit = queue_limit
+
+
 @dataclass(slots=True)
 class ProviderDetails:
     peer_key: str
@@ -208,8 +221,17 @@ class ProviderSession:
                     return
                 elif msg.key == MessageKey.INFERENCE_ERROR:
                     ended = True
+                    data = msg.data or {}
+                    if data.get("busy"):
+                        # Structured shed (provider over queue_limit):
+                        # distinguishable so failover retries elsewhere
+                        # instead of treating it as a bad request.
+                        raise ProviderBusyError(
+                            data.get("error", "provider busy"),
+                            queue_depth=data.get("queueDepth"),
+                            queue_limit=data.get("queueLimit"))
                     raise ClientError(
-                        (msg.data or {}).get("error", "inference failed"))
+                        data.get("error", "inference failed"))
         finally:
             self._queues.pop(req_id, None)
             if not ended and not self._peer.closed:
@@ -373,11 +395,15 @@ class SymmetryClient:
                 async for delta in session.chat(messages, **chat_kw):
                     yield delta
                 return
-            except (ProviderGoneError, ConnectionError, OSError) as exc:
-                # Only provider-death failures fail over. A request-level
-                # ClientError (bad messages, rejected params) propagates:
-                # replaying it elsewhere would fail identically while
-                # blacklisting healthy providers.
+            except (ProviderGoneError, ProviderBusyError,
+                    ConnectionError, OSError) as exc:
+                # Provider-death AND busy-shed failures fail over (a shed
+                # provider is healthy but over its backlog bound — this
+                # request is excluded from it, not the provider from the
+                # pool). A request-level ClientError (bad messages,
+                # rejected params) propagates: replaying it elsewhere
+                # would fail identically while blacklisting healthy
+                # providers.
                 last_exc = exc
                 if details.peer_key:
                     dead.append(details.peer_key)

@@ -52,6 +52,18 @@ class InferenceBackend(abc.ABC):
     """A source of streamed completions."""
 
     name: str = "?"
+    # Admission capacity. `slots` = requests served concurrently without
+    # queueing (engine decode slots); `queue_limit` = total in-flight
+    # (serving + queued) beyond which the provider sheds new inference
+    # with a structured busy error instead of letting every queued client
+    # wait unboundedly. None = unbounded — the reference's behavior
+    # (nothing in /root/reference/src/provider.ts rejects on backlog, only
+    # maxConnections caps peers), kept for the proxy/echo backends.
+    slots: int | None = None
+    queue_limit: int | None = None
+    # TTFT-bounded admission (provider sheds when its estimated
+    # first-token wait exceeds this); None = disabled.
+    admission_ttft_bound_s: float | None = None
 
     @abc.abstractmethod
     def stream(self, request: InferenceRequest) -> AsyncIterator[StreamChunk]:

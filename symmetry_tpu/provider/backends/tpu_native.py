@@ -58,6 +58,15 @@ class TpuNativeBackend(InferenceBackend):
         self._host_dead = False
         self._engine_alive = True  # host-reported scheduler liveness
         self._stats_waiters: list[asyncio.Future] = []
+        # Admission capacity for the provider's overload shedding: the
+        # engine serves `slots` streams concurrently; beyond
+        # slots + max_queue, new requests would wait more than ~one slot
+        # rotation, so the provider rejects them with a busy error.
+        tpu = config.tpu
+        self.slots = tpu.max_batch_size
+        extra = tpu.max_queue if tpu.max_queue is not None else self.slots
+        self.queue_limit = self.slots + max(0, extra)
+        self.admission_ttft_bound_s = tpu.max_ttft_s
 
     @property
     def _process_mode(self) -> bool:

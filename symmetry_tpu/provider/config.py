@@ -55,6 +55,24 @@ class TpuConfig:
     # 64 at the llama3-8b/128-slot point (double-buffered dispatch hides
     # the round-trips) with ~2x lower TTFT and inter-chunk latency.
     decode_block: int = 16
+    # Requests allowed to QUEUE beyond the decode slots before the
+    # provider sheds new inference with a structured busy error (clients
+    # fail over; the router steers by reported queue depth). None → one
+    # full extra wave (= max_batch_size): an admitted request then waits
+    # at most ~one slot rotation, bounding its TTFT near the per-request
+    # service time instead of growing with the backlog. 0 disables
+    # queueing (shed the moment every slot is busy).
+    max_queue: int | None = None
+    # TTFT-bounded admission: shed a new request when the provider's
+    # ESTIMATED first-token wait (requests awaiting their first token ÷
+    # recent first-token rate) exceeds this many seconds. Catches the
+    # overload mode the in-flight bound can't: during a sustained-arrival
+    # ramp the limiter is prefill dispatch rate, so the scheduler inbox
+    # can hold seconds of wait while decode slots are still free. None
+    # (default) disables the bound — a pure thundering-herd burst from
+    # idle is admitted in full either way (no recent rate signal → no
+    # shedding on ignorance).
+    max_ttft_s: float | None = None
     # "process" (default, production): the engine runs in a host
     # subprocess behind a pipe — its GIL-held device syncs would
     # otherwise starve the provider's event loop and every stream's

@@ -23,6 +23,7 @@ import asyncio
 import contextlib
 import os
 import time
+from collections import deque
 from typing import Any
 
 from symmetry_tpu.identity import Identity
@@ -127,8 +128,14 @@ class SymmetryProvider:
         # BASELINE.json headline metric.
         self.tracer = Tracer()
         self.metrics: dict[str, Any] = {
-            "requests": 0, "tokens_out": 0, "errors": 0,
+            "requests": 0, "tokens_out": 0, "errors": 0, "shed": 0,
         }
+        self._last_load_report = -1e9  # throttles shed-triggered METRICS
+        # TTFT-bounded admission state: requests accepted but not yet
+        # streaming, and recent first-token completion stamps (the
+        # admission-rate signal the wait estimate divides by).
+        self._unstarted = 0
+        self._first_token_stamps: deque[float] = deque(maxlen=512)
         self._started_at = time.monotonic()
 
     # ----- lifecycle (reference: init(), src/provider.ts:37-81) -----
@@ -352,11 +359,22 @@ class SymmetryProvider:
     def stats(self) -> dict[str, Any]:
         """Serving metrics snapshot: counters, tok/s, TTFT/e2e percentiles."""
         uptime = max(time.monotonic() - self._started_at, 1e-9)
+        slots = getattr(self.backend, "slots", None)
         return {
             "requests": self.metrics["requests"],
             "tokens_out": self.metrics["tokens_out"],
             "errors": self.metrics["errors"],
+            "shed": self.metrics["shed"],
             "in_flight": self._in_flight,
+            # Requests waiting beyond the engine's concurrent slots — the
+            # router's steering signal (registry.select_provider prefers
+            # providers with the smallest reported backlog).
+            "queued": (max(0, self._in_flight - slots)
+                       if slots is not None else 0),
+            "pending_first_token": self._unstarted,
+            **({"queue_limit": getattr(self.backend, "queue_limit")}
+               if getattr(self.backend, "queue_limit", None) is not None
+               else {}),
             "connections": len(self._client_peers),
             "uptime_s": round(uptime, 1),
             "tok_s": round(self.metrics["tokens_out"] / uptime, 2),
@@ -494,6 +512,67 @@ class SymmetryProvider:
             return "invalid or expired session token"
         return None
 
+    def _estimated_first_token_wait_s(self) -> float | None:
+        """Predicted first-token wait for a request admitted NOW: requests
+        already accepted but not yet streaming, divided by the recent
+        first-token rate. None = no recent rate signal — a burst from idle
+        must not be shed on ignorance (the signal appears as soon as its
+        first wave starts streaming)."""
+        if self._unstarted <= 0:
+            return 0.0
+        now = time.monotonic()
+        recent = [t for t in self._first_token_stamps if now - t < 10.0]
+        if len(recent) < 4:
+            return None
+        span = max(now - recent[0], 0.25)
+        return self._unstarted / (len(recent) / span)
+
+    def _admission_shed_reason(self) -> dict | None:
+        """The structured busy payload when a new request must be shed,
+        else None. Two independent bounds:
+
+        1. in-flight ≥ queue_limit — the backlog exceeds ~one extra slot
+           rotation, so TTFT would grow with queue depth;
+        2. estimated first-token wait > admission_ttft_bound_s — the
+           sustained-arrival mode where decode slots may still be free but
+           prefill dispatch rate is the limiter and the scheduler inbox
+           holds seconds of wait (the in-flight bound can't see this).
+        """
+        limit = getattr(self.backend, "queue_limit", None)
+        slots = getattr(self.backend, "slots", None) or 0
+        if limit is not None and self._in_flight >= limit:
+            return {"error": f"provider busy: {self._in_flight} requests "
+                             f"in flight (limit {limit})",
+                    "queueDepth": max(0, self._in_flight - slots),
+                    "queueLimit": limit}
+        bound = getattr(self.backend, "admission_ttft_bound_s", None)
+        if bound is not None:
+            est = self._estimated_first_token_wait_s()
+            if est is not None and est > bound:
+                return {"error": f"provider busy: estimated first-token "
+                                 f"wait {est:.1f}s exceeds {bound:.1f}s",
+                        "queueDepth": self._unstarted,
+                        "estimatedWaitS": round(est, 2),
+                        **({"queueLimit": limit}
+                           if limit is not None else {})}
+        return None
+
+    async def _shed(self, peer: Peer, tag: dict, reason: dict) -> None:
+        self.metrics["shed"] += 1
+        logger.debug(f"shedding request: {reason['error']}")
+        await peer.send(MessageKey.INFERENCE_ERROR,
+                        {**reason, "busy": True, **tag})
+        # Push the load report NOW (throttled): the 15 s health-loop
+        # cadence is too stale for the router to steer a burst away.
+        now = time.monotonic()
+        if (now - self._last_load_report > 2.0
+                and self._server_peer is not None
+                and not self._server_peer.closed):
+            self._last_load_report = now
+            with contextlib.suppress(ConnectionError, OSError):
+                await self._server_peer.send(MessageKey.METRICS,
+                                             self.stats())
+
     async def _handle_inference(self, peer: Peer, data: dict) -> None:
         start = time.monotonic()
         req_id = data.get("requestId")
@@ -510,6 +589,17 @@ class SymmetryProvider:
             await peer.send(MessageKey.INFERENCE_ERROR,
                             {"error": err, **tag})
             return
+        # Bounded-latency admission: a request the provider cannot serve
+        # within its latency bounds is shed NOW with a STRUCTURED busy
+        # error — the client fails over (chat_failover excludes this
+        # provider), and the router steers by the queue depth reported in
+        # stats/METRICS. The reference had no equivalent (only the
+        # maxConnections peer cap, src/provider.ts:38-40): every queued
+        # client just waited, p99 growing with the backlog.
+        shed_reason = self._admission_shed_reason()
+        if shed_reason is not None:
+            await self._shed(peer, tag, shed_reason)
+            return
         request = InferenceRequest(
             messages=messages,
             max_tokens=data.get("max_tokens"),
@@ -519,6 +609,7 @@ class SymmetryProvider:
             seed=data.get("seed"),
         )
         self._in_flight += 1
+        self._unstarted += 1
         self.metrics["requests"] += 1
         request_id = f"{peer.remote_public_hex[:12]}:{self.metrics['requests']}"
         completion_parts: list[str] = []
@@ -549,6 +640,8 @@ class SymmetryProvider:
                         first_token_s = time.monotonic() - start
                         self.tracer.record("ttft", start, first_token_s,
                                            request_id=request_id)
+                        self._unstarted -= 1
+                        self._first_token_stamps.append(time.monotonic())
                 # Raw passthrough; Connection.send awaits drain = backpressure
                 # (reference's write/drain discipline, src/provider.ts:248-252).
                 await peer.send(MessageKey.TOKEN_CHUNK,
@@ -591,6 +684,10 @@ class SymmetryProvider:
             raise
         finally:
             self._in_flight -= 1
+            if first_token_s is None:
+                # Never started streaming (error/cancel before the first
+                # token) — still waiting from the estimator's view.
+                self._unstarted -= 1
 
     async def _report_completion(self, data: dict, tokens: int) -> None:
         token = data.get("sessionToken") or {}

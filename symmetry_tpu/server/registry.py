@@ -31,6 +31,8 @@ CREATE TABLE IF NOT EXISTS peers (
     online          INTEGER NOT NULL DEFAULT 1,
     connections     INTEGER NOT NULL DEFAULT 0,
     max_connections INTEGER NOT NULL DEFAULT 10,
+    queued          INTEGER NOT NULL DEFAULT 0,  -- reported engine backlog
+
     data_collection INTEGER NOT NULL DEFAULT 0,
     config          TEXT,               -- sanitized config JSON (no secrets)
     metrics         TEXT,               -- latest load/latency report JSON
@@ -104,7 +106,8 @@ class Registry:
         self._db.executescript(_SCHEMA)
         self._migrate()
         # Restart recovery: anything marked online in a previous run is stale.
-        self._db.execute("UPDATE peers SET online = 0, connections = 0")
+        self._db.execute(
+            "UPDATE peers SET online = 0, connections = 0, queued = 0")
         self._db.commit()
 
     def _migrate(self) -> None:
@@ -114,6 +117,10 @@ class Registry:
                 self._db.execute("PRAGMA table_info(peers)")}
         if "metrics" not in have:
             self._db.execute("ALTER TABLE peers ADD COLUMN metrics TEXT")
+        if "queued" not in have:
+            self._db.execute(
+                "ALTER TABLE peers ADD COLUMN queued INTEGER NOT NULL "
+                "DEFAULT 0")
         self._db.commit()
 
     # --- providers (PeerUpsert semantics, reference src/types.ts:203-208) ---
@@ -159,10 +166,16 @@ class Registry:
     def set_metrics(self, peer_key: str, metrics: dict[str, Any]) -> None:
         """Latest provider load/latency report (`metrics` key): tok/s,
         in-flight, TTFT percentiles — the server-side view of provider
-        health beyond liveness."""
+        health beyond liveness. The reported engine backlog (`queued`) is
+        lifted into its own column so select_provider can steer away from
+        overloaded providers without parsing JSON per candidate."""
+        queued = metrics.get("queued")
+        if not isinstance(queued, int) or queued < 0:
+            queued = 0
         self._db.execute(
-            "UPDATE peers SET metrics = ?, last_seen = ? WHERE peer_key = ?",
-            (json.dumps(metrics), time.time(), peer_key),
+            "UPDATE peers SET metrics = ?, queued = ?, last_seen = ?"
+            " WHERE peer_key = ?",
+            (json.dumps(metrics), queued, time.time(), peer_key),
         )
         self._db.commit()
 
@@ -198,7 +211,12 @@ class Registry:
             query += (" AND peer_key NOT IN ("
                       + ",".join("?" * len(exclude)) + ")")
             params.extend(exclude)
-        query += " ORDER BY CAST(connections AS REAL) / max_connections ASC, last_seen DESC LIMIT 1"
+        # Steering: reported engine backlog first (a provider shedding
+        # load must stop receiving assignments while an idle one exists),
+        # then the reference's least-loaded-by-connections order.
+        query += (" ORDER BY queued ASC,"
+                  " CAST(connections AS REAL) / max_connections ASC,"
+                  " last_seen DESC LIMIT 1")
         row = self._db.execute(query, tuple(params)).fetchone()
         return _row_to_provider(row) if row else None
 

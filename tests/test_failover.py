@@ -11,7 +11,12 @@ import asyncio
 
 import pytest
 
-from symmetry_tpu.client.client import ChatRestart, ClientError, SymmetryClient
+from symmetry_tpu.client.client import (
+    ChatRestart,
+    ClientError,
+    ProviderBusyError,
+    SymmetryClient,
+)
 from symmetry_tpu.identity import Identity
 from symmetry_tpu.protocol.keys import MessageKey
 from symmetry_tpu.provider.backends.base import InferenceBackend, StreamChunk
@@ -151,6 +156,110 @@ class TestFailover:
             await server.stop()
 
         run(main())
+
+    def test_busy_shed_fails_over_to_second_provider(self):
+        """Bounded-latency admission: a provider over its queue_limit
+        rejects with a structured busy error instead of queueing
+        unboundedly, and chat_failover completes on another provider."""
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("fo-server4")
+            server, p1, p2 = await start_network(hub, ident,
+                                                 slow_first=False)
+            # p1 sheds everything: zero slots, zero queue.
+            p1.backend.slots = 0
+            p1.backend.queue_limit = 0
+            client = SymmetryClient(Identity.from_name("fo-cli4"), hub)
+            server.registry.set_connections(p2.identity.public_hex, 5)
+
+            events = []
+            async for item in client.chat_failover(
+                    "mem://server", ident.public_key, "tiny:fo",
+                    [{"role": "user", "content": "busy path"}]):
+                events.append(item)
+
+            restarts = [e for e in events if isinstance(e, ChatRestart)]
+            assert len(restarts) == 1
+            assert restarts[0].provider_key == p2.identity.public_hex
+            assert "".join(e for e in events
+                           if isinstance(e, str)) == "busy path"
+            assert p1.metrics["shed"] == 1
+            assert p1.stats()["shed"] == 1
+            await p1.stop(drain_timeout_s=1)
+            await p2.stop(drain_timeout_s=1)
+            await server.stop()
+
+        run(main())
+
+    def test_busy_raises_structured_error_direct(self):
+        """A non-failover client sees ProviderBusyError carrying the
+        provider's queue depth/limit, not a generic failure."""
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("fo-server5")
+            server, p1, p2 = await start_network(hub, ident,
+                                                 slow_first=False)
+            p1.backend.slots = 0
+            p1.backend.queue_limit = 0
+            client = SymmetryClient(Identity.from_name("fo-cli5"), hub)
+            server.registry.set_connections(p2.identity.public_hex, 5)
+
+            details = await client.request_provider(
+                "mem://server", ident.public_key, "tiny:fo")
+            assert details.peer_key == p1.identity.public_hex
+            session = await client.connect(details)
+            try:
+                with pytest.raises(ProviderBusyError) as exc_info:
+                    async for _ in session.chat(
+                            [{"role": "user", "content": "x"}]):
+                        pass
+                assert exc_info.value.queue_limit == 0
+            finally:
+                await session.close()
+            await p1.stop(drain_timeout_s=1)
+            await p2.stop(drain_timeout_s=1)
+            await server.stop()
+
+        run(main())
+
+    def test_ttft_bound_estimator_and_shed_reasons(self):
+        """The two admission bounds, exercised directly: the in-flight
+        queue_limit and the rate-based estimated first-token wait."""
+        import time as _t
+
+        prov = SymmetryProvider(
+            provider_config("00" * 32, "est-p"), transport=MemoryTransport(),
+            identity=Identity.from_name("est-p"), server_address="mem://x")
+
+        # Nothing waiting → zero wait, no shed.
+        assert prov._estimated_first_token_wait_s() == 0.0
+        assert prov._admission_shed_reason() is None
+
+        # Backlog but NO recent rate signal (burst from idle): the
+        # estimator must return None and the bound must not shed.
+        prov.backend.admission_ttft_bound_s = 1.0
+        prov._unstarted = 50
+        assert prov._estimated_first_token_wait_s() is None
+        assert prov._admission_shed_reason() is None
+
+        # Recent first tokens at ~1/s with 50 waiting → ~50 s estimated
+        # wait → over the 1 s bound → structured shed reason.
+        now = _t.monotonic()
+        prov._first_token_stamps.extend(now - 5 + i for i in range(5))
+        est = prov._estimated_first_token_wait_s()
+        assert est is not None and 25 <= est <= 100
+        reason = prov._admission_shed_reason()
+        assert reason is not None
+        assert reason["estimatedWaitS"] == round(est, 2)
+        assert reason["queueDepth"] == 50
+
+        # The in-flight bound fires first when both trip.
+        prov.backend.queue_limit = 4
+        prov.backend.slots = 2
+        prov._in_flight = 4
+        reason = prov._admission_shed_reason()
+        assert reason is not None and reason["queueLimit"] == 4
+        assert reason["queueDepth"] == 2  # 4 in flight - 2 slots
 
     def test_failover_exhaustion_raises(self):
         async def main():

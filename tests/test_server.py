@@ -51,6 +51,24 @@ def test_load_normalized_by_capacity():
     assert reg.select_provider("m1").peer_key == "big"
 
 
+def test_steering_prefers_smaller_reported_backlog():
+    """A provider reporting engine backlog (queued) must stop receiving
+    assignments while a less-backlogged one exists — the router-side half
+    of overload shedding."""
+    reg = Registry()
+    _add(reg, "busy", conns=1)
+    _add(reg, "idle", conns=3)   # more connections, but no backlog
+    reg.set_metrics("busy", {"queued": 64, "shed": 12})
+    reg.set_metrics("idle", {"queued": 0})
+    assert reg.select_provider("m1").peer_key == "idle"
+    # Backlog drains → connection-load order applies again.
+    reg.set_metrics("busy", {"queued": 0})
+    assert reg.select_provider("m1").peer_key == "busy"
+    # A malformed report must not poison steering.
+    reg.set_metrics("busy", {"queued": "garbage"})
+    assert reg.select_provider("m1").peer_key == "busy"
+
+
 def test_sessions_and_completions():
     reg = Registry()
     _add(reg, "p1")
