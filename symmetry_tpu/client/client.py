@@ -368,49 +368,99 @@ class SymmetryClient:
         the dead provider is void and consumers must discard it (a
         half-finished completion cannot be resumed token-exactly on
         another node). chat_text_failover does that bookkeeping for you.
+
+        Busy-shed backoff: when the pool is exhausted and busy sheds
+        exhausted it (the providers are healthy, just over their backlog
+        bound — a transient), the busy providers are un-excluded and ONE
+        more round runs after a short backoff sized from the last shed
+        reply's queue_depth/queue_limit, instead of failing a retryable
+        burst outright. Genuinely-dead providers stay excluded.
         """
         dead: list[str] = []
+        busy: list[str] = []
         last_exc: Exception | None = None
-        for attempt in range(attempts):
-            try:
-                details = await self.request_provider(
-                    server_address, server_key, model_name, exclude=dead)
-            except ClientError as exc:
-                last_exc = exc
-                break  # no provider left to fail over to
-            if attempt > 0:
-                yield ChatRestart(attempt=attempt,
-                                  provider_key=details.peer_key)
-            try:
-                # relay_via: a NAT-only provider (direct dial fails, the
-                # server splice works) is serviceable, not dead
-                session = await self.connect(
-                    details, relay_via=(server_address, server_key))
-            except (ClientError, ConnectionError, OSError) as exc:
-                last_exc = exc
-                if details.peer_key:
-                    dead.append(details.peer_key)
+        # Tracked separately from last_exc: pool exhaustion surfaces as a
+        # plain ClientError from request_provider AFTER the busy shed, so
+        # gating the retry on last_exc would skip it exactly when the
+        # sheds emptied the pool — the case the backoff exists for.
+        last_busy: ProviderBusyError | None = None
+        n_tries = 0
+        for round_idx in range(2):
+            pool_exhausted = False
+            for _ in range(attempts):
+                try:
+                    details = await self.request_provider(
+                        server_address, server_key, model_name,
+                        exclude=dead + busy)
+                except ClientError as exc:
+                    last_exc = exc
+                    pool_exhausted = True
+                    break  # no provider left to fail over to
+                if n_tries > 0:
+                    yield ChatRestart(attempt=n_tries,
+                                      provider_key=details.peer_key)
+                n_tries += 1
+                try:
+                    # relay_via: a NAT-only provider (direct dial fails,
+                    # the server splice works) is serviceable, not dead
+                    session = await self.connect(
+                        details, relay_via=(server_address, server_key))
+                except (ClientError, ConnectionError, OSError) as exc:
+                    last_exc = exc
+                    if details.peer_key:
+                        dead.append(details.peer_key)
+                    continue
+                try:
+                    async for delta in session.chat(messages, **chat_kw):
+                        yield delta
+                    return
+                except (ProviderGoneError, ProviderBusyError,
+                        ConnectionError, OSError) as exc:
+                    # Provider-death AND busy-shed failures fail over (a
+                    # shed provider is healthy but over its backlog bound
+                    # — this request is excluded from it, not the
+                    # provider from the pool). A request-level
+                    # ClientError (bad messages, rejected params)
+                    # propagates: replaying it elsewhere would fail
+                    # identically while blacklisting healthy providers.
+                    last_exc = exc
+                    if isinstance(exc, ProviderBusyError):
+                        # Tracked even for a keyless provider row (no
+                        # exclusion possible): the shed itself is what
+                        # makes the end-of-round backoff retry eligible.
+                        last_busy = exc
+                        if details.peer_key:
+                            busy.append(details.peer_key)
+                    elif details.peer_key:
+                        dead.append(details.peer_key)
+                finally:
+                    await session.close()
+            # Retry only when busy sheds actually ended the round: the
+            # pool ran dry with sheds among the exclusions, or the final
+            # attempt itself was shed. A round that merely PASSED THROUGH
+            # a busy provider before dying on dead ones gets no bonus
+            # attempts beyond the caller's budget.
+            if (round_idx == 0 and last_busy is not None
+                    and (pool_exhausted
+                         or isinstance(last_exc, ProviderBusyError))):
+                # One retry round: the backlog that shed us drains at
+                # roughly one slot rotation; scale the wait by how deep
+                # the queue was relative to its limit, bounded so a huge
+                # depth never turns into a stall of our own.
+                depth = last_busy.queue_depth or 0
+                limit = last_busy.queue_limit or 0
+                over = depth / limit if limit > 0 else 1.0
+                backoff = min(2.0, 0.25 * (1.0 + over))
+                logger.debug(
+                    f"pool exhausted on busy sheds (depth={depth} "
+                    f"limit={limit}); retrying once in {backoff:.2f}s")
+                await asyncio.sleep(backoff)
+                busy.clear()
                 continue
-            try:
-                async for delta in session.chat(messages, **chat_kw):
-                    yield delta
-                return
-            except (ProviderGoneError, ProviderBusyError,
-                    ConnectionError, OSError) as exc:
-                # Provider-death AND busy-shed failures fail over (a shed
-                # provider is healthy but over its backlog bound — this
-                # request is excluded from it, not the provider from the
-                # pool). A request-level ClientError (bad messages,
-                # rejected params) propagates: replaying it elsewhere
-                # would fail identically while blacklisting healthy
-                # providers.
-                last_exc = exc
-                if details.peer_key:
-                    dead.append(details.peer_key)
-            finally:
-                await session.close()
+            break
         raise ClientError(
-            f"chat failed after {attempts} provider attempt(s): {last_exc}")
+            f"chat failed after {n_tries or attempts} provider "
+            f"attempt(s): {last_exc}")
 
     async def chat_text_failover(self, server_address: str, server_key: bytes,
                                  model_name: str,

@@ -16,10 +16,26 @@ Protocol: JSON lines.
   stdout → {"op": "ready", "model": …}            (after warmup)
            {"op": "event", "id", "text", "done", "finish_reason",
             "error", "ttft_s", "tokens", "tokens_new"}
+           {"op": "events", "events": [{…event fields, no "op"…}, …]}
            {"op": "stats", …}
-Logs go to stderr. The host is intentionally synchronous: scheduler emit
-callbacks write lines under a lock straight from the engine thread —
-there is no latency-sensitive I/O in this process to starve.
+
+The batched `events` frame is the hot path: the scheduler coalesces each
+decode block's per-slot deltas (plus any finishes and admission errors
+from the same block) into ONE frame — one json.dumps, one pipe write,
+one flush per block, instead of one per slot per block. Events inside a
+frame are ordered; per-request order is the stream order. Single-event
+flushes still go out as legacy `event` frames, so pre-batching consumers
+keep working and the reader exercises both shapes; `ready`/`error`/
+`stats` frames are always single. Emit-path counters (`pipe_writes`,
+`pipe_event_writes`, `pipe_events`, `pipe_batched_frames`, `pipe_bytes`)
+ride the stats reply under `emit` so the provider/bench can verify the
+O(1)-writes-per-block contract end to end (`pipe_event_writes` is the
+contract's numerator — ready/stats frames are not emit-path traffic).
+
+Logs go to stderr. The host is intentionally synchronous: the scheduler's
+block-boundary flush writes one line under a lock straight from the
+engine thread — there is no latency-sensitive I/O in this process to
+starve.
 
 Run: python -m symmetry_tpu.engine.host <config.yaml>
 """
@@ -29,12 +45,15 @@ from __future__ import annotations
 import json
 import sys
 import threading
-from typing import Any
+from typing import TYPE_CHECKING, Any
 
 from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
 from symmetry_tpu.engine.scheduler import GenRequest, Scheduler
 from symmetry_tpu.provider.config import ConfigManager
 from symmetry_tpu.utils.logging import logger
+
+if TYPE_CHECKING:
+    from symmetry_tpu.engine.scheduler import TokenEvent
 
 
 class EngineHost:
@@ -45,14 +64,63 @@ class EngineHost:
         self._wlock = threading.Lock()
         self._cancelled: set[str] = set()
         self._reported: dict[str, int] = {}  # id -> tokens already reported
+        # Emit-path counters (under _wlock): every stdout line counts one
+        # pipe_write; pipe_event_writes counts only lines that carry
+        # TokenEvents (the writes-per-block contract is about THESE —
+        # ready/stats frames are not emit-path traffic); pipe_events
+        # counts TokenEvents carried (== event writes only if nothing
+        # coalesces). The O(1)-writes-per-block assertion in tests and
+        # the bench emit metrics both read these.
+        self.emit_stats = {"pipe_writes": 0, "pipe_event_writes": 0,
+                           "pipe_events": 0, "pipe_batched_frames": 0,
+                           "pipe_bytes": 0}
 
     # ---------------------------------------------------------------- wire
 
-    def _write(self, obj: dict[str, Any]) -> None:
+    def _write(self, obj: dict[str, Any], *, events: int = 0) -> None:
         line = json.dumps(obj, separators=(",", ":"))
         with self._wlock:
+            self.emit_stats["pipe_writes"] += 1
+            self.emit_stats["pipe_events"] += events
+            self.emit_stats["pipe_bytes"] += len(line) + 1
+            if events > 0:
+                self.emit_stats["pipe_event_writes"] += 1
+            if events > 1:
+                self.emit_stats["pipe_batched_frames"] += 1
             sys.stdout.write(line + "\n")
             sys.stdout.flush()
+
+    def _event_dict(self, req_id: str, ev: "TokenEvent") -> dict[str, Any]:
+        """One event's wire fields (shared by legacy and batched frames),
+        with the per-request delta bookkeeping."""
+        prev = self._reported.get(req_id, 0)
+        new = max(ev.tokens_generated - prev, 0)
+        self._reported[req_id] = max(ev.tokens_generated, prev)
+        out: dict[str, Any] = {"id": req_id, "text": ev.text,
+                               "tokens": ev.tokens_generated,
+                               "tokens_new": new}
+        if ev.ttft_s is not None:
+            out["ttft_s"] = round(ev.ttft_s, 4)
+        if ev.done:
+            out["done"] = True
+            out["finish_reason"] = ev.finish_reason
+            if ev.error:
+                out["error"] = ev.error
+            self._reported.pop(req_id, None)
+            self._cancelled.discard(req_id)
+        return out
+
+    def _emit_batch(self, batch: list[tuple[GenRequest, "TokenEvent"]]
+                    ) -> None:
+        """Scheduler block-boundary sink: the whole block's events leave
+        as ONE pipe write+flush. A lone event keeps the legacy single
+        `event` frame (wire-compatible with pre-batching readers)."""
+        events = [self._event_dict(req.id, ev) for req, ev in batch]
+        if len(events) == 1:
+            self._write({"op": "event", **events[0]}, events=1)
+        else:
+            self._write({"op": "events", "events": events},
+                        events=len(events))
 
     # ------------------------------------------------------------- lifecycle
 
@@ -84,7 +152,8 @@ class EngineHost:
         t1 = time.perf_counter()
         sched_engine.warmup()
         t_warmup = time.perf_counter() - t1
-        self._scheduler = Scheduler(sched_engine)
+        self._scheduler = Scheduler(sched_engine,
+                                    emit_batch=self._emit_batch)
         self._scheduler.start()
         self._write({"op": "ready",
                      "model": self._config.model_name,
@@ -127,6 +196,10 @@ class EngineHost:
                 thread = self._scheduler._thread
                 m["engine_alive"] = bool(thread is not None
                                          and thread.is_alive())
+                # Snapshot without _wlock — _write below takes it (non-
+                # reentrant), and a dict-of-ints copy is GIL-atomic enough
+                # for a stats read.
+                m["emit"] = dict(self.emit_stats)
                 self._write(m)
             elif op == "shutdown":
                 break
@@ -152,26 +225,15 @@ class EngineHost:
         except Exception as exc:  # noqa: BLE001 — tokenizer failure → event
             self._write({"op": "event", "id": req_id, "text": "",
                          "done": True, "finish_reason": "error",
-                         "error": f"tokenization failed: {exc}"})
+                         "error": f"tokenization failed: {exc}"}, events=1)
             return
         self._reported[req_id] = 0
 
-        def emit(ev) -> None:
-            prev = self._reported.get(req_id, 0)
-            new = max(ev.tokens_generated - prev, 0)
-            self._reported[req_id] = max(ev.tokens_generated, prev)
-            out = {"op": "event", "id": req_id, "text": ev.text,
-                   "tokens": ev.tokens_generated, "tokens_new": new}
-            if ev.ttft_s is not None:
-                out["ttft_s"] = round(ev.ttft_s, 4)
-            if ev.done:
-                out["done"] = True
-                out["finish_reason"] = ev.finish_reason
-                if ev.error:
-                    out["error"] = ev.error
-                self._reported.pop(req_id, None)
-                self._cancelled.discard(req_id)
-            self._write(out)
+        def emit(ev, req_id=req_id) -> None:
+            # Fallback path only: the scheduler delivers through the
+            # emit_batch sink; this fires if batching is ever disabled.
+            self._write({"op": "event", **self._event_dict(req_id, ev)},
+                        events=1)
 
         self._scheduler.submit(GenRequest(
             prompt_ids=prompt_ids, sampling=sampling,

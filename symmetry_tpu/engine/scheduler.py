@@ -25,8 +25,11 @@ import asyncio
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
+
+import numpy as np
 
 from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
 from symmetry_tpu.engine.tokenizer import StreamDecoder
@@ -77,11 +80,30 @@ class Scheduler:
                  debug_invariants: bool = False,
                  prefill_chunks_per_block: int = 4,
                  admit_groups_per_block: int = 4,
-                 admit_seconds_per_block: float = 0.65) -> None:
+                 admit_seconds_per_block: float = 0.65,
+                 emit_batch: Callable[
+                     [list[tuple[GenRequest, TokenEvent]]], None]
+                 | None = None) -> None:
         self.engine = engine
         self._inbox: queue.Queue[GenRequest | None] = queue.Queue()
+        # Budget-deferred admissions wait HERE, not at the inbox tail:
+        # re-queuing a deferred subgroup behind later arrivals inverted
+        # FIFO order every block it stayed deferred, unboundedly inflating
+        # that request's TTFT under sustained load. Drained before the
+        # inbox on the next _admit_new pass, so arrival order holds.
+        self._deferred: deque[GenRequest] = deque()
         self._slots: dict[int, _ActiveSlot] = {}
         self._free: list[int] = list(range(engine.max_slots))[::-1]
+        # Block-granular emit: events buffer on the engine thread and are
+        # delivered at block boundaries — as ONE emit_batch call when a
+        # sink is installed (the host pipe writes one frame per block), or
+        # per-event through req.emit otherwise (AsyncSession, tests).
+        self._emit_batch = emit_batch
+        self._pending_events: list[tuple[GenRequest, TokenEvent]] = []
+        # Vectorized terminal scan over each [K, B] block needs the EOS
+        # set as an array once, not a per-token set probe.
+        self._eos_arr = np.array(sorted(engine.tokenizer.eos_ids),
+                                 dtype=np.int64)
         # Long prompts prefill chunk-by-chunk between decode blocks
         # (engine.ChunkedPrefill); short bursts are capped per block. Both
         # bound how long active streams stall on admission work — the
@@ -114,7 +136,13 @@ class Scheduler:
                         # and cumulative seconds, read via stats().
                         "admit_dispatches": 0, "admit_s": 0.0,
                         "chunk_dispatches": 0, "chunk_s": 0.0,
-                        "block_syncs": 0, "sync_s": 0.0}
+                        "block_syncs": 0, "sync_s": 0.0,
+                        # Emit-path accounting: flushes = batch deliveries
+                        # (one per block boundary with events pending),
+                        # events = TokenEvents carried. events/flushes is
+                        # the coalescing ratio the batched host frame
+                        # exists to raise.
+                        "emit_flushes": 0, "emit_events": 0}
         from symmetry_tpu.utils.trace import Histogram
 
         # Engine-side latency distributions: TTFT as the scheduler saw it
@@ -160,6 +188,7 @@ class Scheduler:
         """Counters + engine-side latency percentiles (host stats op)."""
         out: dict[str, Any] = dict(self.metrics)
         out["occupancy"] = len(self._slots)
+        out["deferred"] = len(self._deferred)
         out["engine_ttft_s"] = self._ttft_hist.to_dict()
         out["admit_dispatch_s"] = self._admit_hist.to_dict()
         out["block_interval_s"] = self._interval_hist.to_dict()
@@ -178,6 +207,15 @@ class Scheduler:
                     text="", token_id=None, done=True, finish_reason="error",
                     error=f"engine failure: {exc}"))
                 del self._slots[slot]
+            while self._deferred:
+                self._emit_cb(self._deferred.popleft(), TokenEvent(
+                    text="", token_id=None, done=True,
+                    finish_reason="error", error=f"engine failure: {exc}"))
+            for _job, req in self._prefill_jobs:
+                self._emit_cb(req, TokenEvent(
+                    text="", token_id=None, done=True,
+                    finish_reason="error", error=f"engine failure: {exc}"))
+            self._prefill_jobs.clear()
             while True:
                 try:
                     item = self._inbox.get_nowait()
@@ -187,10 +225,10 @@ class Scheduler:
                     self._emit_cb(item, TokenEvent(
                         text="", token_id=None, done=True,
                         finish_reason="error", error=f"engine failure: {exc}"))
+            self._flush_events()
             raise
 
     def _loop_forever(self) -> None:
-        eos = self.engine.tokenizer.eos_ids
         # Double-buffered decode (SURVEY §7 hard-part 3): one block is
         # always in flight on the device while the host processes the
         # previous block's tokens. `pending` = (device token array,
@@ -203,6 +241,9 @@ class Scheduler:
             self._spent_this_block = 0.0
             drained = self._admit_new()
             if not self._slots and pending is None and not self._prefill_jobs:
+                # Terminal/error events from the admission pass must reach
+                # their consumers BEFORE blocking on an empty inbox.
+                self._flush_events()
                 # Idle boundary: the next block interval would span the
                 # idle wait, which is not a serving stall.
                 self._last_sync_done = None
@@ -227,6 +268,7 @@ class Scheduler:
                 # would reorder it BEHIND arrivals that raced in while we
                 # were blocked — inverted FIFO for the earliest request).
                 self._admit_new(carry=item)
+                self._flush_events()
                 continue
 
             # Dispatch block N+1 BEFORE syncing block N: np.asarray on
@@ -243,17 +285,32 @@ class Scheduler:
             # admission from stalling active streams for more than ~a
             # chunk's device time.
             self._advance_prefills()
+            # Admission-time events (first tokens from placement, chunked-
+            # prefill finishes, admission errors) leave NOW, before the
+            # device sync below can hold them for up to a whole block —
+            # first-token latency must not pay for block coalescing. One
+            # extra pipe write per block at most: still O(1).
+            self._flush_events()
             if pending is not None:
-                self._process_block(pending[0], pending[1], eos)
+                self._process_block(pending[0], pending[1])
             pending = nxt
+            # Block boundary: everything this iteration produced (block
+            # deltas, finishes) leaves as one batch — the O(1)-writes-
+            # per-block contract.
+            self._flush_events()
             if self._debug:
                 self._check_invariants()
 
     def _process_block(self, device_toks: Any,
-                       snapshot: dict[int, _ActiveSlot], eos) -> None:
-        """Sync one decode block to host and stream its tokens out."""
-        import numpy as np
+                       snapshot: dict[int, _ActiveSlot]) -> None:
+        """Sync one decode block to host and stream its tokens out.
 
+        Batched pass (the block-granular emit path): ONE vectorized EOS
+        scan over the whole [K, B] block, then per live slot one
+        finish-point computation, one push_many over its token run, and
+        one buffered TokenEvent — per-token Python work is gone, and the
+        block boundary flush coalesces every slot's event into a single
+        host-pipe frame."""
         t0 = time.perf_counter()
         toks = np.asarray(device_toks)  # blocks on THIS block only
         t1 = time.perf_counter()
@@ -263,26 +320,39 @@ class Scheduler:
             self._interval_hist.observe(t1 - self._last_sync_done)
         self._last_sync_done = t1
         K = toks.shape[0]
+        eos_mask = (np.isin(toks, self._eos_arr) if self._eos_arr.size
+                    else np.zeros(toks.shape, dtype=bool))
+        block_tokens = 0
         for slot, active in snapshot.items():
             if self._slots.get(slot) is not active:
                 continue  # finished in an earlier block; lane is stale
-            cancelled = active.req.cancelled()
-            finish = "cancelled" if cancelled else None
-            text_parts: list[str] = []
-            last_tok = None
-            for k in range(K):
-                if finish is not None:
-                    break  # discard block remainder past the finish
-                tok = int(toks[k, slot])
-                last_tok = tok
-                active.generated += 1
-                self.metrics["tokens"] += 1
-                if tok in eos:
-                    finish = "stop"
-                    break
-                text_parts.append(active.decoder.push(tok))
-                if active.generated >= active.req.max_new_tokens:
-                    finish = "length"
+            if active.req.cancelled():
+                # Discard the whole block remainder past the cancel.
+                self._finish(slot, active, "cancelled", None, "")
+                continue
+            # The request consumes tokens until the first EOS, its token
+            # budget, or the block end — whichever comes first. An EOS at
+            # the budget-exhausting position still finishes as "stop"
+            # (EOS is checked before the length bound, matching the
+            # per-token order this pass replaced). The EOS token counts
+            # toward tokens_generated but is never detokenized.
+            budget = active.req.max_new_tokens - active.generated
+            r = max(1, min(K, budget))
+            hits = np.flatnonzero(eos_mask[:r, slot])
+            if hits.size:
+                e = int(hits[0])
+                n_push, consumed, finish = e, e + 1, "stop"
+            elif budget <= K:
+                n_push = consumed = r
+                finish = "length"
+            else:
+                n_push = consumed = K
+                finish = None
+            last_tok = int(toks[consumed - 1, slot])
+            active.generated += consumed
+            block_tokens += consumed
+            text = (active.decoder.push_many(toks[:n_push, slot].tolist())
+                    if n_push else "")
             # TWO blocks may touch the cache before this slot is seen
             # again (one already in flight + the next dispatch); a slot
             # that can't absorb 2K more entries must finish now (cache
@@ -290,7 +360,6 @@ class Scheduler:
             if finish is None and (active.prompt_len + active.generated
                                    + 2 * K > self.engine.slot_capacity + 1):
                 finish = "length"
-            text = "".join(text_parts)
             if finish is None:
                 if text:
                     self._emit(active, TokenEvent(
@@ -298,6 +367,7 @@ class Scheduler:
                         tokens_generated=active.generated))
             else:
                 self._finish(slot, active, finish, last_tok, text)
+        self.metrics["tokens"] += block_tokens
 
     def _admit_new(self, carry: GenRequest | None = None) -> bool:
         """Place queued requests into free slots. Returns True if inbox
@@ -336,6 +406,12 @@ class Scheduler:
             while self._free and len(group) < batch_cap:
                 if carry is not None:
                     item, carry = carry, None
+                elif self._deferred:
+                    # Budget-deferred subgroups from earlier blocks go
+                    # first: they were popped from the inbox BEFORE
+                    # everything still in it, so draining them first is
+                    # what preserves arrival order.
+                    item = self._deferred.popleft()
                 else:
                     try:
                         item = self._inbox.get_nowait()
@@ -359,11 +435,21 @@ class Scheduler:
                 # spans buckets (or exceeds a bucket's batch cap) costs
                 # several dispatches, and each one stalls active streams.
                 groups_left -= max(done, 1)
+            else:
+                # Unbudgeted cold-burst drain (nothing was decoding): a
+                # large burst spans many placement groups, so each
+                # group's first tokens leave NOW rather than after the
+                # whole drain — the earliest request's delivered TTFT
+                # must not pay for the rest of the burst's admission.
+                # Still one write per placement group, not per event.
+                self._flush_events()
         if carry is not None:
-            # No free slot took it (all busy): back to the queue rather
-            # than dropping the request.
-            self._inbox.put(carry)
-        return self._inbox.empty()
+            # No free slot took it (all busy): hold it at the deferred
+            # tail rather than dropping it — every deferred entry was
+            # popped before anything still in the inbox, so this keeps
+            # arrival order too.
+            self._deferred.append(carry)
+        return not self._deferred and self._inbox.empty()
 
     def _place_group(self, group: list[tuple[int, GenRequest]]) -> int:
         """Admit `group`; returns the number of prefill DEVICE DISPATCHES
@@ -423,13 +509,15 @@ class Scheduler:
                 # 4-5 dispatches, and running them all back-to-back would
                 # overshoot the budget several-fold and stall every
                 # active stream. Defer the unstarted subgroups — slots
-                # back to the pool, requests back to the queue — and let
-                # the next block pick them up. (unit_idx > 0 guarantees
-                # forward progress: one dispatch always lands.)
+                # back to the pool, requests to the deferred queue (NOT
+                # the inbox tail, which would put them behind later
+                # arrivals and invert FIFO order every deferral) — and
+                # let the next block pick them up. (unit_idx > 0
+                # guarantees forward progress: one dispatch always lands.)
                 for slot, req in (pair for u in units[unit_idx:]
                                   for pair in u):
                     self._free.append(slot)
-                    self._inbox.put(req)
+                    self._deferred.append(req)
                 break
             t0 = time.perf_counter()
             try:
@@ -558,12 +646,31 @@ class Scheduler:
     def _emit(self, active: _ActiveSlot, ev: TokenEvent) -> None:
         self._emit_cb(active.req, ev)
 
-    @staticmethod
-    def _emit_cb(req: GenRequest, ev: TokenEvent) -> None:
-        try:
-            req.emit(ev)
-        except Exception as exc:  # noqa: BLE001 — emit must never kill the loop
-            log.error(f"emit callback failed for request {req.id}: {exc}")
+    def _emit_cb(self, req: GenRequest, ev: TokenEvent) -> None:
+        """Buffer an event for the next block-boundary flush. All emits
+        happen on the engine thread, so the buffer needs no lock."""
+        self._pending_events.append((req, ev))
+
+    def _flush_events(self) -> None:
+        """Deliver everything buffered since the last block boundary: one
+        emit_batch call when a sink is installed (→ one host-pipe frame
+        per block), else per-event req.emit delivery."""
+        if not self._pending_events:
+            return
+        batch, self._pending_events = self._pending_events, []
+        self.metrics["emit_flushes"] += 1
+        self.metrics["emit_events"] += len(batch)
+        if self._emit_batch is not None:
+            try:
+                self._emit_batch(batch)
+            except Exception as exc:  # noqa: BLE001 — must never kill the loop
+                log.error(f"emit batch sink failed: {exc}")
+            return
+        for req, ev in batch:
+            try:
+                req.emit(ev)
+            except Exception as exc:  # noqa: BLE001 — emit must never kill the loop
+                log.error(f"emit callback failed for request {req.id}: {exc}")
 
     def _check_invariants(self) -> None:
         active = set(self._slots)

@@ -55,15 +55,36 @@ class StreamDecoder:
         self._read = 0    # first id not yet emitted as text
 
     def push(self, token_id: int) -> str:
-        self._ids.append(token_id)
-        prefix_text = self._tok.decode(self._ids[self._prefix:self._read])
-        text = self._tok.decode(self._ids[self._prefix:])
-        if text.endswith("�"):
-            # Mid-codepoint: hold everything back until it completes.
+        # One id is the degenerate batch: the back-off loop collapses to
+        # push's old hold-everything-back behavior, and keeping a single
+        # implementation means the hold-back rules cannot drift.
+        return self.push_many([token_id])
+
+    def push_many(self, token_ids: list[int]) -> str:
+        """Feed a whole run of ids in ONE pass; return the newly-completed
+        text. Equivalent to ``"".join(push(t) for t in token_ids)`` but with
+        O(1) decode calls per run instead of O(len) — the batch API the
+        block-granular emit path uses (one call per slot per decode block).
+
+        A trailing incomplete codepoint is held back exactly as push()
+        holds it: back off id-by-id from the end (a codepoint spans at most
+        a few ids) to the last clean boundary and emit up to there."""
+        if not token_ids:
             return ""
+        self._ids.extend(token_ids)
+        prefix_text = self._tok.decode(self._ids[self._prefix:self._read])
+        end = len(self._ids)
+        text = self._tok.decode(self._ids[self._prefix:end])
+        while text.endswith("�") and end > self._read:
+            # Mid-codepoint tail: shrink the emitted run until clean. Ids
+            # past `end` stay buffered for the next push/flush.
+            end -= 1
+            text = self._tok.decode(self._ids[self._prefix:end])
+        if text.endswith("�") or end <= self._read:
+            return ""  # the whole unemitted run is mid-codepoint
         delta = text[len(prefix_text):]
         self._prefix = self._read
-        self._read = len(self._ids)
+        self._read = end
         return delta
 
     def flush(self) -> str:

@@ -77,6 +77,12 @@ class Peer:
     def closed(self) -> bool:
         return self._conn.closed
 
+    @property
+    def write_stats(self) -> dict | None:
+        """The transport's emit-path write counters (transport/base.py
+        WriteCork), when it tracks them."""
+        return self._conn.write_stats
+
     async def send(self, key: str, data: Any = None) -> None:
         payload = create_message(key, data)
         ct = self._session.encrypt(payload)

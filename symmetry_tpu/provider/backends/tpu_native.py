@@ -67,6 +67,11 @@ class TpuNativeBackend(InferenceBackend):
         extra = tpu.max_queue if tpu.max_queue is not None else self.slots
         self.queue_limit = self.slots + max(0, extra)
         self.admission_ttft_bound_s = tpu.max_ttft_s
+        # Relay-side emit accounting: host frames read vs events carried.
+        # frames << events means the batched `events` protocol is doing
+        # its job (one pipe read fans out a whole decode block).
+        self.relay_stats = {"host_frames": 0, "host_events": 0,
+                            "host_batched_frames": 0}
 
     @property
     def _process_mode(self) -> bool:
@@ -166,7 +171,8 @@ class TpuNativeBackend(InferenceBackend):
                 msg = json.loads(line)
             except ValueError:
                 continue
-            if msg.get("op") == "stats":
+            op = msg.get("op")
+            if op == "stats":
                 # stats reply: liveness for the health loop + the full
                 # scheduler breakdown for engine_stats() consumers
                 self._engine_alive = bool(msg.get("engine_alive", True))
@@ -175,8 +181,27 @@ class TpuNativeBackend(InferenceBackend):
                     if not w.done():
                         w.set_result(msg)
                 continue
-            if msg.get("op") != "event":
+            if op == "events":
+                # Batched frame: one pipe line carries every slot's delta
+                # for a decode block. Fan out in frame order — per-request
+                # (and cross-request) ordering is the list order.
+                events = msg.get("events")
+                if not isinstance(events, list):
+                    continue
+                self.relay_stats["host_frames"] += 1
+                self.relay_stats["host_batched_frames"] += 1
+                self.relay_stats["host_events"] += len(events)
+                for ev in events:
+                    if not isinstance(ev, dict):
+                        continue
+                    q = self._queues.get(str(ev.get("id", "")))
+                    if q is not None:
+                        q.put_nowait(ev)
                 continue
+            if op != "event":
+                continue
+            self.relay_stats["host_frames"] += 1
+            self.relay_stats["host_events"] += 1
             q = self._queues.get(str(msg.get("id", "")))
             if q is not None:
                 q.put_nowait(msg)
@@ -252,7 +277,9 @@ class TpuNativeBackend(InferenceBackend):
             msg = await self._probe_host_stats()
             if msg is None:
                 return None
-            return {k: v for k, v in msg.items() if k != "op"}
+            out = {k: v for k, v in msg.items() if k != "op"}
+            out["relay"] = dict(self.relay_stats)
+            return out
         if self._scheduler is None:
             return None
         stats = getattr(self._scheduler, "stats", None)

@@ -131,6 +131,11 @@ class SymmetryProvider:
             "requests": 0, "tokens_out": 0, "errors": 0, "shed": 0,
         }
         self._last_load_report = -1e9  # throttles shed-triggered METRICS
+        # Emit-path wire accounting: closed peers fold their transport
+        # write counters in here; stats() adds the live peers on top, so
+        # the totals survive disconnects (WriteCork, transport/base.py).
+        self._wire_totals = {"writes": 0, "frames": 0,
+                             "coalesced_frames": 0, "bytes": 0}
         # TTFT-bounded admission state: requests accepted but not yet
         # streaming, and recent first-token completion stamps (the
         # admission-rate signal the wait estimate divides by).
@@ -356,6 +361,17 @@ class SymmetryProvider:
                     MessageKey.CONNECTION_SIZE, len(self._client_peers)
                 )
 
+    def _wire_stats(self) -> dict[str, int]:
+        """Aggregate per-peer transport write counters: folded totals of
+        closed peers + a live read of every open one."""
+        out = dict(self._wire_totals)
+        for peer in self._client_peers:
+            ws = peer.write_stats
+            if ws:
+                for k in out:
+                    out[k] += ws.get(k, 0)
+        return out
+
     def stats(self) -> dict[str, Any]:
         """Serving metrics snapshot: counters, tok/s, TTFT/e2e percentiles."""
         uptime = max(time.monotonic() - self._started_at, 1e-9)
@@ -376,6 +392,10 @@ class SymmetryProvider:
                if getattr(self.backend, "queue_limit", None) is not None
                else {}),
             "connections": len(self._client_peers),
+            # Corked-wire emit path: writes < frames means same-tick
+            # coalescing is collapsing the per-stream fan-out of batched
+            # engine blocks into fewer syscalls (transport/base.WriteCork).
+            "wire": self._wire_stats(),
             "uptime_s": round(uptime, 1),
             "tok_s": round(self.metrics["tokens_out"] / uptime, 2),
             "ttft_s": self.tracer.histogram("ttft_s").to_dict(),
@@ -490,6 +510,12 @@ class SymmetryProvider:
         finally:
             self._client_peers.discard(peer)
             await peer.close()
+            # Fold AFTER close: the cork's settle() may perform one last
+            # write on the way down, and it must land in the totals.
+            ws = peer.write_stats
+            if ws:
+                for k in self._wire_totals:
+                    self._wire_totals[k] += ws.get(k, 0)
             await self._report_connections()
 
     # ----- the hot path (reference: handleInferenceRequest, src/provider.ts:195-275) -----

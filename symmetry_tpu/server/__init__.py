@@ -1,4 +1,13 @@
 from symmetry_tpu.server.registry import Registry
-from symmetry_tpu.server.broker import SymmetryServer
 
 __all__ = ["Registry", "SymmetryServer"]
+
+
+def __getattr__(name: str):
+    # Lazy (PEP 562): the broker pulls the identity/crypto stack; the
+    # registry (sqlite data model) must stay importable without it.
+    if name == "SymmetryServer":
+        from symmetry_tpu.server.broker import SymmetryServer
+
+        return SymmetryServer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -32,6 +32,7 @@ CREATE TABLE IF NOT EXISTS peers (
     connections     INTEGER NOT NULL DEFAULT 0,
     max_connections INTEGER NOT NULL DEFAULT 10,
     queued          INTEGER NOT NULL DEFAULT 0,  -- reported engine backlog
+    queued_at       REAL NOT NULL DEFAULT 0,     -- when `queued` was reported
 
     data_collection INTEGER NOT NULL DEFAULT 0,
     config          TEXT,               -- sanitized config JSON (no secrets)
@@ -100,6 +101,13 @@ def _row_to_provider(row: sqlite3.Row) -> ProviderRow:
 class Registry:
     """sqlite peer/session store. ':memory:' for tests, file path for prod."""
 
+    # A reported `queued` backlog steers selection only while fresh: shed-
+    # triggered METRICS pushes stop once the backlog drains, so a stale
+    # reading would keep deprioritizing a now-idle provider. Two provider
+    # health-report intervals (provider.HEALTH_INTERVAL_S = 15 s) without
+    # a fresh report → the backlog is treated as 0.
+    QUEUED_STALE_S = 30.0
+
     def __init__(self, db_path: str = ":memory:") -> None:
         self._db = sqlite3.connect(db_path)
         self._db.row_factory = sqlite3.Row
@@ -107,7 +115,8 @@ class Registry:
         self._migrate()
         # Restart recovery: anything marked online in a previous run is stale.
         self._db.execute(
-            "UPDATE peers SET online = 0, connections = 0, queued = 0")
+            "UPDATE peers SET online = 0, connections = 0, queued = 0,"
+            " queued_at = 0")
         self._db.commit()
 
     def _migrate(self) -> None:
@@ -120,6 +129,10 @@ class Registry:
         if "queued" not in have:
             self._db.execute(
                 "ALTER TABLE peers ADD COLUMN queued INTEGER NOT NULL "
+                "DEFAULT 0")
+        if "queued_at" not in have:
+            self._db.execute(
+                "ALTER TABLE peers ADD COLUMN queued_at REAL NOT NULL "
                 "DEFAULT 0")
         self._db.commit()
 
@@ -170,12 +183,15 @@ class Registry:
         lifted into its own column so select_provider can steer away from
         overloaded providers without parsing JSON per candidate."""
         queued = metrics.get("queued")
-        if not isinstance(queued, int) or queued < 0:
+        # bool is an int subclass: True would silently steer as backlog 1.
+        if (not isinstance(queued, int) or isinstance(queued, bool)
+                or queued < 0):
             queued = 0
+        now = time.time()
         self._db.execute(
-            "UPDATE peers SET metrics = ?, queued = ?, last_seen = ?"
-            " WHERE peer_key = ?",
-            (json.dumps(metrics), queued, time.time(), peer_key),
+            "UPDATE peers SET metrics = ?, queued = ?, queued_at = ?,"
+            " last_seen = ? WHERE peer_key = ?",
+            (json.dumps(metrics), queued, now, now, peer_key),
         )
         self._db.commit()
 
@@ -213,10 +229,14 @@ class Registry:
             params.extend(exclude)
         # Steering: reported engine backlog first (a provider shedding
         # load must stop receiving assignments while an idle one exists),
-        # then the reference's least-loaded-by-connections order.
-        query += (" ORDER BY queued ASC,"
+        # then the reference's least-loaded-by-connections order. A
+        # backlog report older than QUEUED_STALE_S is decayed to 0 — the
+        # provider stopped pushing METRICS because it stopped shedding.
+        query += (" ORDER BY (CASE WHEN queued_at >= ? THEN queued"
+                  " ELSE 0 END) ASC,"
                   " CAST(connections AS REAL) / max_connections ASC,"
                   " last_seen DESC LIMIT 1")
+        params.append(time.time() - self.QUEUED_STALE_S)
         row = self._db.execute(query, tuple(params)).fetchone()
         return _row_to_provider(row) if row else None
 

@@ -27,6 +27,11 @@ class MemoryConnection(Connection):
         self._peer: "MemoryConnection | None" = None  # set by memory_pair
         self._closed = False
         self._eof = False
+        # Frame-queue transport: boundaries ARE the unit, so nothing can
+        # coalesce — writes == frames. Tracked anyway so emit-path stats
+        # aggregate uniformly across transports.
+        self._write_stats = {"writes": 0, "frames": 0,
+                             "coalesced_frames": 0, "bytes": 0}
 
     async def send(self, frame: bytes) -> None:
         if self._closed:
@@ -35,7 +40,14 @@ class MemoryConnection(Connection):
             # Mirror TCP: writing to a reset connection raises, it doesn't
             # buffer into the void until the queue wedges.
             raise ConnectionError("connection reset by peer")
+        self._write_stats["writes"] += 1
+        self._write_stats["frames"] += 1
+        self._write_stats["bytes"] += len(frame)
         await self._tx.put(frame)  # Queue(maxsize) gives natural backpressure
+
+    @property
+    def write_stats(self) -> dict:
+        return dict(self._write_stats)
 
     async def recv(self) -> bytes | None:
         if self._eof or self._closed:

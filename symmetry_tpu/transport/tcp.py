@@ -14,7 +14,13 @@ import asyncio
 from collections import deque
 
 from symmetry_tpu.protocol.framing import FrameReader, encode_frame
-from symmetry_tpu.transport.base import Connection, ConnectionHandler, Listener, Transport
+from symmetry_tpu.transport.base import (
+    Connection,
+    ConnectionHandler,
+    Listener,
+    Transport,
+    WriteCork,
+)
 from symmetry_tpu.utils.logging import logger
 
 
@@ -36,12 +42,23 @@ class TcpConnection(Connection):
         self._frames = FrameReader()
         self._pending: deque[bytes] = deque()
         self._closed = False
+        # Per-connection write cork: frames sent in the same event-loop
+        # tick (the provider fan-out of one batched engine block to this
+        # peer's streams) leave in one write+drain instead of one each.
+        self._cork = WriteCork(self._write_drain)
+
+    async def _write_drain(self, data: bytes) -> None:
+        self._writer.write(data)
+        await self._writer.drain()
 
     async def send(self, frame: bytes) -> None:
         if self._closed:
             raise ConnectionError("connection closed")
-        self._writer.write(encode_frame(frame))
-        await self._writer.drain()
+        await self._cork.send(encode_frame(frame))
+
+    @property
+    def write_stats(self) -> dict:
+        return dict(self._cork.stats)
 
     async def recv(self) -> bytes | None:
         while not self._pending:
@@ -56,8 +73,21 @@ class TcpConnection(Connection):
 
     async def close(self) -> None:
         if not self._closed:
-            self._closed = True
+            self._closed = True  # set first: no new frames enter the cork
             try:
+                # Settle the cork before closing the writer: a frame
+                # send() accepted in this tick must reach the transport,
+                # not be buffered-and-discarded by the teardown. Bounded:
+                # a remote that stopped reading leaves the flusher wedged
+                # in drain() forever — after the grace period, abort (the
+                # writer.close() below breaks the stalled drain, whose
+                # error path then fails any still-waiting senders).
+                if self._cork.pending:
+                    try:
+                        await asyncio.wait_for(self._cork.settle(),
+                                               timeout=5.0)
+                    except asyncio.TimeoutError:
+                        pass
                 self._writer.close()
                 await self._writer.wait_closed()
             except (ConnectionResetError, BrokenPipeError, OSError):
