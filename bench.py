@@ -195,14 +195,18 @@ def run_e2e_client_worker() -> int:
     server_key = bytes.fromhex(spec["server_key_hex"])
     model_name = spec["model_name"]
     indices: list[int] = spec["indices"]
-    prompt: str = spec["prompt"]
+    # Per-session prompts (aligned with `indices`): the shared-prefix
+    # workload gives every client its own prompt; uniform workloads send
+    # the same string for all. Legacy "prompt" still accepted.
+    prompts: list[str] = (spec.get("prompts")
+                          or [spec["prompt"]] * len(indices))
     max_new: int = spec["max_new"]
     stagger_s: float = spec["stagger_s"]
 
     async def main() -> list[dict]:
         ready = asyncio.Event()
 
-        async def one_client(i: int) -> dict:
+        async def one_client(i: int, prompt: str) -> dict:
             client = SymmetryClient(Identity.from_name(f"bench-cli-{i}"),
                                     TcpTransport())
             details = await client.request_provider(
@@ -243,7 +247,8 @@ def run_e2e_client_worker() -> int:
 
         sessions_up = [0]
         all_connected = asyncio.Event()
-        tasks = [asyncio.ensure_future(one_client(i)) for i in indices]
+        tasks = [asyncio.ensure_future(one_client(i, prompts[k]))
+                 for k, i in enumerate(indices)]
         await asyncio.wait_for(all_connected.wait(), timeout=120)
         print(f"READY {len(indices)}", flush=True)
         loop = asyncio.get_running_loop()
@@ -262,7 +267,9 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             prompt_chars: int, max_seq: int, dtype_name: str, block: int,
             quant: str | None, kv_quant: bool, bucket: int,
             stagger_s: float = 0.0, max_queue: int | None = None,
-            max_ttft_s: float | None = None, client_procs: int = 1) -> dict:
+            max_ttft_s: float | None = None, client_procs: int = 1,
+            shared_prefix: bool = False,
+            prefix_cache_mb: float | None = None) -> dict:
     """The NORTH-STAR measurement (BASELINE.json metric): aggregate WIRE
     tok/s and p50/p99 TTFT through the full serving path — server +
     tpu_native provider + N concurrent streaming clients over TCP
@@ -323,6 +330,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                    else {}),
                 **({"max_ttft_s": max_ttft_s} if max_ttft_s is not None
                    else {}),
+                **({"prefix_cache_mb": prefix_cache_mb}
+                   if prefix_cache_mb else {}),
             },
         }
         # Provider log is ALWAYS captured (round-3 verdict #1: a 6-line
@@ -339,7 +348,41 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
         log_fh = open(log_path, "w")
 
 
-        prompt = "x" * prompt_chars
+        prompts = ["x" * prompt_chars] * clients
+        wave_a_prompts = wave_b_prompts = None
+        if shared_prefix:
+            # Shared-prefix workload: wave A is the UNCACHED comparison
+            # (every client's preamble is unique from its first token, so
+            # every admission is a full-prefill miss that churns the LRU),
+            # wave B is the CACHED path (one shared preamble; the first
+            # dispatch populates the store, everyone after hits). Both
+            # waves have identical prompt shapes and arrival patterns, so
+            # the TTFT delta between them is the prefix cache's doing.
+            # The preamble is sized so the shared portion ends exactly at
+            # a prefix-align boundary (min(prefill_chunk=256, bucket) —
+            # mirrors engine.prefix_align) and the unique tail fits one
+            # suffix dispatch.
+            align = min(256, bucket)
+            shared_tok = align * max(1, (bucket * 3 // 4) // align)
+            # ByteTokenizer chat template wraps content as BOS + "user: "
+            # (7 ids, part of the SHARED prefix) … "\nassistant: " (12
+            # trailing ids that count against the tail room).
+            shared_chars = shared_tok - 7
+            tail_room = bucket - shared_tok - 12
+
+            def tail(i: int) -> str:
+                return f" client {i:04d} asks question {i:04d}."
+
+            if shared_chars < 8 or tail_room < len(tail(0)):
+                raise RuntimeError(
+                    f"--prompt-len {bucket} too small for shared-prefix "
+                    f"mode (needs room for an aligned preamble + tail + "
+                    f"chat template)")
+
+            wave_a_prompts = [f"{i:05d}" + "u" * (shared_chars - 5)
+                              + tail(i) for i in range(clients)]
+            wave_b_prompts = ["s" * shared_chars + tail(i)
+                              for i in range(clients)]
         # All sessions handshake BEFORE any chat is sent (barrier below):
         # the burst then measures the SERVING path against truly
         # simultaneous arrivals — the worst case for admission — instead
@@ -351,7 +394,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
         all_connected = asyncio.Event()
         connected = 0
 
-        async def run_sharded_fleet() -> tuple[list, float, float]:
+        async def run_sharded_fleet(fleet_prompts: list[str]
+                                    ) -> tuple[list, float, float]:
             """The client fleet split over `client_procs` OS processes
             (run_e2e_client_worker), so the measured tails are the
             SERVICE's, not the client event loop's. Returns (results, t0,
@@ -372,7 +416,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                     spec = {"server_address": server.address,
                             "server_key_hex": server_ident.public_hex,
                             "model_name": model_name, "indices": shard,
-                            "prompt": prompt, "max_new": max_new,
+                            "prompts": [fleet_prompts[i] for i in shard],
+                            "max_new": max_new,
                             "stagger_s": stagger_s}
                     p.stdin.write((json.dumps(spec) + "\n").encode())
                     await p.stdin.drain()
@@ -435,7 +480,7 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             stamps: list[tuple[float, int]] = []  # (arrival, chars)
             try:
                 async for delta in session.chat(
-                        [{"role": "user", "content": prompt}],
+                        [{"role": "user", "content": prompts[i]}],
                         max_tokens=max_new, temperature=0.7, seed=i):
                     now = _time.perf_counter()
                     if t_first is None and delta:
@@ -474,8 +519,49 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 print(f"[bench] provider registered after {startup_s:.0f}s "
                       f"(weight init + XLA compile + warmup; excluded from "
                       f"the measured window)", file=sys.stderr)
-                if client_procs > 1:
-                    results, t0, elapsed = await run_sharded_fleet()
+                async def fetch_prefix_counters() -> dict | None:
+                    """One stats round-trip, prefix-cache block only."""
+                    try:
+                        c = SymmetryClient(
+                            Identity.from_name("bench-stats-mid"),
+                            TcpTransport())
+                        details = await c.request_provider(
+                            server.address, server_ident.public_key,
+                            model_name)
+                        s = await c.connect(details)
+                        try:
+                            stats = await s.stats()
+                        finally:
+                            await s.close()
+                        return (stats.get("engine") or {}).get(
+                            "prefix_cache")
+                    except Exception as exc:  # noqa: BLE001 — diag only
+                        print(f"[bench] mid-run stats fetch failed: "
+                              f"{exc!r}", file=sys.stderr)
+                        return None
+
+                results_uncached = None
+                pc_after_wave_a = None
+                if shared_prefix:
+                    # Wave A (unique preambles — all misses) runs to
+                    # completion, then wave B (shared preamble — hits
+                    # after the first dispatch) on the SAME provider.
+                    # Headline metrics come from the cached wave; wave A
+                    # supplies the same-run uncached comparison. The
+                    # prefix counters are SNAPSHOTTED between waves so
+                    # the reported cached-wave hit rate is wave B's
+                    # delta, not diluted by wave A's intentional misses.
+                    print("[bench] shared-prefix wave A (uncached, unique "
+                          "preambles)", file=sys.stderr)
+                    results_uncached, _t0a, _el_a = await run_sharded_fleet(
+                        wave_a_prompts)
+                    pc_after_wave_a = await fetch_prefix_counters()
+                    print("[bench] shared-prefix wave B (cached, shared "
+                          "preamble)", file=sys.stderr)
+                    results, t0, elapsed = await run_sharded_fleet(
+                        wave_b_prompts)
+                elif client_procs > 1:
+                    results, t0, elapsed = await run_sharded_fleet(prompts)
                 else:
                     tasks = [asyncio.ensure_future(one_client(i))
                              for i in range(clients)]
@@ -676,6 +762,38 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 f"{diag['block_interval_p99_s']}s over "
                 f"{diag['block_syncs']} blocks",
                 file=sys.stderr)
+            # Per-stage TTFT attribution (round-4 task #3): where the
+            # time between client send and first delta actually went —
+            # submit (provider→pipe), pipe_in (pipe + host tokenize),
+            # queue (scheduler inbox), prefill (placement→first token),
+            # emit (block-flush hold), relay (pipe out + provider loop).
+            stages = engine_stats.get("stages") or {}
+            if stages:
+                order = ("submit", "pipe_in", "queue", "prefill",
+                         "emit", "relay")
+                diag["stage_p50_s"] = {
+                    k: _rnd((stages.get(k) or {}).get("p50"))
+                    for k in order if k in stages}
+                diag["stage_p99_s"] = {
+                    k: _rnd((stages.get(k) or {}).get("p99"))
+                    for k in order if k in stages}
+                print("[bench] ttft stages p50 (s): "
+                      + " | ".join(f"{k} {diag['stage_p50_s'][k]}"
+                                   for k in order
+                                   if k in diag["stage_p50_s"]),
+                      file=sys.stderr)
+            # Shared-prefix KV cache counters (host stats → provider
+            # stats → here): hit rate, reuse volume, eviction churn.
+            pc = engine_stats.get("prefix_cache")
+            if pc:
+                diag["prefix_cache"] = pc
+                print(f"[bench] prefix cache: hit rate {pc.get('hit_rate')} "
+                      f"({pc.get('hits')} hits / {pc.get('misses')} misses)"
+                      f" | {pc.get('tokens_reused')} prefill tokens reused"
+                      f" | {pc.get('insertions')} stored, "
+                      f"{pc.get('evictions')} evicted, "
+                      f"{pc.get('bytes')} / {pc.get('budget_bytes')} bytes",
+                      file=sys.stderr)
             # The attribution that mattered in round 3: wire TTFT far above
             # engine TTFT means the stall is relay/wire/client-loop, not
             # admission.
@@ -695,11 +813,47 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
         except OSError:
             pass
 
+        shared_block = None
+        if shared_prefix and results_uncached is not None:
+            ok_a = [r for r in results_uncached if not r.get("rejected")]
+            ta = sorted(r["ttft"] for r in ok_a)
+            shared_block = {
+                "uncached_admitted": len(ok_a),
+                "ttft_p50_uncached_s": (round(pct(ta, 0.50), 3)
+                                        if ta else None),
+                "ttft_p99_uncached_s": (round(pct(ta, 0.99), 3)
+                                        if ta else None),
+                "ttft_p50_cached_s": round(pct(ttfts, 0.50), 3),
+                "ttft_p99_cached_s": round(pct(ttfts, 0.99), 3),
+            }
+            pc_end = diag.get("prefix_cache")
+            if pc_end:
+                # Wave-B delta: cumulative counters minus the between-
+                # waves snapshot, so the quoted hit rate is the cached
+                # wave's own, undiluted by wave A's intentional misses.
+                base = pc_after_wave_a or {}
+                d_hits = pc_end.get("hits", 0) - base.get("hits", 0)
+                d_miss = pc_end.get("misses", 0) - base.get("misses", 0)
+                shared_block["cached_wave_hits"] = d_hits
+                shared_block["cached_wave_misses"] = d_miss
+                shared_block["hit_rate"] = (
+                    round(d_hits / (d_hits + d_miss), 4)
+                    if d_hits + d_miss else None)
+            if ta:
+                print(f"[bench] shared-prefix: TTFT p50 uncached "
+                      f"{shared_block['ttft_p50_uncached_s']}s → cached "
+                      f"{shared_block['ttft_p50_cached_s']}s (p99 "
+                      f"{shared_block['ttft_p99_uncached_s']} → "
+                      f"{shared_block['ttft_p99_cached_s']})",
+                      file=sys.stderr)
+
         return {
             "metric": f"e2e serving tok/s ({preset_name} {dtype_label}, "
                       f"{clients} streaming clients over TCP"
                       + (f" @ {stagger_s}s stagger" if stagger_s else
                          " (burst)")
+                      + (", shared-prefix cached wave" if shared_prefix
+                         else "")
                       + f", {max_new} tok/req, {slots} slots, block {block}, "
                         f"provider subprocess, 1 tpu dev)",
             "value": round(tok_s, 1),
@@ -721,6 +875,7 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             **({"admitted": len(results), "rejected": len(rejected),
                 "reject_p99_s": round(pct(rj, 0.99), 3)}
                if rejected else {}),
+            **({"shared_prefix": shared_block} if shared_block else {}),
             **({"engine": diag} if diag else {}),
         }
 
@@ -854,11 +1009,27 @@ def main() -> None:
                          "in-repo fake-Ollama SSE server (no TPU)")
     ap.add_argument("--proxy-delay", type=float, default=0.0,
                     help="fake backend's per-chunk delay seconds (--proxy)")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="shared-prefix workload (--e2e): wave A of "
+                         "unique-preamble prompts (uncached), then wave B "
+                         "sharing one long preamble — the prefix KV cache "
+                         "serves wave B's admissions from cached KV and "
+                         "the run reports cached vs uncached TTFT on the "
+                         "same provider (tpu.prefix_cache_mb)")
+    ap.add_argument("--prefix-cache-mb", type=float, default=None,
+                    help="shared-prefix KV cache HBM budget in MiB "
+                         "(tpu.prefix_cache_mb). Default: 128 in "
+                         "--shared-prefix mode, disabled otherwise")
     ap.add_argument("--preset", default="llama3-8b")
-    ap.add_argument("--slots", type=int, default=128)
+    ap.add_argument("--slots", type=int, default=None,
+                    help="decode slots (default 128; 96 in shared-prefix "
+                         "mode — the larger prompt bucket plus the cache "
+                         "budget must leave the ~95%%-full default HBM "
+                         "point some slack)")
     ap.add_argument("--steps", type=int, default=192)
-    ap.add_argument("--clients", type=int, default=128,
-                    help="concurrent streaming clients (--e2e)")
+    ap.add_argument("--clients", type=int, default=None,
+                    help="concurrent streaming clients (--e2e; default "
+                         "128, 96 in shared-prefix mode)")
     ap.add_argument("--stagger", type=float, default=0.0,
                     help="seconds between client arrivals (--e2e); 0 = "
                          "thundering-herd burst, the worst-case TTFT")
@@ -869,7 +1040,10 @@ def main() -> None:
                          "serving throughput rather than mostly ramp "
                          "(round-3 verdict #1); 480 exactly fills the 640 "
                          "capacity with the 128 bucket + 2 lookahead blocks")
-    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--prompt-len", type=int, default=None,
+                    help="prefill bucket / prompt size (default 128; 384 "
+                         "in shared-prefix mode so the shared preamble "
+                         "spans a full 256-token alignment boundary)")
     ap.add_argument("--max-seq", type=int, default=None,
                     help="KV capacity per slot. Default 640 = 128-token "
                          "bucket + 480 new tokens + 2 lookahead blocks "
@@ -913,6 +1087,19 @@ def main() -> None:
     args = ap.parse_args()
     if args.e2e_client_worker:
         return run_e2e_client_worker()
+    # Per-mode defaults: the shared-prefix workload needs a bucket that
+    # spans an alignment boundary plus slack for the cache budget, so its
+    # defaults trade a few slots for the bigger bucket; everything else
+    # keeps the BENCH_r05-comparable point.
+    if args.clients is None:
+        args.clients = 96 if args.shared_prefix else 128
+    if args.slots is None:
+        args.slots = 96 if args.shared_prefix else 128
+    user_prompt_len = args.prompt_len
+    if args.prompt_len is None:
+        args.prompt_len = 384 if args.shared_prefix else 128
+    if args.shared_prefix and args.prefix_cache_mb is None:
+        args.prefix_cache_mb = 128.0
     if args.client_procs is None:
         args.client_procs = 8 if args.clients >= 64 else 1
     user_block = args.block
@@ -921,13 +1108,16 @@ def main() -> None:
     # Track whether the caller sized the run explicitly: the e2e failure
     # ladder only swaps in its conservative point for DEFAULT-sized runs
     # (prompt-len and block participate — the retry point's capacity
-    # arithmetic assumes the default 128-token bucket and block 16).
+    # arithmetic assumes the default 128-token bucket and block 16;
+    # shared-prefix mode always counts as sized — its retry point would
+    # not fit the preamble).
     user_sized = (args.max_seq is not None or args.max_new is not None
-                  or args.prompt_len != 128 or user_block is not None)
+                  or user_prompt_len is not None or user_block is not None
+                  or args.shared_prefix)
     if args.max_seq is None:
         args.max_seq = 640
     if args.max_new is None:
-        args.max_new = 480
+        args.max_new = 192 if args.shared_prefix else 480
 
     def engine_bench() -> dict:
         # engine numbers are recorded at block 64; when the user didn't
@@ -977,7 +1167,9 @@ def main() -> None:
                 quant=None if args.quant == "none" else args.quant,
                 kv_quant=args.kv_quant == "int8", bucket=args.prompt_len,
                 stagger_s=args.stagger, max_queue=args.max_queue,
-                max_ttft_s=args.max_ttft, client_procs=args.client_procs)
+                max_ttft_s=args.max_ttft, client_procs=args.client_procs,
+                shared_prefix=args.shared_prefix,
+                prefix_cache_mb=args.prefix_cache_mb)
 
         try:
             result = e2e_attempt(args.max_seq, args.max_new)

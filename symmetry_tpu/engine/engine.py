@@ -44,6 +44,7 @@ from symmetry_tpu.models.llama import (
 from symmetry_tpu.ops.sampling import sample_tokens
 from symmetry_tpu.parallel.mesh import MeshSpec, build_mesh
 from symmetry_tpu.parallel.sharding import shardings_for
+from symmetry_tpu.engine.prefix_cache import PrefixHit, PrefixStore
 from symmetry_tpu.engine.tokenizer import Tokenizer, get_tokenizer
 
 
@@ -98,11 +99,16 @@ class ChunkedPrefill:
     """An in-progress chunked prefill: one prompt's KV prefix being built
     chunk-by-chunk so long-prompt admission never stalls active decode
     streams for more than ~one chunk (round-2 verdict: a 2048-bucket
-    prefill froze every stream for ~0.6 s)."""
+    prefill froze every stream for ~0.6 s).
+
+    With `start_pos` > 0 the cache was SEEDED from a prefix-cache entry
+    (the first start_pos positions already hold that prefix's KV) and
+    `ids` carries only the uncached suffix — the chunk loop then covers
+    suffix tokens only."""
 
     slot: int
-    ids: np.ndarray           # [1, n_chunks * C] padded prompt
-    true_len: int
+    ids: np.ndarray           # [1, n_chunks * C] padded suffix tokens
+    true_len: int             # FULL prompt length (prefix + suffix)
     n_chunks: int
     cache: Any                # batch-1 prefix KVCache (bucket capacity)
     temp: jnp.ndarray         # [1]
@@ -111,10 +117,16 @@ class ChunkedPrefill:
     prefill_key: jax.Array    # [1] PRNG for the first-token sample
     decode_key: jax.Array     # [1] PRNG stream carried into decode
     done_chunks: int = 0
+    start_pos: int = 0        # tokens already in the cache at start
+    full_ids: tuple[int, ...] = ()  # the whole prompt (prefix-store key)
 
     @property
     def remaining_chunks(self) -> int:
         return self.n_chunks - self.done_chunks
+
+    @property
+    def suffix_len(self) -> int:
+        return self.true_len - self.start_pos
 
 
 class InferenceEngine:
@@ -140,6 +152,7 @@ class InferenceEngine:
         pipeline_microbatches: int = 1,
         prefill_chunk: int | None = 256,
         prefill_token_budget: int | None = None,
+        prefix_cache_bytes: int = 0,
     ) -> None:
         self.config = config
         self.params = params
@@ -234,6 +247,21 @@ class InferenceEngine:
         # (batch, bucket) -> persistent donated prefix buffer; see
         # _prefill_scratch_for.
         self._prefill_scratch: dict[tuple[int, int], Any] = {}
+
+        # Shared-prefix KV cache (prefix_cache.py): boundaries align to
+        # min(prefill_chunk, smallest bucket) so (a) every hit's suffix
+        # fits the ONE compiled continuation shape per (batch, bucket)
+        # and (b) prompts at the smallest bucket can still hit. Off by
+        # default (budget 0): the default serving path then performs
+        # literally zero extra work — no lookups, no store dispatches,
+        # no extra warmup compiles.
+        self.prefix_align = (min(self.prefill_chunk, self.prefill_buckets[0])
+                             if self.prefill_chunk is not None else None)
+        if prefix_cache_bytes > 0 and self.prefix_align:
+            self.prefix_store: PrefixStore | None = PrefixStore(
+                budget_bytes=prefix_cache_bytes, align=self.prefix_align)
+        else:
+            self.prefix_store = None
 
         self._build_jits()
 
@@ -349,6 +377,53 @@ class InferenceEngine:
 
             return jax.lax.fori_loop(0, slots.shape[0], body, state)
 
+        def insert_from_prefix(scratch: KVCache, src: KVCache, p):
+            """Seed a donated (batch, bucket) working prefix buffer from a
+            stored batch-1 prefix-cache entry: every row's first positions
+            become the entry's KV and lengths become `p` (the aligned
+            prefix length in use — may be SHORTER than the entry, which
+            is sound because KV at position i depends only on tokens
+            <= i). Capacities may differ in either direction; the copy
+            covers min(entry, scratch) positions and only the first p
+            are ever attended. The suffix continuation (chunk_step/
+            chunk_final) then runs from these lengths exactly like a
+            chunked prefill that had already built p tokens."""
+
+            def place(big, small, t_axis):
+                width = min(big.shape[t_axis], small.shape[t_axis])
+                sl = jax.lax.slice_in_dim(small, 0, width, axis=t_axis)
+                tiled = jnp.broadcast_to(
+                    sl, sl.shape[:1] + (big.shape[1],) + sl.shape[2:])
+                return jax.lax.dynamic_update_slice(
+                    big, tiled.astype(big.dtype), (0,) * big.ndim)
+
+            return scratch._replace(
+                k=place(scratch.k, src.k, 2),
+                v=place(scratch.v, src.v, 2),
+                lengths=jnp.full_like(scratch.lengths, p),
+                **({"k_scale": place(scratch.k_scale, src.k_scale, 3),
+                    "v_scale": place(scratch.v_scale, src.v_scale, 3)}
+                   if self.kv_quant else {}),
+            )
+
+        def extract_prefix_row(prefix: KVCache, row, p):
+            """Copy row `row` of a batch-N prefill buffer into a FRESH
+            batch-1 buffer (the prefix-cache entry) valid through `p`
+            tokens. No donation: the output is the newly-allocated entry
+            and the source scratch stays pooled."""
+
+            def take(arr):
+                sizes = (arr.shape[0], 1) + arr.shape[2:]
+                start = (0, row) + (0,) * (arr.ndim - 2)
+                return jax.lax.dynamic_slice(arr, start, sizes)
+
+            return KVCache(
+                k=take(prefix.k), v=take(prefix.v),
+                lengths=jnp.full((1,), p, jnp.int32),
+                k_scale=take(prefix.k_scale) if self.kv_quant else None,
+                v_scale=take(prefix.v_scale) if self.kv_quant else None,
+            )
+
         def chunk_step(params, tokens, cache, seq_len):
             """Extend a batch-1 prefix cache by one prompt chunk. Attention
             runs the continuation path (absolute-position masking against
@@ -424,11 +499,19 @@ class InferenceEngine:
                                        out_shardings=prefix_shard)
             self._chunk_final = jax.jit(chunk_final, donate_argnums=(2,),
                                         out_shardings=(rep, prefix_shard))
+            self._insert_from_prefix = jax.jit(
+                insert_from_prefix, donate_argnums=(0,),
+                out_shardings=prefix_shard)
+            self._extract_prefix_row = jax.jit(
+                extract_prefix_row, out_shardings=prefix_shard)
         else:
             self._prefill = jax.jit(prefill, donate_argnums=(7,))
             self._decode = jax.jit(decode_block, donate_argnums=(1,))
             self._chunk_step = jax.jit(chunk_step, donate_argnums=(2,))
             self._chunk_final = jax.jit(chunk_final, donate_argnums=(2,))
+            self._insert_from_prefix = jax.jit(insert_from_prefix,
+                                               donate_argnums=(0,))
+            self._extract_prefix_row = jax.jit(extract_prefix_row)
         self._insert_all = jax.jit(
             insert_all, donate_argnums=(0,),
             out_shardings=state_shard)
@@ -466,6 +549,20 @@ class InferenceEngine:
         return tuple(b for b in self.PREFILL_BATCHES
                      if b * bucket <= budget
                      and (b == 1 or b <= self.max_slots))
+
+    def _request_keys(self, sampling: SamplingParams) -> tuple[Any, Any]:
+        """(prefill key, decode key) for one request: seeded requests
+        reproduce their whole completion; unseeded ones get per-request
+        entropy. ONE derivation shared by every admission path, so a
+        seeded request samples identically whether it was admitted via
+        full prefill, chunked prefill, or a prefix-cache hit."""
+        if sampling.seed is not None:
+            key = jax.random.key(sampling.seed)
+        else:
+            self._requests_served += 1
+            key = jax.random.fold_in(self._base_key, self._requests_served)
+        pk, dk = jax.random.split(key)
+        return pk, dk
 
     def prefill_and_insert(self, slot: int, prompt_ids: list[int],
                            sampling: SamplingParams) -> int:
@@ -523,15 +620,7 @@ class InferenceEngine:
                 prefill_keys.append(prefill_keys[n_req - 1])
                 decode_keys.append(decode_keys[n_req - 1])
                 continue
-            if sampling.seed is not None:
-                key = jax.random.key(sampling.seed)
-            else:
-                # Per-request entropy: a fixed per-slot key would make the
-                # same unseeded prompt sample identically every time.
-                self._requests_served += 1
-                key = jax.random.fold_in(self._base_key,
-                                         self._requests_served)
-            pk, dk = jax.random.split(key)
+            pk, dk = self._request_keys(sampling)
             prefill_keys.append(pk)
             decode_keys.append(dk)
 
@@ -549,12 +638,151 @@ class InferenceEngine:
         self.state = self._insert_all(
             self.state, prefix, jnp.asarray(slots_arr), lens_arr,
             toks, temps_arr, top_ps_arr, top_ks_arr, decode_keys_arr)
+        # Populate the prefix cache from this batch BEFORE the buffer goes
+        # back to the pool (the extract reads it; the next same-shape
+        # prefill would overwrite it).
+        if self.prefix_store is not None:
+            self.prefix_store.note_miss(n_req)  # admitted uncached
+            self._maybe_store_prefix(assignments[:n_req], prefix)
         # insert_all READS prefix (no donation): the buffer is free for
         # the next same-shape prefill the moment the insert executes —
         # device-order sequencing makes immediate reuse safe.
         self._store_prefill_scratch(batch, bucket, prefix)
         host_toks = np.asarray(toks)
         return [int(host_toks[i]) for i in range(n_req)]
+
+    # ------------------------------------------------------------------
+    # Shared-prefix KV cache (engine side; bookkeeping in prefix_cache.py)
+
+    def prefix_lookup(self, prompt_ids: list[int]) -> PrefixHit | None:
+        """Pinned longest-aligned-prefix hit for this prompt, or None.
+        The scheduler partitions admission groups by the hit identity
+        (hit/miss requests become separate dispatch units) and must
+        release() hits it ends up not dispatching; the engine releases
+        hits it consumes."""
+        if self.prefix_store is None:
+            return None
+        return self.prefix_store.lookup(prompt_ids)
+
+    def seeded_chunk_ok(self, prompt_len: int) -> bool:
+        """True when a LONG-suffix hit (suffix > prefix_align) can run as
+        a seeded chunked prefill: the chunk programs for this prompt's
+        bucket exist only when the bucket exceeds one chunk (warmup
+        compiles exactly that set). Otherwise the hit must fall back to a
+        plain full prefill — never a mid-traffic XLA compile."""
+        return (self.prefill_chunk is not None
+                and self.bucket_for(prompt_len) > self.prefill_chunk)
+
+    def prefill_and_insert_cached(
+        self, assignments: list[tuple[int, list[int], SamplingParams]],
+        hit: PrefixHit,
+    ) -> list[int]:
+        """Admit a group of requests that SHARE a cached prefix: one seed
+        copy broadcasts the entry into every row of the (batch, bucket)
+        working buffer, one continuation dispatch prefills only the
+        uncached suffixes (<= prefix_align tokens each, the compiled
+        suffix shape) and samples first tokens, one insert installs every
+        slot — three dispatches for the whole group regardless of how
+        long the shared prefix is. Releases `hit` in all paths."""
+        try:
+            if not assignments:
+                return []
+            p = hit.length
+            A = self.prefix_align
+            n_req = len(assignments)
+            bucket = max(self.bucket_for(len(ids))
+                         for _, ids, _ in assignments)
+            allowed = self.prefill_batches_for(bucket)
+            if n_req > allowed[-1]:
+                raise EngineError(
+                    f"cached-prefill group of {n_req} exceeds the bucket's "
+                    f"batch cap {allowed[-1]} (scheduler partitions to cap)")
+            for _, ids, _ in assignments:
+                if not p < len(ids) <= p + A:
+                    raise EngineError(
+                        f"cached-prefill suffix out of range: prompt "
+                        f"{len(ids)} vs prefix {p} (suffix cap {A})")
+                if tuple(ids[:p]) != hit.entry.tokens[:p]:
+                    raise EngineError("prompt diverges from cached prefix")
+            batch = next(b for b in allowed if b >= n_req)
+
+            suffix = np.zeros((batch, A), np.int32)
+            sfx_lens = np.zeros((batch,), np.int32)
+            full_lens = np.zeros((batch,), np.int32)
+            temps = np.zeros((batch,), np.float32)
+            top_ps = np.ones((batch,), np.float32)
+            top_ks = np.zeros((batch,), np.int32)
+            slots_arr = np.zeros((batch,), np.int32)
+            prefill_keys, decode_keys = [], []
+            for i in range(batch):
+                # Pad rows replay the last request bit-identically (same
+                # suffix, slot, and keys) — same contract as the full
+                # prefill path: every row is inserted, so a pad row must
+                # be an exact overwrite of the last real slot.
+                slot, ids, sampling = assignments[min(i, n_req - 1)]
+                sfx = ids[p:]
+                suffix[i, :len(sfx)] = sfx
+                sfx_lens[i] = len(sfx)
+                full_lens[i] = len(ids)
+                temps[i] = sampling.temperature
+                top_ps[i] = sampling.top_p
+                top_ks[i] = sampling.top_k
+                slots_arr[i] = slot
+                if i >= n_req:
+                    prefill_keys.append(prefill_keys[n_req - 1])
+                    decode_keys.append(decode_keys[n_req - 1])
+                    continue
+                pk, dk = self._request_keys(sampling)
+                prefill_keys.append(pk)
+                decode_keys.append(dk)
+
+            scratch = self._prefill_scratch_for(batch, bucket)
+            scratch = self._insert_from_prefix(scratch, hit.entry.cache,
+                                               jnp.int32(p))
+            # The copy out of the entry is dispatched (its buffer is held
+            # by the runtime until it executes): safe to unpin now.
+            hit.release()
+            sfx_arr = jnp.asarray(sfx_lens)
+            temps_arr = jnp.asarray(temps)
+            top_ps_arr = jnp.asarray(top_ps)
+            top_ks_arr = jnp.asarray(top_ks)
+            decode_keys_arr = jnp.stack(decode_keys)
+            toks, prefix = self._chunk_final(
+                self.params, jnp.asarray(suffix), scratch, sfx_arr,
+                sfx_arr - 1, temps_arr, top_ps_arr, top_ks_arr,
+                jnp.stack(prefill_keys))
+            self.state = self._insert_all(
+                self.state, prefix, jnp.asarray(slots_arr),
+                jnp.asarray(full_lens), toks, temps_arr, top_ps_arr,
+                top_ks_arr, decode_keys_arr)
+            self._store_prefill_scratch(batch, bucket, prefix)
+            self.prefix_store.note_reuse(n_req, p)
+            host_toks = np.asarray(toks)
+            return [int(host_toks[i]) for i in range(n_req)]
+        finally:
+            hit.release()
+
+    def _maybe_store_prefix(self, assignments, prefix) -> None:
+        """Adopt ONE newly-built prefix from a prefill batch into the
+        store (at most one extract dispatch per admission dispatch, so
+        cache population cannot balloon admission latency). The entry is
+        the first row whose aligned prefix is new; unique-prompt traffic
+        churns through LRU eviction, shared-prefix traffic converges
+        after a single store."""
+        A = self.prefix_align
+        for row, (_slot, ids, _sampling) in enumerate(assignments):
+            p = A * (len(ids) // A)
+            if p < A or self.prefix_store.has(ids[:p]):
+                continue
+            entry_cache = self._extract_prefix_row(prefix, jnp.int32(row),
+                                                   jnp.int32(p))
+            nbytes = sum(x.nbytes for x in jax.tree.leaves(entry_cache))
+            self.prefix_store.insert(ids[:p], entry_cache, nbytes)
+            return
+
+    def prefix_cache_stats(self) -> dict | None:
+        return (self.prefix_store.stats()
+                if self.prefix_store is not None else None)
 
     # ------------------------------------------------------------------
     # Chunked prefill (long prompts, interleaved with decode blocks)
@@ -566,50 +794,73 @@ class InferenceEngine:
                 and prompt_len > self.prefill_chunk)
 
     def start_chunked_prefill(self, slot: int, prompt_ids: list[int],
-                              sampling: SamplingParams) -> ChunkedPrefill:
+                              sampling: SamplingParams,
+                              hit: PrefixHit | None = None) -> ChunkedPrefill:
         """Begin a chunked prefill for `slot`; drive it to completion with
-        advance_chunked_prefill (one device dispatch per call)."""
-        if not prompt_ids:
-            raise EngineError("empty prompt")
-        C = self.prefill_chunk
-        assert C is not None
-        true_len = len(prompt_ids)
-        bucket = self.bucket_for(true_len)  # validates length; cache size
-        n_chunks = -(-true_len // C)
-        padded = np.zeros((1, n_chunks * C), np.int32)
-        padded[0, :true_len] = prompt_ids
+        advance_chunked_prefill (one device dispatch per call). With a
+        prefix-cache `hit`, the cache is seeded from the cached entry and
+        the chunk loop covers only the uncached suffix (the long-suffix
+        hit path — suffixes <= prefix_align go through
+        prefill_and_insert_cached in one dispatch instead). The hit is
+        released here in all paths."""
+        try:
+            if not prompt_ids:
+                raise EngineError("empty prompt")
+            C = self.prefill_chunk
+            assert C is not None
+            true_len = len(prompt_ids)
+            bucket = self.bucket_for(true_len)  # validates length; cache size
+            start = 0
+            if hit is not None:
+                start = hit.length
+                if not 0 < start < true_len:
+                    raise EngineError("cached prefix does not fit prompt")
+                if tuple(prompt_ids[:start]) != hit.entry.tokens[:start]:
+                    raise EngineError("prompt diverges from cached prefix")
+            sfx_len = true_len - start
+            n_chunks = -(-sfx_len // C)
+            padded = np.zeros((1, n_chunks * C), np.int32)
+            padded[0, :sfx_len] = prompt_ids[start:]
 
-        if sampling.seed is not None:
-            key = jax.random.key(sampling.seed)
-        else:
-            self._requests_served += 1
-            key = jax.random.fold_in(self._base_key, self._requests_served)
-        pk, dk = jax.random.split(key)
+            pk, dk = self._request_keys(sampling)
 
-        cache = self._new_prefix_cache(bucket)
-        return ChunkedPrefill(
-            slot=slot, ids=padded, true_len=true_len, n_chunks=n_chunks,
-            cache=cache,
-            temp=jnp.asarray([sampling.temperature], jnp.float32),
-            top_p=jnp.asarray([sampling.top_p], jnp.float32),
-            top_k=jnp.asarray([sampling.top_k], jnp.int32),
-            prefill_key=pk[None], decode_key=dk[None],
-        )
+            cache = self._new_prefix_cache(bucket)
+            if hit is not None:
+                cache = self._insert_from_prefix(cache, hit.entry.cache,
+                                                 jnp.int32(start))
+                hit.release()  # copy dispatched; entry free to evict
+                self.prefix_store.note_reuse(1, start)
+            elif self.prefix_store is not None:
+                self.prefix_store.note_miss(1)  # admitted uncached
+            return ChunkedPrefill(
+                slot=slot, ids=padded, true_len=true_len, n_chunks=n_chunks,
+                cache=cache,
+                temp=jnp.asarray([sampling.temperature], jnp.float32),
+                top_p=jnp.asarray([sampling.top_p], jnp.float32),
+                top_k=jnp.asarray([sampling.top_k], jnp.int32),
+                prefill_key=pk[None], decode_key=dk[None],
+                start_pos=start, full_ids=tuple(prompt_ids),
+            )
+        finally:
+            if hit is not None:
+                hit.release()
 
     def advance_chunked_prefill(self, job: ChunkedPrefill) -> int | None:
         """Run ONE chunk; returns the first sampled token when the prompt
-        is complete (the slot is then live), else None."""
+        is complete (the slot is then live), else None. Chunk offsets are
+        relative to the SUFFIX the job carries — with a seeded start_pos
+        the cache lengths already position the writes past the prefix."""
         C = self.prefill_chunk
         c0 = job.done_chunks * C
         chunk = jnp.asarray(job.ids[:, c0:c0 + C])
-        valid = jnp.asarray([min(C, job.true_len - c0)], jnp.int32)
+        valid = jnp.asarray([min(C, job.suffix_len - c0)], jnp.int32)
         last = job.done_chunks == job.n_chunks - 1
         if not last:
             job.cache = self._chunk_step(self.params, chunk, job.cache,
                                          valid)
             job.done_chunks += 1
             return None
-        last_idx = jnp.asarray([job.true_len - 1 - c0], jnp.int32)
+        last_idx = jnp.asarray([job.suffix_len - 1 - c0], jnp.int32)
         toks, cache = self._chunk_final(
             self.params, chunk, job.cache, valid, last_idx,
             job.temp, job.top_p, job.top_k, job.prefill_key)
@@ -621,6 +872,16 @@ class InferenceEngine:
             self.state, cache, jnp.asarray([job.slot], jnp.int32),
             jnp.asarray([job.true_len], jnp.int32), toks,
             job.temp, job.top_p, job.top_k, job.decode_key)
+        # The finished buffer holds the FULL prompt's KV and would
+        # otherwise be dropped — adopt it into the prefix store for free
+        # (zero copy: insert_all only read it). Completed chunked
+        # prefills are exactly the long shared preambles worth caching.
+        if self.prefix_store is not None and job.full_ids:
+            A = self.prefix_align
+            p = A * (job.true_len // A)
+            if p >= A and not self.prefix_store.has(job.full_ids[:p]):
+                nbytes = sum(x.nbytes for x in jax.tree.leaves(cache))
+                self.prefix_store.insert(job.full_ids[:p], cache, nbytes)
         return int(np.asarray(toks)[0])
 
     def _new_prefix_cache(self, capacity: int, batch: int = 1):
@@ -757,6 +1018,44 @@ class InferenceEngine:
                     jnp.zeros((1,), jnp.int32),
                     jax.random.split(jax.random.key(0), 1))
                 # batch-1 insert at this bucket already compiled above
+
+        # Prefix-cache hit-path programs (only when the cache is on —
+        # budget 0 keeps warmup exactly as before): per (batch, bucket),
+        # the row extract (store path), the seed copy from an entry at
+        # EVERY possible entry capacity (entries keep the bucket they
+        # were built at, and a prefix built at one bucket may serve
+        # prompts in another), and the batched suffix continuation at the
+        # prefix_align shape. A hit burst mid-traffic must never pay a
+        # fresh XLA compile — the exact stall the cache exists to remove.
+        if self.prefix_store is not None:
+            A = self.prefix_align
+            entries = {}
+            for ts in self.prefill_buckets:
+                s = self._prefill_scratch_for(1, ts)
+                entries[ts] = self._extract_prefix_row(s, jnp.int32(0),
+                                                       jnp.int32(0))
+                self._store_prefill_scratch(1, ts, s)
+            for bucket in self.prefill_buckets:
+                for batch in self.prefill_batches_for(bucket):
+                    scratch = self._prefill_scratch_for(batch, bucket)
+                    self._extract_prefix_row(scratch, jnp.int32(0),
+                                             jnp.int32(0))
+                    for dummy in entries.values():
+                        scratch = self._insert_from_prefix(scratch, dummy,
+                                                           jnp.int32(0))
+                    toks, prefix = self._chunk_final(
+                        self.params, jnp.zeros((batch, A), jnp.int32),
+                        scratch, jnp.ones((batch,), jnp.int32),
+                        jnp.zeros((batch,), jnp.int32),
+                        jnp.zeros((batch,), jnp.float32),
+                        jnp.ones((batch,), jnp.float32),
+                        jnp.zeros((batch,), jnp.int32),
+                        jax.random.split(jax.random.key(0), batch))
+                    self._store_prefill_scratch(batch, bucket, prefix)
+                    # Sync so a marginal-HBM failure surfaces at startup,
+                    # not at the first hit burst (same rationale as the
+                    # concurrent-peak probe above).
+                    np.asarray(toks)
 
     def decode_steps_dispatch(self) -> jax.Array:
         """Dispatch one decode block WITHOUT syncing: returns the [K, B]
@@ -905,4 +1204,6 @@ class InferenceEngine:
             prefill_chunk=getattr(tpu_cfg, "prefill_chunk", 256),
             prefill_token_budget=getattr(tpu_cfg, "prefill_token_budget",
                                          None),
+            prefix_cache_bytes=int(
+                (getattr(tpu_cfg, "prefix_cache_mb", None) or 0) * 2**20),
         )

@@ -15,9 +15,15 @@ Protocol: JSON lines.
            {"op": "stats"} | {"op": "shutdown"}
   stdout → {"op": "ready", "model": …}            (after warmup)
            {"op": "event", "id", "text", "done", "finish_reason",
-            "error", "ttft_s", "tokens", "tokens_new"}
+            "error", "ttft_s", "tokens", "tokens_new",
+            "t": {"recv", "picked", "first", "out"}}   ("t" on the
+            FIRST event of a request only: per-stage CLOCK_MONOTONIC
+            stamps — host recv, placement pick, first sampled token,
+            pipe write — so the provider can attribute its TTFT)
            {"op": "events", "events": [{…event fields, no "op"…}, …]}
-           {"op": "stats", …}
+           {"op": "stats", …}   (scheduler counters incl. deferred_depth,
+            prefill_jobs_active, and the prefix_cache hit/miss/evict/
+            bytes block when the shared-prefix KV cache is enabled)
 
 The batched `events` frame is the hot path: the scheduler coalesces each
 decode block's per-slot deltas (plus any finishes and admission errors
@@ -45,6 +51,7 @@ from __future__ import annotations
 import json
 import sys
 import threading
+import time
 from typing import TYPE_CHECKING, Any
 
 from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
@@ -101,6 +108,15 @@ class EngineHost:
                                "tokens_new": new}
         if ev.ttft_s is not None:
             out["ttft_s"] = round(ev.ttft_s, 4)
+        if ev.stages:
+            # First event of the request: forward the scheduler's stage
+            # stamps and add the pipe-write moment, so the provider can
+            # attribute its TTFT per stage (host recv → pick → first
+            # token → pipe out; all CLOCK_MONOTONIC, one clock across
+            # processes on Linux).
+            out["t"] = {k: round(v, 4) for k, v in ev.stages.items()
+                        if v is not None}
+            out["t"]["out"] = round(time.monotonic(), 4)
         if ev.done:
             out["done"] = True
             out["finish_reason"] = ev.finish_reason

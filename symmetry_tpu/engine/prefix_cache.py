@@ -1,0 +1,262 @@
+"""Shared-prefix KV store: prefill each common prefix once, admit many.
+
+Realistic serving traffic overwhelmingly shares prompt prefixes — system
+prompts, few-shot preambles, multi-turn history — yet every request used
+to pay a full prefill. This module is the host-side bookkeeping for
+automatic prefix reuse (the engine owns the device work): an HBM-budgeted
+LRU of batch-1 prefix `KVCache` buffers, keyed by the token content of
+ALIGNED prompt prefixes, in the spirit of vLLM's automatic prefix caching
+and SGLang's RadixAttention but shaped for this engine's static-bucket
+world.
+
+Design points:
+
+  - Alignment. Prefixes are stored and matched only at multiples of the
+    engine's `prefix_align` (min(prefill_chunk, smallest bucket)): the
+    hit path runs the uncached suffix through ONE fixed-shape
+    continuation dispatch, so the suffix must fit a compiled shape. A
+    stored entry of aligned length P serves a hit at ANY aligned p <= P
+    — KV at position i depends only on tokens <= i (causal), so the
+    first p positions of a longer prefix ARE the shorter prefix's KV.
+    The index therefore maps every aligned boundary of every entry.
+
+  - Keys are digests of the prefix token bytes; a hit re-verifies the
+    actual tokens against the entry (collisions must produce a miss,
+    never silently wrong KV).
+
+  - Strictly-partial matches only: lookup never returns p == len(prompt).
+    The suffix (>= 1 token) is what produces the first sampled token —
+    the continuation dispatch projects the last valid position and
+    samples, so a "full" hit would still need a forward call; always
+    leaving >= 1 suffix token keeps one uniform hit path.
+
+  - Budget + LRU + pins. Entries are evicted least-recently-used when a
+    new insert would exceed the byte budget; an entry is PINNED from
+    lookup until the engine has dispatched the copy out of it, and
+    pinned entries are never evicted (the budget must not claim back HBM
+    that a copy in flight still reads).
+
+Thread contract: all mutating calls happen on the scheduler's engine
+thread (same as the engine itself). stats() may be read cross-thread —
+it snapshots plain ints under the GIL, same discipline as the
+scheduler's metrics dict.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any
+
+
+def _digest(token_bytes: bytes) -> bytes:
+    return hashlib.blake2b(token_bytes, digest_size=16).digest()
+
+
+@dataclass
+class PrefixEntry:
+    """One cached prefix: batch-1 KV buffer + the tokens it encodes."""
+
+    tokens: tuple[int, ...]   # the full stored prefix (aligned length)
+    cache: Any                # batch-1 KVCache, capacity = build bucket
+    nbytes: int
+    pins: int = 0
+
+    @property
+    def length(self) -> int:
+        return len(self.tokens)
+
+
+@dataclass
+class PrefixHit:
+    """A pinned lookup result: `entry.cache[:, :, :length]` is the KV of
+    `prompt[:length]`. Call release() once the copy out of the entry has
+    been dispatched (idempotent — safe to call from cleanup paths)."""
+
+    entry: PrefixEntry
+    length: int               # aligned tokens usable for THIS prompt
+    _store: "PrefixStore | None" = field(repr=False, default=None)
+    _released: bool = False
+
+    @property
+    def group_key(self) -> tuple[int, int]:
+        """Requests with equal group_key can share one seed dispatch."""
+        return (id(self.entry), self.length)
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._store._unpin(self.entry)
+
+
+class PrefixStore:
+    """LRU store of prefix KV entries under a byte budget."""
+
+    def __init__(self, budget_bytes: int, align: int) -> None:
+        if align < 1:
+            raise ValueError("prefix alignment must be >= 1")
+        self.budget_bytes = int(budget_bytes)
+        self.align = int(align)
+        # Full-prefix digest -> entry, most-recently-used LAST.
+        self._entries: "OrderedDict[bytes, PrefixEntry]" = OrderedDict()
+        # Boundary digest -> (entry key, boundary length). Several
+        # boundaries of one entry, and boundaries of DIFFERENT entries
+        # sharing a prefix, all land here; latest insert wins a contended
+        # boundary (both map to identical KV content, verified at hit).
+        self._index: dict[bytes, tuple[bytes, int]] = {}
+        self.stats_counters = {
+            "hits": 0, "misses": 0, "insertions": 0, "evictions": 0,
+            "rejected": 0, "tokens_reused": 0,
+        }
+        self._bytes = 0
+        # Count of entries with pins > 0, maintained incrementally: the
+        # stats() snapshot is read from the host's stdin thread while the
+        # engine thread mutates the store, so it must only copy plain
+        # ints — iterating _entries cross-thread could observe a
+        # mutation mid-iteration and kill the stats op.
+        self._pinned = 0
+
+    # ------------------------------------------------------------- queries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    def has(self, tokens: tuple[int, ...] | list[int]) -> bool:
+        """True when an entry already covers this EXACT aligned prefix
+        (used to skip redundant store dispatches)."""
+        key = _digest(self._token_bytes(tokens))
+        hit = self._index.get(key)
+        if hit is None:
+            return False
+        entry = self._entries.get(hit[0])
+        return (entry is not None
+                and entry.tokens[:len(tokens)] == tuple(tokens))
+
+    def lookup(self, prompt_ids: list[int]) -> PrefixHit | None:
+        """Longest aligned strict prefix of `prompt_ids` with cached KV,
+        pinned; None on miss. Does NOT touch the hit/miss counters: a
+        request may be looked up several times before it actually admits
+        (budget deferral re-resolves next block) or may fall back to a
+        full prefill despite a match (no compiled continuation shape) —
+        the engine counts per ADMITTED request via note_reuse/note_miss,
+        so hit_rate means 'fraction of admissions that reused cached
+        KV', the number the bench quotes."""
+        n = len(prompt_ids)
+        a = self.align
+        # Strictly below n: the suffix dispatch must sample >= 1 token.
+        for p in range(a * ((n - 1) // a), 0, -a):
+            key = _digest(self._token_bytes(prompt_ids[:p]))
+            ref = self._index.get(key)
+            if ref is None:
+                continue
+            entry = self._entries.get(ref[0])
+            if entry is None or entry.length < p:
+                continue
+            if entry.tokens[:p] != tuple(prompt_ids[:p]):
+                continue  # digest collision — must read as a miss
+            self._entries.move_to_end(ref[0])
+            self._pin(entry)
+            return PrefixHit(entry=entry, length=p, _store=self)
+        return None
+
+    # ------------------------------------------------------------ mutation
+
+    def insert(self, tokens: list[int] | tuple[int, ...], cache: Any,
+               nbytes: int) -> bool:
+        """Adopt `cache` (batch-1 KV whose first len(tokens) positions
+        encode `tokens`) under the budget; evicts LRU unpinned entries to
+        make room. Returns False (and drops the buffer ref) when the
+        prefix is already stored, misaligned, or cannot fit."""
+        tokens = tuple(tokens)
+        if not tokens or len(tokens) % self.align:
+            return False
+        if self.has(tokens):
+            return False
+        while (self._bytes + nbytes > self.budget_bytes
+               and self._evict_one()):
+            pass
+        if self._bytes + nbytes > self.budget_bytes:
+            self.stats_counters["rejected"] += 1
+            return False
+        entry = PrefixEntry(tokens=tokens, cache=cache, nbytes=int(nbytes))
+        key = _digest(self._token_bytes(tokens))
+        old = self._entries.pop(key, None)
+        if old is not None:  # same digest, different tokens (collision)
+            self._bytes -= old.nbytes
+        self._entries[key] = entry
+        self._bytes += entry.nbytes
+        for p in range(self.align, entry.length + 1, self.align):
+            self._index[_digest(self._token_bytes(tokens[:p]))] = (key, p)
+        self.stats_counters["insertions"] += 1
+        return True
+
+    def note_reuse(self, n_requests: int, prefix_len: int) -> None:
+        """Account `n_requests` ADMITTED via cached KV (one hit each)
+        and the prefill tokens their dispatch skipped."""
+        self.stats_counters["hits"] += n_requests
+        self.stats_counters["tokens_reused"] += n_requests * prefix_len
+
+    def note_miss(self, n_requests: int) -> None:
+        """Account `n_requests` admitted WITHOUT cached KV (full
+        prefill or unseeded chunked prefill)."""
+        self.stats_counters["misses"] += n_requests
+
+    def _pin(self, entry: PrefixEntry) -> None:
+        entry.pins += 1
+        if entry.pins == 1:
+            self._pinned += 1
+
+    def _unpin(self, entry: PrefixEntry) -> None:
+        entry.pins -= 1
+        if entry.pins == 0:
+            self._pinned -= 1
+
+    def _evict_one(self) -> bool:
+        """Drop the least-recently-used UNPINNED entry; False when every
+        entry is pinned (nothing safely evictable)."""
+        for key, entry in self._entries.items():
+            if entry.pins <= 0:
+                del self._entries[key]
+                self._bytes -= entry.nbytes
+                for p in range(self.align, entry.length + 1, self.align):
+                    bkey = _digest(self._token_bytes(entry.tokens[:p]))
+                    if self._index.get(bkey, (None,))[0] != key:
+                        continue
+                    # The evicted entry may have WON this boundary from
+                    # another resident entry sharing the prefix (latest
+                    # insert wins) — repair the index to any survivor
+                    # that still covers it, else a live prefix would
+                    # silently stop hitting until its own entry churned.
+                    del self._index[bkey]
+                    prefix = entry.tokens[:p]
+                    for okey, other in self._entries.items():
+                        if (other.length >= p
+                                and other.tokens[:p] == prefix):
+                            self._index[bkey] = (okey, p)
+                            break
+                self.stats_counters["evictions"] += 1
+                return True
+        return False
+
+    # --------------------------------------------------------------- misc
+
+    @staticmethod
+    def _token_bytes(tokens: list[int] | tuple[int, ...]) -> bytes:
+        import numpy as np
+
+        return np.asarray(tokens, dtype=np.int32).tobytes()
+
+    def stats(self) -> dict[str, Any]:
+        out: dict[str, Any] = dict(self.stats_counters)
+        out["entries"] = len(self._entries)
+        out["bytes"] = self._bytes
+        out["budget_bytes"] = self.budget_bytes
+        out["pinned"] = self._pinned
+        n = out["hits"] + out["misses"]
+        out["hit_rate"] = round(out["hits"] / n, 4) if n else 0.0
+        return out

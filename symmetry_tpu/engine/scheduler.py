@@ -48,6 +48,11 @@ class GenRequest:
     cancelled: Callable[[], bool] = lambda: False
     id: str = ""
     enqueued_at: float = field(default_factory=time.monotonic)
+    # Stamped when the request enters a placement group (the admission
+    # moment); re-stamped on re-pick after a budget deferral, so
+    # picked_at - enqueued_at is the true scheduler queue wait. Feeds the
+    # per-stage TTFT breakdown (TokenEvent.stages).
+    picked_at: float | None = None
 
 
 @dataclass(slots=True)
@@ -62,6 +67,13 @@ class TokenEvent:
     # serving metrics (SURVEY §5.1: TTFT and tok/s are first-class)
     ttft_s: float | None = None
     tokens_generated: int = 0
+    # Per-stage monotonic stamps, attached ONCE per request (its first
+    # event): {"recv": host received, "picked": entered a placement
+    # group, "first": first token sampled}. The host adds its pipe-write
+    # stamp and the provider closes the chain — the end-to-end TTFT
+    # attribution of round-4 task #3 (CLOCK_MONOTONIC is one clock
+    # across processes on Linux, same contract the bench workers use).
+    stages: dict | None = None
 
 
 @dataclass
@@ -71,6 +83,7 @@ class _ActiveSlot:
     generated: int = 0
     prompt_len: int = 0
     first_token_at: float | None = None
+    stages_sent: bool = False
 
 
 class Scheduler:
@@ -188,10 +201,21 @@ class Scheduler:
         """Counters + engine-side latency percentiles (host stats op)."""
         out: dict[str, Any] = dict(self.metrics)
         out["occupancy"] = len(self._slots)
-        out["deferred"] = len(self._deferred)
+        # Gauges for the two admission backlogs that were invisible in
+        # host→provider stats: the budget-deferred deque and the
+        # chunked-prefill jobs still building their prefixes.
+        out["deferred_depth"] = len(self._deferred)
+        out["prefill_jobs_active"] = len(self._prefill_jobs)
         out["engine_ttft_s"] = self._ttft_hist.to_dict()
         out["admit_dispatch_s"] = self._admit_hist.to_dict()
         out["block_interval_s"] = self._interval_hist.to_dict()
+        # Shared-prefix KV cache counters (hit/miss/evict/bytes) ride the
+        # same host stats op so they surface provider- and bench-side.
+        pc_stats = getattr(self.engine, "prefix_cache_stats", None)
+        if pc_stats is not None:
+            pc = pc_stats()
+            if pc is not None:
+                out["prefix_cache"] = pc
         return out
 
     # ------------------------------------------------------------- the loop
@@ -457,14 +481,48 @@ class Scheduler:
         # Requests the engine would reject (e.g. prompt beyond the largest
         # bucket) must fail individually, not poison the whole batch.
         wants_chunked = getattr(self.engine, "wants_chunked", None)
+        lookup = getattr(self.engine, "prefix_lookup", None)
+        align = getattr(self.engine, "prefix_align", None)
+        seeded_ok = getattr(self.engine, "seeded_chunk_ok", None)
+        now = time.monotonic()
         ready: list[tuple[int, GenRequest]] = []
+        # Prefix-cache hits partition into their OWN dispatch units keyed
+        # by (bucket, entry, prefix length): a hit unit admits through the
+        # engine's cached path (seed copy + suffix-only prefill) while
+        # miss units pay the full coalesced prefill — mixing them would
+        # force everyone onto the slower path.
+        hit_units: dict[tuple, tuple[Any, list[tuple[int, GenRequest]]]] = {}
         for slot, req in group:
+            req.picked_at = now
+            hit = None
             try:
                 if not req.prompt_ids:
                     raise ValueError("empty prompt")
-                self.engine.bucket_for(len(req.prompt_ids))
-                if wants_chunked is not None and wants_chunked(
-                        len(req.prompt_ids)):
+                n = len(req.prompt_ids)
+                bucket = self.engine.bucket_for(n)
+                hit = lookup(req.prompt_ids) if lookup is not None else None
+                if hit is not None:
+                    if n - hit.length <= align:
+                        # Short suffix: batched single-dispatch hit path.
+                        key = (bucket, hit.group_key)
+                        if key in hit_units:
+                            hit.release()  # one pinned handle per unit
+                            hit_units[key][1].append((slot, req))
+                        else:
+                            hit_units[key] = (hit, [(slot, req)])
+                        continue
+                    if seeded_ok is not None and seeded_ok(n):
+                        # Long suffix: chunked prefill seeded from the
+                        # cached prefix (the engine releases the hit).
+                        job = self.engine.start_chunked_prefill(
+                            slot, req.prompt_ids, req.sampling, hit=hit)
+                        hit = None
+                        self._prefill_jobs.append((job, req))
+                        continue
+                    # No compiled continuation shape fits — full prefill.
+                    hit.release()
+                    hit = None
+                if wants_chunked is not None and wants_chunked(n):
                     # Long prompt: build its prefix chunk-by-chunk between
                     # decode blocks instead of one monolithic dispatch.
                     job = self.engine.start_chunked_prefill(
@@ -472,13 +530,15 @@ class Scheduler:
                     self._prefill_jobs.append((job, req))
                     continue
             except Exception as exc:  # noqa: BLE001
+                if hit is not None:
+                    hit.release()
                 self._free.append(slot)
                 self._emit_cb(req, TokenEvent(
                     text="", token_id=None, done=True, finish_reason="error",
                     error=str(exc)))
                 continue
             ready.append((slot, req))
-        if not ready:
+        if not ready and not hit_units:
             return 0
         # Partition by prefill bucket: the engine dispatches one coalesced
         # prefill per bucket, and mixing a long prompt into a short-prompt
@@ -494,14 +554,31 @@ class Scheduler:
                 self.engine.bucket_for(len(req.prompt_ids)), []).append(
                     (slot, req))
         batches_for = getattr(self.engine, "prefill_batches_for", None)
-        units: list[list[tuple[int, GenRequest]]] = []
+        # Each unit: (subgroup, prefix hit or None), ordered by the
+        # EARLIEST arrival among its members — under a tight admission
+        # budget the unstarted tail of `units` defers to the next block,
+        # so any other order (e.g. cheapest-first) would let a sustained
+        # stream of late cache-hit arrivals starve an earlier deferred
+        # miss, the exact FIFO inversion the deferred deque exists to
+        # prevent.
+        arrival = {id(req): i for i, (_s, req) in enumerate(group)}
+        units: list[tuple[list[tuple[int, GenRequest]], Any]] = []
+        for bucket_key, (hit, subgroup) in hit_units.items():
+            cap = (max(batches_for(bucket_key[0]))
+                   if batches_for is not None else len(subgroup))
+            for start in range(0, len(subgroup), cap):
+                # Split units share one pinned handle; release() is
+                # idempotent and the handle's entry ref keeps the buffer
+                # alive for the later splits either way.
+                units.append((subgroup[start:start + cap], hit))
         for bucket, subgroup in by_bucket.items():
             cap = (max(batches_for(bucket)) if batches_for is not None
                    else len(subgroup))
             for start in range(0, len(subgroup), cap):
-                units.append(subgroup[start:start + cap])
+                units.append((subgroup[start:start + cap], None))
+        units.sort(key=lambda u: min(arrival[id(req)] for _s, req in u[0]))
         n_dispatches = 0
-        for unit_idx, sub in enumerate(units):
+        for unit_idx, (sub, hit) in enumerate(units):
             if (unit_idx > 0 and self._slots
                     and self._spent_this_block >= self._admit_budget_s):
                 # The shared per-block time budget ran out mid-group: a
@@ -514,14 +591,22 @@ class Scheduler:
                 # arrivals and invert FIFO order every deferral) — and
                 # let the next block pick them up. (unit_idx > 0
                 # guarantees forward progress: one dispatch always lands.)
-                for slot, req in (pair for u in units[unit_idx:]
-                                  for pair in u):
-                    self._free.append(slot)
-                    self._deferred.append(req)
+                # A deferred hit re-resolves through prefix_lookup next
+                # block, so its pinned handle is released now.
+                for d_sub, d_hit in units[unit_idx:]:
+                    if d_hit is not None:
+                        d_hit.release()
+                    for slot, req in d_sub:
+                        self._free.append(slot)
+                        self._deferred.append(req)
                 break
             t0 = time.perf_counter()
             try:
-                if len(sub) > 1:
+                if hit is not None:
+                    firsts = self.engine.prefill_and_insert_cached(
+                        [(slot, req.prompt_ids, req.sampling)
+                         for slot, req in sub], hit)
+                elif len(sub) > 1:
                     firsts = self.engine.prefill_and_insert_many(
                         [(slot, req.prompt_ids, req.sampling)
                          for slot, req in sub])
@@ -644,6 +729,17 @@ class Scheduler:
         self.metrics["evictions"] += 1
 
     def _emit(self, active: _ActiveSlot, ev: TokenEvent) -> None:
+        if not active.stages_sent:
+            # First event of the request: attach the per-stage admission
+            # stamps (host recv → placement pick → first token). The host
+            # adds its pipe-out stamp, the provider the relay stamp — the
+            # full TTFT chain then reads out per stage in bench.py.
+            active.stages_sent = True
+            ev.stages = {
+                "recv": active.req.enqueued_at,
+                "picked": active.req.picked_at or active.first_token_at,
+                "first": active.first_token_at,
+            }
         self._emit_cb(active.req, ev)
 
     def _emit_cb(self, req: GenRequest, ev: TokenEvent) -> None:

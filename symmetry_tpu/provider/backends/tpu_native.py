@@ -72,6 +72,22 @@ class TpuNativeBackend(InferenceBackend):
         # its job (one pipe read fans out a whole decode block).
         self.relay_stats = {"host_frames": 0, "host_events": 0,
                             "host_batched_frames": 0}
+        # Per-stage TTFT attribution (round-4 task #3: the ~2 s
+        # engine→provider hop): each first event carries the host's
+        # monotonic stage stamps ("t" field), and this side closes the
+        # chain with its own submit/receipt stamps. All CLOCK_MONOTONIC —
+        # one clock across processes on Linux.
+        #   submit   provider stream start → host-pipe submit written
+        #   pipe_in  submit written → host read + tokenized + enqueued
+        #   queue    enqueued → entered a placement group
+        #   prefill  placement pick → first token sampled
+        #   emit     first token → host pipe write (block-flush hold)
+        #   relay    host pipe write → this process relays the event
+        from symmetry_tpu.utils.trace import Histogram
+
+        self.stage_hists = {name: Histogram() for name in
+                            ("submit", "pipe_in", "queue", "prefill",
+                             "emit", "relay")}
 
     @property
     def _process_mode(self) -> bool:
@@ -279,6 +295,9 @@ class TpuNativeBackend(InferenceBackend):
                 return None
             out = {k: v for k, v in msg.items() if k != "op"}
             out["relay"] = dict(self.relay_stats)
+            out["stages"] = {name: h.to_dict()
+                             for name, h in self.stage_hists.items()
+                             if h.count}
             return out
         if self._scheduler is None:
             return None
@@ -364,6 +383,25 @@ class TpuNativeBackend(InferenceBackend):
         finally:
             session.cancel()  # no-op if complete; frees the slot if client left
 
+    def _observe_stages(self, t_recv: float, t_submit: float,
+                        t: dict) -> None:
+        """Fold one request's first-event stage stamps into the per-stage
+        TTFT histograms. Negative spans (sub-ms cross-process clock read
+        ordering) clamp to zero rather than poisoning the distribution."""
+        now = time.monotonic()
+        recv = t.get("recv", t_submit)
+        picked = t.get("picked", recv)
+        first = t.get("first", picked)
+        out = t.get("out", first)
+        spans = {"submit": t_submit - t_recv,
+                 "pipe_in": recv - t_submit,
+                 "queue": picked - recv,
+                 "prefill": first - picked,
+                 "emit": out - first,
+                 "relay": now - out}
+        for name, span in spans.items():
+            self.stage_hists[name].observe(max(span, 0.0))
+
     async def _stream_host(self, request: InferenceRequest, request_id: str,
                            created: int, max_new: int
                            ) -> AsyncIterator[StreamChunk]:
@@ -373,6 +411,7 @@ class TpuNativeBackend(InferenceBackend):
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[request_id] = queue
         completed = False
+        t_recv = time.monotonic()
         try:
             await self._host_send({
                 "op": "submit", "id": request_id,
@@ -382,6 +421,7 @@ class TpuNativeBackend(InferenceBackend):
                                        if request.top_p is not None else 1.0),
                              "top_k": getattr(request, "top_k", None) or 0,
                              "seed": request.seed}})
+            t_submit = time.monotonic()
             yield StreamChunk(
                 raw=self._chunk_line(request_id, created,
                                      {"role": "assistant"}), text="")
@@ -395,6 +435,9 @@ class TpuNativeBackend(InferenceBackend):
                 except asyncio.TimeoutError:
                     raise BackendError(
                         "engine host produced no event for 600s") from None
+                stamps = ev.get("t")
+                if isinstance(stamps, dict):
+                    self._observe_stages(t_recv, t_submit, stamps)
                 err = ev.get("error")
                 if err and ev.get("finish_reason") == "error":
                     raise BackendError(err)

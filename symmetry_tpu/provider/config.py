@@ -51,6 +51,13 @@ class TpuConfig:
     # Coalesced-prefill width cap per bucket: batch × bucket ≤ budget
     # (engine.prefill_batches_for). None → engine default (2048 tokens).
     prefill_token_budget: int | None = None
+    # Shared-prefix KV cache HBM budget in MiB (engine/prefix_cache.py):
+    # prompts sharing a system-prompt/few-shot preamble skip prefill for
+    # the cached portion — the scheduler partitions admissions into
+    # hit/miss dispatch units and the hit path copies the cached prefix
+    # KV into the slot lane, prefilling only the uncached suffix. None/0
+    # disables the cache entirely (no lookups, no extra warmup compiles).
+    prefix_cache_mb: float | None = None
     # Decode steps per device dispatch. 16 measured throughput-equal to
     # 64 at the llama3-8b/128-slot point (double-buffered dispatch hides
     # the round-trips) with ~2x lower TTFT and inter-chunk latency.

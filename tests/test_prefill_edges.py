@@ -1,0 +1,124 @@
+"""Edge cases of the coalesced-prefill sizing grid and admission rejects.
+
+prefill_batches_for / bucket_for are the two functions every admission
+decision routes through; their boundary behavior decides whether a
+runtime dispatch can ever SELECT a batch shape warmup never compiled
+(the mid-traffic-XLA-compile stall) or a prompt can slip past the
+largest bucket. Covers:
+
+  - a batch wider than max_slots is excluded from the grid
+  - budget < bucket still yields batch 1 (a bucket is always servable)
+  - an over-largest-bucket prompt raises EngineError, and inside an
+    admission group it is rejected PER-REQUEST — the rest of the group
+    still streams
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from symmetry_tpu.engine.engine import (
+    EngineError,
+    InferenceEngine,
+    SamplingParams,
+)
+from symmetry_tpu.engine.scheduler import GenRequest, Scheduler
+from symmetry_tpu.engine.tokenizer import ByteTokenizer
+from symmetry_tpu.models import init_params, preset
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = preset("tiny")
+    params = init_params(cfg, jax.random.key(0), jnp.float32)
+    return cfg, params
+
+
+def make_engine(cfg, params, *, slots=2, buckets=(16, 32), budget=None):
+    return InferenceEngine(
+        cfg, params, ByteTokenizer(), max_slots=slots, max_seq_len=64,
+        prefill_buckets=buckets, cache_dtype=jnp.float32,
+        prefill_token_budget=budget)
+
+
+class TestPrefillBatchGrid:
+    def test_batch_wider_than_max_slots_excluded(self, setup):
+        """A 16-wide batch fits the token budget at the 16 bucket, but an
+        engine with 2 slots must never offer it: runtime selection would
+        hit a shape warmup never compiled."""
+        cfg, params = setup
+        engine = make_engine(cfg, params, slots=2, budget=2048)
+        for bucket in engine.prefill_buckets:
+            allowed = engine.prefill_batches_for(bucket)
+            assert all(b == 1 or b <= engine.max_slots for b in allowed), \
+                (bucket, allowed)
+        assert engine.prefill_batches_for(16) == (1, 2)
+
+    def test_budget_below_bucket_still_yields_batch_one(self, setup):
+        """budget < bucket must clamp to the bucket (batch 1), not to an
+        empty tuple — every bucket is always servable one prompt at a
+        time."""
+        cfg, params = setup
+        engine = make_engine(cfg, params, slots=8, budget=8)
+        assert engine.prefill_batches_for(16) == (1,)
+        assert engine.prefill_batches_for(32) == (1,)
+
+    def test_batches_ascending_and_contain_one(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params, slots=8, budget=64)
+        for bucket in engine.prefill_buckets:
+            allowed = engine.prefill_batches_for(bucket)
+            assert allowed[0] == 1
+            assert list(allowed) == sorted(allowed)
+
+    def test_bucket_for_boundaries(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params)
+        assert engine.bucket_for(1) == 16
+        assert engine.bucket_for(16) == 16
+        assert engine.bucket_for(17) == 32
+        assert engine.bucket_for(32) == 32
+
+    def test_over_largest_bucket_raises(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params)
+        with pytest.raises(EngineError, match="exceeds the largest"):
+            engine.bucket_for(33)
+        with pytest.raises(EngineError, match="exceeds the largest"):
+            engine.prefill_and_insert(0, list(range(40)), SamplingParams())
+
+
+class TestPerRequestRejection:
+    def test_oversized_prompt_rejected_per_request_not_per_group(
+            self, setup):
+        """An admission group mixing an over-bucket prompt with valid
+        ones: the oversized request gets its own error event and every
+        other member of the group streams to completion."""
+        cfg, params = setup
+        engine = make_engine(cfg, params, slots=4)
+        sched = Scheduler(engine, debug_invariants=True)
+        prompts = [list(b"fits fine"), list(range(40)), list(b"also ok")]
+        results = {i: [] for i in range(len(prompts))}
+        done = {i: threading.Event() for i in range(len(prompts))}
+        for i, ids in enumerate(prompts):
+            def emit(ev, i=i):
+                results[i].append(ev)
+                if ev.done:
+                    done[i].set()
+            sched.submit(GenRequest(prompt_ids=ids,
+                                    sampling=SamplingParams(),
+                                    max_new_tokens=4, emit=emit, id=f"r{i}"))
+        sched.start()
+        for ev in done.values():
+            assert ev.wait(120)
+        sched.stop()
+        assert results[1][-1].finish_reason == "error"
+        assert "exceeds the largest" in results[1][-1].error
+        for i in (0, 2):
+            assert results[i][-1].finish_reason in ("stop", "length")
+            assert results[i][-1].tokens_generated >= 1
+        # the oversized request's slot went back to the pool
+        assert sched.occupancy == 0
+        assert sorted(sched._free) == [0, 1, 2, 3]
