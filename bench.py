@@ -202,6 +202,12 @@ def run_e2e_client_worker() -> int:
                           or [spec["prompt"]] * len(indices))
     max_new: int = spec["max_new"]
     stagger_s: float = spec["stagger_s"]
+    # Wave-level request controls: the speculative bench runs a greedy
+    # (temperature 0) workload, wave A opting every request out of
+    # drafting ("speculative": false) so the same provider measures the
+    # plain path and the speculative path on identical prompts.
+    temperature: float = spec.get("temperature", 0.7)
+    spec_flag: bool | None = spec.get("speculative")
 
     async def main() -> list[dict]:
         ready = asyncio.Event()
@@ -226,7 +232,8 @@ def run_e2e_client_worker() -> int:
             try:
                 async for delta in session.chat(
                         [{"role": "user", "content": prompt}],
-                        max_tokens=max_new, temperature=0.7, seed=i):
+                        max_tokens=max_new, temperature=temperature,
+                        seed=i, speculative=spec_flag):
                     now = _time.monotonic()
                     if t_first is None and delta:
                         t_first = now
@@ -269,7 +276,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             stagger_s: float = 0.0, max_queue: int | None = None,
             max_ttft_s: float | None = None, client_procs: int = 1,
             shared_prefix: bool = False,
-            prefix_cache_mb: float | None = None) -> dict:
+            prefix_cache_mb: float | None = None,
+            speculative: bool = False, draft_k: int = 8) -> dict:
     """The NORTH-STAR measurement (BASELINE.json metric): aggregate WIRE
     tok/s and p50/p99 TTFT through the full serving path — server +
     tpu_native provider + N concurrent streaming clients over TCP
@@ -332,6 +340,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                    else {}),
                 **({"prefix_cache_mb": prefix_cache_mb}
                    if prefix_cache_mb else {}),
+                **({"speculative": {"k_draft": draft_k}}
+                   if speculative else {}),
             },
         }
         # Provider log is ALWAYS captured (round-3 verdict #1: a 6-line
@@ -349,6 +359,17 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
 
 
         prompts = ["x" * prompt_chars] * clients
+        if speculative:
+            # Repetition-heavy, code-like prompts (the prompt-lookup
+            # drafter's home turf: keyed records whose n-grams recur), run
+            # GREEDY — greedy is both the decode-equivalence contract
+            # (wave A and wave B must stream identical text) and the
+            # regime where a model's own repetitive continuation keeps
+            # matching its context.
+            unit = "cfg[{0}].key{0} = value{0}; "
+            rep = "".join(unit.format(j % 7) for j in range(64))
+            prompts = [("repeat the config table verbatim: "
+                        + rep)[:prompt_chars]] * clients
         wave_a_prompts = wave_b_prompts = None
         if shared_prefix:
             # Shared-prefix workload: wave A is the UNCACHED comparison
@@ -394,7 +415,9 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
         all_connected = asyncio.Event()
         connected = 0
 
-        async def run_sharded_fleet(fleet_prompts: list[str]
+        async def run_sharded_fleet(fleet_prompts: list[str],
+                                    temperature: float = 0.7,
+                                    spec_flag: bool | None = None
                                     ) -> tuple[list, float, float]:
             """The client fleet split over `client_procs` OS processes
             (run_e2e_client_worker), so the measured tails are the
@@ -418,7 +441,10 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                             "model_name": model_name, "indices": shard,
                             "prompts": [fleet_prompts[i] for i in shard],
                             "max_new": max_new,
-                            "stagger_s": stagger_s}
+                            "stagger_s": stagger_s,
+                            "temperature": temperature,
+                            **({"speculative": spec_flag}
+                               if spec_flag is not None else {})}
                     p.stdin.write((json.dumps(spec) + "\n").encode())
                     await p.stdin.drain()
                     procs.append(p)
@@ -519,8 +545,10 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 print(f"[bench] provider registered after {startup_s:.0f}s "
                       f"(weight init + XLA compile + warmup; excluded from "
                       f"the measured window)", file=sys.stderr)
-                async def fetch_prefix_counters() -> dict | None:
-                    """One stats round-trip, prefix-cache block only."""
+                async def fetch_engine_block(field: str) -> dict | None:
+                    """One stats round-trip, one engine-stats block (the
+                    prefix-cache or speculative counters) — used to
+                    snapshot cumulative counters between waves."""
                     try:
                         c = SymmetryClient(
                             Identity.from_name("bench-stats-mid"),
@@ -533,8 +561,7 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                             stats = await s.stats()
                         finally:
                             await s.close()
-                        return (stats.get("engine") or {}).get(
-                            "prefix_cache")
+                        return (stats.get("engine") or {}).get(field)
                     except Exception as exc:  # noqa: BLE001 — diag only
                         print(f"[bench] mid-run stats fetch failed: "
                               f"{exc!r}", file=sys.stderr)
@@ -542,7 +569,27 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
 
                 results_uncached = None
                 pc_after_wave_a = None
-                if shared_prefix:
+                results_plain = None
+                plain_elapsed = None
+                spec_after_wave_a = None
+                if speculative:
+                    # Wave A: identical prompts with every request opted
+                    # OUT of drafting ("speculative": false) — the plain
+                    # decode path on the same provider. Wave B: drafting
+                    # on. Both greedy, so the text is token-identical and
+                    # the tok/s delta is speculation's doing alone.
+                    print("[bench] speculative wave A (drafting off, "
+                          "plain decode)", file=sys.stderr)
+                    results_plain, _t0a, plain_elapsed = \
+                        await run_sharded_fleet(prompts, temperature=0.0,
+                                                spec_flag=False)
+                    spec_after_wave_a = await fetch_engine_block(
+                        "speculative")
+                    print("[bench] speculative wave B (n-gram drafting + "
+                          "batched verify)", file=sys.stderr)
+                    results, t0, elapsed = await run_sharded_fleet(
+                        prompts, temperature=0.0)
+                elif shared_prefix:
                     # Wave A (unique preambles — all misses) runs to
                     # completion, then wave B (shared preamble — hits
                     # after the first dispatch) on the SAME provider.
@@ -555,7 +602,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                           "preambles)", file=sys.stderr)
                     results_uncached, _t0a, _el_a = await run_sharded_fleet(
                         wave_a_prompts)
-                    pc_after_wave_a = await fetch_prefix_counters()
+                    pc_after_wave_a = await fetch_engine_block(
+                        "prefix_cache")
                     print("[bench] shared-prefix wave B (cached, shared "
                           "preamble)", file=sys.stderr)
                     results, t0, elapsed = await run_sharded_fleet(
@@ -687,6 +735,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
               f"tail {tail_s:.1f}s", file=sys.stderr)
 
         diag: dict = {}
+        ttft_stages = None
+        spec_stats = None
         if engine_stats:
             # Three TTFT vantage points bracket any stall: engine (first
             # sampled token), provider (first chunk leaving the backend
@@ -767,6 +817,9 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             # submit (provider→pipe), pipe_in (pipe + host tokenize),
             # queue (scheduler inbox), prefill (placement→first token),
             # emit (block-flush hold), relay (pipe out + provider loop).
+            # The FULL per-stage breakdown (not just the printed p50
+            # line) rides the final JSON as `ttft_stages`, so BENCH_r*.json
+            # captures it for trajectory analysis.
             stages = engine_stats.get("stages") or {}
             if stages:
                 order = ("submit", "pipe_in", "queue", "prefill",
@@ -776,6 +829,9 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                     for k in order if k in stages}
                 diag["stage_p99_s"] = {
                     k: _rnd((stages.get(k) or {}).get("p99"))
+                    for k in order if k in stages}
+                ttft_stages = {
+                    k: {m: _rnd(v, 4) for m, v in (stages[k] or {}).items()}
                     for k in order if k in stages}
                 print("[bench] ttft stages p50 (s): "
                       + " | ".join(f"{k} {diag['stage_p50_s'][k]}"
@@ -793,6 +849,21 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                       f" | {pc.get('insertions')} stored, "
                       f"{pc.get('evictions')} evicted, "
                       f"{pc.get('bytes')} / {pc.get('budget_bytes')} bytes",
+                      file=sys.stderr)
+            # Speculative decoding counters (host stats → provider stats
+            # → here): drafted/accepted volume, acceptance rate, and the
+            # tokens-per-verify-dispatch distribution.
+            spec_stats = engine_stats.get("speculative")
+            if spec_stats:
+                tpd = spec_stats.get("tokens_per_dispatch") or {}
+                print(f"[bench] speculative: "
+                      f"{spec_stats.get('verify_blocks')} verify blocks | "
+                      f"{spec_stats.get('drafted')} drafted, "
+                      f"{spec_stats.get('accepted')} accepted "
+                      f"(rate {spec_stats.get('acceptance_rate')}), "
+                      f"{spec_stats.get('rolled_back')} rolled back | "
+                      f"tokens/dispatch p50/p99 "
+                      f"{_rnd(tpd.get('p50'))}/{_rnd(tpd.get('p99'))}",
                       file=sys.stderr)
             # The attribution that mattered in round 3: wire TTFT far above
             # engine TTFT means the stall is relay/wire/client-loop, not
@@ -812,6 +883,44 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 print(f"  {ln.rstrip()}", file=sys.stderr)
         except OSError:
             pass
+
+        speculative_block = None
+        if speculative and results_plain is not None:
+            ok_p = [r for r in results_plain if not r.get("rejected")]
+            plain_tokens = sum(r["tokens"] for r in ok_p)
+            plain_tok_s = (plain_tokens / plain_elapsed
+                           if plain_elapsed else None)
+            tp = sorted(r["ttft"] for r in ok_p)
+            speculative_block = {
+                "tok_s_plain": _rnd(plain_tok_s, 1),
+                "tok_s_speculative": round(tok_s, 1),
+                "speedup": (round(tok_s / plain_tok_s, 3)
+                            if plain_tok_s else None),
+                "ttft_p50_plain_s": (round(pct(tp, 0.50), 3)
+                                     if tp else None),
+                "ttft_p50_speculative_s": round(pct(ttfts, 0.50), 3),
+            }
+            if spec_stats:
+                # Wave-B delta: cumulative counters minus the between-
+                # waves snapshot. Wave A requests opt out of drafting, so
+                # its contribution should be ~0, but the subtraction
+                # keeps the quoted numbers honest either way.
+                base = spec_after_wave_a or {}
+                for key in ("verify_blocks", "drafted", "accepted",
+                            "rolled_back", "spec_tokens"):
+                    speculative_block[key] = (spec_stats.get(key, 0)
+                                              - base.get(key, 0))
+                drafted = speculative_block["drafted"]
+                speculative_block["acceptance_rate"] = (
+                    round(speculative_block["accepted"] / drafted, 4)
+                    if drafted else None)
+                speculative_block["tokens_per_dispatch"] = (
+                    spec_stats.get("tokens_per_dispatch"))
+            print(f"[bench] speculative vs plain (same prompts, same "
+                  f"provider): {speculative_block['tok_s_plain']} tok/s "
+                  f"plain → {speculative_block['tok_s_speculative']} "
+                  f"tok/s speculative "
+                  f"(x{speculative_block['speedup']})", file=sys.stderr)
 
         shared_block = None
         if shared_prefix and results_uncached is not None:
@@ -854,6 +963,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                          " (burst)")
                       + (", shared-prefix cached wave" if shared_prefix
                          else "")
+                      + (f", speculative wave (k={draft_k})" if speculative
+                         else "")
                       + f", {max_new} tok/req, {slots} slots, block {block}, "
                         f"provider subprocess, 1 tpu dev)",
             "value": round(tok_s, 1),
@@ -876,6 +987,11 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 "reject_p99_s": round(pct(rj, 0.99), 3)}
                if rejected else {}),
             **({"shared_prefix": shared_block} if shared_block else {}),
+            **({"speculative": speculative_block}
+               if speculative_block else {}),
+            # Satellite of the speculative PR: the per-stage TTFT
+            # breakdown lands in the JSON capture, not just stderr text.
+            **({"ttft_stages": ttft_stages} if ttft_stages else {}),
             **({"engine": diag} if diag else {}),
         }
 
@@ -1020,6 +1136,18 @@ def main() -> None:
                     help="shared-prefix KV cache HBM budget in MiB "
                          "(tpu.prefix_cache_mb). Default: 128 in "
                          "--shared-prefix mode, disabled otherwise")
+    ap.add_argument("--speculative", action="store_true",
+                    help="speculative-decoding workload (--e2e): a "
+                         "repetition-heavy greedy workload runs twice on "
+                         "one provider with tpu.speculative on — wave A "
+                         "opts every request out of drafting (plain "
+                         "decode), wave B drafts with n-gram prompt "
+                         "lookup and batched verify — and the run reports "
+                         "speculative vs plain tok/s plus drafted/"
+                         "accepted/acceptance-rate counters")
+    ap.add_argument("--draft-k", type=int, default=8,
+                    help="draft tokens per slot per verify dispatch "
+                         "(tpu.speculative k_draft; --speculative only)")
     ap.add_argument("--preset", default="llama3-8b")
     ap.add_argument("--slots", type=int, default=None,
                     help="decode slots (default 128; 96 in shared-prefix "
@@ -1091,10 +1219,15 @@ def main() -> None:
     # spans an alignment boundary plus slack for the cache budget, so its
     # defaults trade a few slots for the bigger bucket; everything else
     # keeps the BENCH_r05-comparable point.
+    if args.speculative and args.shared_prefix:
+        ap.error("--speculative and --shared-prefix are separate "
+                 "two-wave workloads; pick one")
     if args.clients is None:
-        args.clients = 96 if args.shared_prefix else 128
+        args.clients = 96 if (args.shared_prefix or args.speculative) \
+            else 128
     if args.slots is None:
-        args.slots = 96 if args.shared_prefix else 128
+        args.slots = 96 if (args.shared_prefix or args.speculative) \
+            else 128
     user_prompt_len = args.prompt_len
     if args.prompt_len is None:
         args.prompt_len = 384 if args.shared_prefix else 128
@@ -1113,11 +1246,14 @@ def main() -> None:
     # not fit the preamble).
     user_sized = (args.max_seq is not None or args.max_new is not None
                   or user_prompt_len is not None or user_block is not None
-                  or args.shared_prefix)
+                  or args.shared_prefix or args.speculative)
     if args.max_seq is None:
         args.max_seq = 640
     if args.max_new is None:
-        args.max_new = 192 if args.shared_prefix else 480
+        # Speculative mode trims the per-request budget like shared-prefix:
+        # two waves on one provider must fit the same wall budget.
+        args.max_new = (192 if (args.shared_prefix or args.speculative)
+                        else 480)
 
     def engine_bench() -> dict:
         # engine numbers are recorded at block 64; when the user didn't
@@ -1169,7 +1305,8 @@ def main() -> None:
                 stagger_s=args.stagger, max_queue=args.max_queue,
                 max_ttft_s=args.max_ttft, client_procs=args.client_procs,
                 shared_prefix=args.shared_prefix,
-                prefix_cache_mb=args.prefix_cache_mb)
+                prefix_cache_mb=args.prefix_cache_mb,
+                speculative=args.speculative, draft_k=args.draft_k)
 
         try:
             result = e2e_attempt(args.max_seq, args.max_new)

@@ -169,6 +169,7 @@ class ProviderSession:
         top_p: float | None = None,
         top_k: int | None = None,
         seed: int | None = None,
+        speculative: bool | None = None,
     ) -> AsyncIterator[str]:
         """Send one inference request; yield text deltas as they stream.
         Safe to call concurrently on one session (requestId multiplexing)."""
@@ -181,7 +182,8 @@ class ProviderSession:
         if self._details.session_token is not None:
             payload["sessionToken"] = self._details.session_token
         for k, v in (("max_tokens", max_tokens), ("temperature", temperature),
-                     ("top_p", top_p), ("top_k", top_k), ("seed", seed)):
+                     ("top_p", top_p), ("top_k", top_k), ("seed", seed),
+                     ("speculative", speculative)):
             if v is not None:
                 payload[k] = v
         self._ensure_reader()

@@ -41,10 +41,11 @@ from symmetry_tpu.models.llama import (
 )
 
 
-from symmetry_tpu.ops.sampling import sample_tokens
+from symmetry_tpu.ops.sampling import sample_tokens, verify_tokens
 from symmetry_tpu.parallel.mesh import MeshSpec, build_mesh
 from symmetry_tpu.parallel.sharding import shardings_for
 from symmetry_tpu.engine.prefix_cache import PrefixHit, PrefixStore
+from symmetry_tpu.engine.spec import SpecConfig
 from symmetry_tpu.engine.tokenizer import Tokenizer, get_tokenizer
 
 
@@ -153,6 +154,7 @@ class InferenceEngine:
         prefill_chunk: int | None = 256,
         prefill_token_budget: int | None = None,
         prefix_cache_bytes: int = 0,
+        speculative: SpecConfig | None = None,
     ) -> None:
         self.config = config
         self.params = params
@@ -262,6 +264,15 @@ class InferenceEngine:
                 budget_bytes=prefix_cache_bytes, align=self.prefix_align)
         else:
             self.prefix_store = None
+
+        # Speculative decoding (engine/spec/): None keeps the serving path
+        # byte-identical — no verify jit is ever built or compiled, the
+        # scheduler never drafts, warmup's compile set is unchanged.
+        self.spec = speculative
+        if self.spec is not None and 1 + self.spec.k_draft > max_seq_len:
+            raise EngineError(
+                f"speculative k_draft {self.spec.k_draft} does not fit "
+                f"max_seq_len {max_seq_len}")
 
         self._build_jits()
 
@@ -465,6 +476,44 @@ class InferenceEngine:
                 lambda s, _: decode_one(s, params), state, None,
                 length=self.decode_block)
 
+        def verify_block(params, state: DecodeState, draft, n_draft):
+            """Speculative verify: ONE batched forward over [B, 1+k_draft]
+            positions — the pending last_token plus every slot's drafted
+            continuation — then per-position acceptance (ops/sampling.py
+            verify_tokens) and a per-slot cache-length rollback to the
+            first rejection. Fixed [B, 1+k] shape: exactly one compiled
+            program, covered by warmup only when the knob is on.
+
+            The trunk is the same continuation path chunk_step uses
+            (absolute-position causal masking against the live cache), so
+            KV for all 1+k positions is appended in place; positions past
+            each slot's seq_len write garbage that the rollback lengths
+            exclude and later writes overwrite — the rollback itself is
+            one lengths update, no data movement. A slot with n_draft 0
+            advances exactly one token, like a plain decode step."""
+            tokens = jnp.concatenate([state.last_token[:, None], draft],
+                                     axis=1)               # [B, 1+k]
+            seq_lens = 1 + n_draft
+            old_lengths = state.cache.lengths
+            h, cache = trunk(params, tokens, state.cache, seq_lens=seq_lens)
+            # Head over all 1+k positions: unlike prefill's bucket-wide
+            # pad, every lane here is a candidate token — and 1+k is tiny.
+            logits = logits_from_hidden(params, cfg, h)    # [B, 1+k, V]
+            split = jax.vmap(lambda q: jax.random.split(q, 2))(state.rng)
+            rng, step_key = split[:, 0], split[:, 1]
+            out, n_emit = verify_tokens(
+                logits, draft, n_draft, step_key, state.temperature,
+                state.top_p, state.top_k)
+            last = jnp.take_along_axis(out, (n_emit - 1)[:, None],
+                                       axis=1)[:, 0]
+            # Roll back: only the accepted prefix (and the pending bonus
+            # token's future write position) stays valid.
+            cache = cache._replace(lengths=old_lengths + n_emit)
+            return DecodeState(
+                cache=cache, last_token=last, temperature=state.temperature,
+                top_p=state.top_p, top_k=state.top_k, rng=rng,
+            ), out.T, n_emit
+
         state_shard = self._state_shardings
         if self.mesh is not None:
             # Host-read outputs (sampled tokens) must be fully replicated —
@@ -495,6 +544,10 @@ class InferenceEngine:
                                     out_shardings=(rep, prefix_shard))
             self._decode = jax.jit(decode_block, donate_argnums=(1,),
                                    out_shardings=(state_shard, rep))
+            if self.spec is not None:
+                self._verify = jax.jit(
+                    verify_block, donate_argnums=(1,),
+                    out_shardings=(state_shard, rep, rep))
             self._chunk_step = jax.jit(chunk_step, donate_argnums=(2,),
                                        out_shardings=prefix_shard)
             self._chunk_final = jax.jit(chunk_final, donate_argnums=(2,),
@@ -507,6 +560,8 @@ class InferenceEngine:
         else:
             self._prefill = jax.jit(prefill, donate_argnums=(7,))
             self._decode = jax.jit(decode_block, donate_argnums=(1,))
+            if self.spec is not None:
+                self._verify = jax.jit(verify_block, donate_argnums=(1,))
             self._chunk_step = jax.jit(chunk_step, donate_argnums=(2,))
             self._chunk_final = jax.jit(chunk_final, donate_argnums=(2,))
             self._insert_from_prefix = jax.jit(insert_from_prefix,
@@ -1019,6 +1074,17 @@ class InferenceEngine:
                     jax.random.split(jax.random.key(0), 1))
                 # batch-1 insert at this bucket already compiled above
 
+        # Speculative verify program (only when the knob is on — off keeps
+        # warmup's compile set byte-identical): exactly ONE extra compile,
+        # the fixed [B, 1+k_draft] verify shape. Zero drafts advance every
+        # lane one garbage token — harmless on the pre-insert empty cache,
+        # same contract as the decode warmup above. The sync inside
+        # verify_step surfaces a marginal-HBM failure at startup.
+        if self.spec is not None:
+            self.verify_step(
+                np.zeros((self.max_slots, self.spec.k_draft), np.int32),
+                np.zeros((self.max_slots,), np.int32))
+
         # Prefix-cache hit-path programs (only when the cache is on —
         # budget 0 keeps warmup exactly as before): per (batch, bucket),
         # the row extract (store path), the seed copy from an entry at
@@ -1056,6 +1122,29 @@ class InferenceEngine:
                     # not at the first hit burst (same rationale as the
                     # concurrent-peak probe above).
                     np.asarray(toks)
+
+    def verify_step(self, draft: np.ndarray, n_draft: np.ndarray
+                    ) -> tuple[np.ndarray, np.ndarray]:
+        """Run ONE speculative verify dispatch: `draft` [B, k_draft] holds
+        each slot's proposed continuation tokens, `n_draft` [B] how many
+        are real (0 = no proposal; the slot advances one plain token).
+        Returns (tokens [1+k, B], n_emit [B]) on the host — tokens[:n, b]
+        with n = n_emit[b] are slot b's emitted run for this dispatch.
+
+        Synchronous by design: the NEXT dispatch's drafts are built from
+        this dispatch's output, so there is nothing to overlap — the
+        scheduler falls back to double-buffered plain blocks whenever no
+        slot has a proposal."""
+        if self.spec is None:
+            raise EngineError("speculative decoding is not enabled")
+        k = self.spec.k_draft
+        if draft.shape != (self.max_slots, k):
+            raise EngineError(
+                f"draft shape {draft.shape} != {(self.max_slots, k)}")
+        self.state, toks, n_emit = self._verify(
+            self.params, self.state, jnp.asarray(draft, jnp.int32),
+            jnp.asarray(n_draft, jnp.int32))
+        return np.asarray(toks), np.asarray(n_emit)
 
     def decode_steps_dispatch(self) -> jax.Array:
         """Dispatch one decode block WITHOUT syncing: returns the [K, B]
@@ -1206,4 +1295,6 @@ class InferenceEngine:
                                          None),
             prefix_cache_bytes=int(
                 (getattr(tpu_cfg, "prefix_cache_mb", None) or 0) * 2**20),
+            speculative=SpecConfig.from_knob(
+                getattr(tpu_cfg, "speculative", None)),
         )

@@ -10,7 +10,9 @@ never hits this because its "engine" is an external HTTP server
 equivalent of that isolation, with a pipe instead of HTTP.
 
 Protocol: JSON lines.
-  stdin  ← {"op": "submit", "id", "messages", "max_new", "sampling": {…}}
+  stdin  ← {"op": "submit", "id", "messages", "max_new", "sampling": {…},
+            "speculative": bool?}   (optional per-request opt-out of
+            speculative decoding; ignored unless tpu.speculative is on)
            {"op": "cancel", "id"}
            {"op": "stats"} | {"op": "shutdown"}
   stdout → {"op": "ready", "model": …}            (after warmup)
@@ -22,8 +24,10 @@ Protocol: JSON lines.
             pipe write — so the provider can attribute its TTFT)
            {"op": "events", "events": [{…event fields, no "op"…}, …]}
            {"op": "stats", …}   (scheduler counters incl. deferred_depth,
-            prefill_jobs_active, and the prefix_cache hit/miss/evict/
-            bytes block when the shared-prefix KV cache is enabled)
+            prefill_jobs_active, the prefix_cache hit/miss/evict/bytes
+            block when the shared-prefix KV cache is enabled, and the
+            speculative drafted/accepted/acceptance-rate block when
+            tpu.speculative is on)
 
 The batched `events` frame is the hot path: the scheduler coalesces each
 decode block's per-slot deltas (plus any finishes and admission errors
@@ -99,10 +103,14 @@ class EngineHost:
 
     def _event_dict(self, req_id: str, ev: "TokenEvent") -> dict[str, Any]:
         """One event's wire fields (shared by legacy and batched frames),
-        with the per-request delta bookkeeping."""
+        with the per-request delta bookkeeping. tokens_new deltas ride
+        tokens_emitted — only tokens that actually streamed as text, so
+        summing them reproduces the bench's tokens_streamed exactly (the
+        EOS token and post-finish block remainders are excluded; the
+        cumulative `tokens` field keeps the EOS-counting convention)."""
         prev = self._reported.get(req_id, 0)
-        new = max(ev.tokens_generated - prev, 0)
-        self._reported[req_id] = max(ev.tokens_generated, prev)
+        new = max(ev.tokens_emitted - prev, 0)
+        self._reported[req_id] = max(ev.tokens_emitted, prev)
         out: dict[str, Any] = {"id": req_id, "text": ev.text,
                                "tokens": ev.tokens_generated,
                                "tokens_new": new}
@@ -251,12 +259,14 @@ class EngineHost:
             self._write({"op": "event", **self._event_dict(req_id, ev)},
                         events=1)
 
+        spec = msg.get("speculative")
         self._scheduler.submit(GenRequest(
             prompt_ids=prompt_ids, sampling=sampling,
             max_new_tokens=int(msg.get("max_new", 512)),
             emit=emit,
             cancelled=lambda: req_id in self._cancelled,
-            id=req_id))
+            id=req_id,
+            speculative=spec if isinstance(spec, bool) else None))
 
 
 def main() -> int:

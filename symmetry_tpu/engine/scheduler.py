@@ -47,6 +47,10 @@ class GenRequest:
     emit: Callable[["TokenEvent"], None]
     cancelled: Callable[[], bool] = lambda: False
     id: str = ""
+    # Per-request speculative-decoding override: False opts this request
+    # out of drafting (its slot rides plain decode lanes); None/True defer
+    # to the engine's tpu.speculative knob. No effect when the knob is off.
+    speculative: bool | None = None
     enqueued_at: float = field(default_factory=time.monotonic)
     # Stamped when the request enters a placement group (the admission
     # moment); re-stamped on re-pick after a budget deferral, so
@@ -67,6 +71,13 @@ class TokenEvent:
     # serving metrics (SURVEY §5.1: TTFT and tok/s are first-class)
     ttft_s: float | None = None
     tokens_generated: int = 0
+    # Cumulative tokens actually EMITTED as text (pushed to the stream
+    # decoder) — excludes the EOS token and anything a finishing block
+    # discarded past it, so deltas of this field sum to exactly what the
+    # client streamed (the host's tokens_new and the bench's
+    # tokens_streamed both ride it; tokens_generated keeps the
+    # budget-accounting convention of counting the EOS).
+    tokens_emitted: int = 0
     # Per-stage monotonic stamps, attached ONCE per request (its first
     # event): {"recv": host received, "picked": entered a placement
     # group, "first": first token sampled}. The host adds its pipe-write
@@ -81,6 +92,7 @@ class _ActiveSlot:
     req: GenRequest
     decoder: StreamDecoder
     generated: int = 0
+    emitted: int = 0   # tokens pushed to the decoder (streamed as text)
     prompt_len: int = 0
     first_token_at: float | None = None
     stages_sent: bool = False
@@ -140,6 +152,24 @@ class Scheduler:
         self._debug = debug_invariants
         self._thread: threading.Thread | None = None
         self._stopping = threading.Event()
+        # Speculative decoding (engine/spec/): when the engine was built
+        # with tpu.speculative, the scheduler owns the host-side n-gram
+        # drafter and interleaves verify dispatches with plain decode
+        # blocks. Engine spec None => self._drafter None => every code
+        # path below is byte-identical to the non-speculative scheduler.
+        spec = getattr(engine, "spec", None)
+        if spec is not None:
+            from symmetry_tpu.engine.spec import NGramDrafter
+
+            self._drafter: NGramDrafter | None = NGramDrafter(spec)
+        else:
+            self._drafter = None
+        # KV writes one dispatch can land for a slot: a verify dispatch
+        # touches 1 + k_draft positions where a plain block touches
+        # decode_block — the capacity guards must fence the larger.
+        self._max_block_writes = max(
+            engine.decode_block,
+            (1 + spec.k_draft) if spec is not None else 0)
         self.metrics = {"requests": 0, "tokens": 0, "evictions": 0,
                         "steps": 0, "peak_occupancy": 0,
                         # Per-phase wall accounting (round-3 verdict: a
@@ -155,7 +185,15 @@ class Scheduler:
                         # events = TokenEvents carried. events/flushes is
                         # the coalescing ratio the batched host frame
                         # exists to raise.
-                        "emit_flushes": 0, "emit_events": 0}
+                        "emit_flushes": 0, "emit_events": 0,
+                        # Speculative decoding (all 0 with the knob off):
+                        # verify dispatches, tokens the drafter proposed,
+                        # tokens the target accepted, and tokens rolled
+                        # back (drafted - accepted); spec_verify_s is the
+                        # wall spent in verify dispatch+sync.
+                        "spec_verify_blocks": 0, "spec_drafted": 0,
+                        "spec_accepted": 0, "spec_rolled_back": 0,
+                        "spec_tokens": 0, "spec_verify_s": 0.0}
         from symmetry_tpu.utils.trace import Histogram
 
         # Engine-side latency distributions: TTFT as the scheduler saw it
@@ -167,6 +205,10 @@ class Scheduler:
         self._ttft_hist = Histogram()
         self._admit_hist = Histogram()
         self._interval_hist = Histogram()
+        # Per-slot tokens emitted by each verify dispatch (1 = nothing
+        # accepted, 1 + k_draft = the whole proposal) — the distribution
+        # that says whether speculation is paying for its dispatches.
+        self._spec_emit_hist = Histogram()
         self._last_sync_done: float | None = None
 
     # ------------------------------------------------------------- lifecycle
@@ -216,6 +258,24 @@ class Scheduler:
             pc = pc_stats()
             if pc is not None:
                 out["prefix_cache"] = pc
+        # Speculative-decoding block (host stats → provider stats → bench):
+        # drafted/accepted/rolled-back counters, the acceptance rate, and
+        # the per-slot tokens-per-verify-dispatch distribution.
+        if self._drafter is not None:
+            drafted = self.metrics["spec_drafted"]
+            out["speculative"] = {
+                "k_draft": self._drafter.config.k_draft,
+                "verify_blocks": self.metrics["spec_verify_blocks"],
+                "drafted": drafted,
+                "accepted": self.metrics["spec_accepted"],
+                "rolled_back": self.metrics["spec_rolled_back"],
+                "acceptance_rate": (
+                    round(self.metrics["spec_accepted"] / drafted, 4)
+                    if drafted else None),
+                "spec_tokens": self.metrics["spec_tokens"],
+                "verify_s": round(self.metrics["spec_verify_s"], 3),
+                "tokens_per_dispatch": self._spec_emit_hist.to_dict(),
+            }
         return out
 
     # ------------------------------------------------------------- the loop
@@ -299,8 +359,28 @@ class Scheduler:
             # block N then overlaps block N+1's device execution, hiding
             # the host↔device transfer and all host-side bookkeeping
             # behind compute.
+            #
+            # Speculative mode interleaves verify dispatches with those
+            # plain blocks: the drafter proposes continuations of the
+            # FRESHEST emitted context, so the in-flight plain block must
+            # sync before drafting, and a verify dispatch is processed in
+            # the same iteration (its output is the next proposals'
+            # context — there is nothing to overlap it with). That early
+            # sync costs the dispatch-before-sync overlap, so it is paid
+            # only when a PEEK at the current (one-block-stale) context
+            # says a proposal is likely — repetition that makes the fresh
+            # context match almost always makes the stale one match too.
+            # Non-repetitive traffic therefore keeps the overlapped plain
+            # path below, in the knob-off dispatch order exactly.
+            did_verify = False
+            if self._slots and self._drafter is not None:
+                if pending is not None and self._spec_peek():
+                    self._process_block(pending[0], pending[1])
+                    pending = None
+                if self._slots and pending is None:
+                    did_verify = self._maybe_verify_block()
             nxt = None
-            if self._slots:
+            if self._slots and not did_verify:
                 nxt = (self.engine.decode_steps_dispatch(),
                        dict(self._slots))
                 self.metrics["steps"] += self.engine.decode_block
@@ -326,7 +406,8 @@ class Scheduler:
                 self._check_invariants()
 
     def _process_block(self, device_toks: Any,
-                       snapshot: dict[int, _ActiveSlot]) -> None:
+                       snapshot: dict[int, _ActiveSlot],
+                       n_valid: np.ndarray | None = None) -> None:
         """Sync one decode block to host and stream its tokens out.
 
         Batched pass (the block-granular emit path): ONE vectorized EOS
@@ -334,7 +415,21 @@ class Scheduler:
         finish-point computation, one push_many over its token run, and
         one buffered TokenEvent — per-token Python work is gone, and the
         block boundary flush coalesces every slot's event into a single
-        host-pipe frame."""
+        host-pipe frame.
+
+        `n_valid` [B] makes the block RAGGED: slot b produced only
+        n_valid[b] tokens this dispatch (>= 1). Plain decode blocks pass
+        None (every slot advanced all K steps); speculative verify
+        dispatches pass their per-slot accepted counts, so variable
+        accepted-tokens-per-slot rides the same EOS/budget scan, the same
+        push_many detokenize, and the same block-granular event frames.
+
+        Token accounting: metrics["tokens"] (and TokenEvent.
+        tokens_emitted) count only tokens PUSHED to the detokenizer —
+        the EOS token and anything the block produced past a finish are
+        discarded from the counters too, so the engine-side number sums
+        to exactly the bench's tokens_streamed. tokens_generated keeps
+        counting the EOS (the budget convention)."""
         t0 = time.perf_counter()
         toks = np.asarray(device_toks)  # blocks on THIS block only
         t1 = time.perf_counter()
@@ -359,39 +454,101 @@ class Scheduler:
             # the budget-exhausting position still finishes as "stop"
             # (EOS is checked before the length bound, matching the
             # per-token order this pass replaced). The EOS token counts
-            # toward tokens_generated but is never detokenized.
+            # toward tokens_generated but is never detokenized or counted
+            # as emitted.
+            v = K if n_valid is None else int(n_valid[slot])
             budget = active.req.max_new_tokens - active.generated
-            r = max(1, min(K, budget))
+            r = max(1, min(v, budget))
             hits = np.flatnonzero(eos_mask[:r, slot])
             if hits.size:
                 e = int(hits[0])
                 n_push, consumed, finish = e, e + 1, "stop"
-            elif budget <= K:
+            elif budget <= v:
                 n_push = consumed = r
                 finish = "length"
             else:
-                n_push = consumed = K
+                n_push = consumed = v
                 finish = None
             last_tok = int(toks[consumed - 1, slot])
             active.generated += consumed
-            block_tokens += consumed
+            active.emitted += n_push
+            block_tokens += n_push
             text = (active.decoder.push_many(toks[:n_push, slot].tolist())
                     if n_push else "")
-            # TWO blocks may touch the cache before this slot is seen
+            # TWO dispatches may touch the cache before this slot is seen
             # again (one already in flight + the next dispatch); a slot
-            # that can't absorb 2K more entries must finish now (cache
-            # holds prompt_len + generated - 1 entries after this block).
-            if finish is None and (active.prompt_len + active.generated
-                                   + 2 * K > self.engine.slot_capacity + 1):
+            # that can't absorb 2 more full writes must finish now (cache
+            # holds prompt_len + generated - 1 entries after this block;
+            # a write is K positions for a plain block, 1 + k_draft for a
+            # speculative verify).
+            if finish is None and (
+                    active.prompt_len + active.generated
+                    + 2 * self._max_block_writes
+                    > self.engine.slot_capacity + 1):
                 finish = "length"
             if finish is None:
+                if self._drafter is not None:
+                    # Consumed tokens extend the slot's n-gram index (its
+                    # context must track the device's conditioning).
+                    self._drafter.extend(slot, toks[:consumed, slot].tolist())
                 if text:
                     self._emit(active, TokenEvent(
                         text=text, token_id=last_tok,
-                        tokens_generated=active.generated))
+                        tokens_generated=active.generated,
+                        tokens_emitted=active.emitted))
             else:
                 self._finish(slot, active, finish, last_tok, text)
         self.metrics["tokens"] += block_tokens
+
+    def _spec_peek(self) -> bool:
+        """Would any active slot propose a draft from its CURRENT
+        context? Used while a plain block is still in flight — the
+        context is stale by that block, so this is a predictor, not the
+        proposal itself: a few dict probes per slot, no device work. A
+        miss here just means one more overlapped plain block."""
+        return any(
+            active.req.speculative is not False
+            and self._drafter.propose(slot)
+            for slot, active in self._slots.items())
+
+    def _maybe_verify_block(self) -> bool:
+        """Collect every active slot's n-gram proposal; when at least one
+        slot has a draft, run ONE verify dispatch (fixed [B, 1+k] shape)
+        and process its ragged output through the block pipeline. Returns
+        False — letting the caller fall back to a plain decode block —
+        when nothing was proposed."""
+        engine = self.engine
+        k = engine.spec.k_draft
+        draft = np.zeros((engine.max_slots, k), np.int32)
+        n_draft = np.zeros((engine.max_slots,), np.int32)
+        proposed = 0
+        for slot, active in self._slots.items():
+            if active.req.speculative is False:
+                continue  # per-request opt-out: plain decode lanes only
+            prop = self._drafter.propose(slot)
+            if prop:
+                draft[slot, :len(prop)] = prop
+                n_draft[slot] = len(prop)
+                proposed += len(prop)
+        if not proposed:
+            return False
+        snapshot = dict(self._slots)
+        t0 = time.perf_counter()
+        toks, n_emit = engine.verify_step(draft, n_draft)
+        dt = time.perf_counter() - t0
+        accepted = int(np.sum(np.minimum(n_emit - 1, n_draft)))
+        self.metrics["spec_verify_blocks"] += 1
+        self.metrics["spec_verify_s"] += dt
+        self.metrics["spec_drafted"] += proposed
+        self.metrics["spec_accepted"] += accepted
+        self.metrics["spec_rolled_back"] += proposed - accepted
+        self.metrics["steps"] += 1  # one forward advanced every lane
+        for slot in snapshot:
+            if n_draft[slot]:
+                self._spec_emit_hist.observe(int(n_emit[slot]))
+                self.metrics["spec_tokens"] += int(n_emit[slot])
+        self._process_block(toks, snapshot, n_valid=n_emit)
+        return True
 
     def _admit_new(self, carry: GenRequest | None = None) -> bool:
         """Place queued requests into free slots. Returns True if inbox
@@ -695,24 +852,29 @@ class Scheduler:
         if first in self.engine.tokenizer.eos_ids:
             self._finish(slot, active, "stop", first, "")
             return
+        active.emitted = 1
+        self.metrics["tokens"] += 1
         # Finish before the first decode block if (a) the request's token
         # budget is already spent by the prefill token, or (b) the prompt is
-        # so long the cache can't absorb the TWO blocks that may be
-        # dispatched before this slot's tokens are next examined (one
-        # in-flight + one lookahead) — otherwise KV writes land past
-        # capacity (silently dropped scatters) and the client would stream
-        # garbage.
+        # so long the cache can't absorb the TWO dispatches that may land
+        # before this slot's tokens are next examined (one in-flight + one
+        # lookahead; each writes up to _max_block_writes positions) —
+        # otherwise KV writes land past capacity (silently dropped
+        # scatters) and the client would stream garbage.
         if (active.generated >= req.max_new_tokens
                 or active.prompt_len + active.generated
-                + 2 * self.engine.decode_block
+                + 2 * self._max_block_writes
                 > self.engine.slot_capacity + 1):
             text = active.decoder.push(first)
             self._finish(slot, active, "length", first, text)
             return
+        if self._drafter is not None and req.speculative is not False:
+            self._drafter.begin(slot, req.prompt_ids, first)
         text = active.decoder.push(first)
         if text:
             self._emit(active, TokenEvent(
                 text=text, token_id=first, tokens_generated=1,
+                tokens_emitted=1,
                 ttft_s=active.first_token_at - req.enqueued_at))
 
     def _finish(self, slot: int, active: _ActiveSlot, reason: str,
@@ -722,9 +884,12 @@ class Scheduler:
                 if active.first_token_at else None)
         self._emit(active, TokenEvent(
             text=tail, token_id=tok, done=True, finish_reason=reason,
-            ttft_s=ttft, tokens_generated=active.generated))
+            ttft_s=ttft, tokens_generated=active.generated,
+            tokens_emitted=active.emitted))
         del self._slots[slot]
         self._free.append(slot)
+        if self._drafter is not None:
+            self._drafter.release(slot)
         self.engine.release_slot(slot)
         self.metrics["evictions"] += 1
 
@@ -797,14 +962,16 @@ class AsyncSession:
         self._cancelled = True
 
     def submit(self, prompt_ids: list[int], sampling: SamplingParams,
-               max_new_tokens: int, request_id: str = "") -> None:
+               max_new_tokens: int, request_id: str = "",
+               speculative: bool | None = None) -> None:
         def emit(ev: TokenEvent) -> None:
             self._loop.call_soon_threadsafe(self._queue.put_nowait, ev)
 
         self._scheduler.submit(GenRequest(
             prompt_ids=prompt_ids, sampling=sampling,
             max_new_tokens=max_new_tokens, emit=emit,
-            cancelled=lambda: self._cancelled, id=request_id))
+            cancelled=lambda: self._cancelled, id=request_id,
+            speculative=speculative))
 
     async def events(self):
         while True:

@@ -23,6 +23,49 @@ from symmetry_tpu.ops.attention import NEG_INF
 SAMPLING_TOP_CAP = 64
 
 
+def _masked_top_logits(
+    logits: jnp.ndarray,        # [..., V] float
+    temperature: jnp.ndarray,   # [B] float; 0 => greedy
+    top_p: jnp.ndarray,         # [B] float in (0, 1]; 1 => disabled
+    top_k: jnp.ndarray,         # [B] int32; 0 => disabled
+    cap: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The shared sampling-distribution core: temperature-scaled logits
+    restricted to the top-`cap` window with the greedy/top-k/top-p keep
+    mask applied (NEG_INF elsewhere). Returns (masked [..., cap], vocab
+    indices [..., cap]). Factored out of sample_tokens so the speculative
+    verify pass (verify_tokens) scores drafts against EXACTLY the
+    distribution the decode path samples from — the acceptance rule is
+    only unbiased if the two share one definition of the target."""
+    extra = logits.ndim - 2  # broadcast per-slot controls over mid axes
+    ctl = (slice(None),) + (None,) * extra
+
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[ctl + (None,)]
+
+    # Partial sort: [..., cap] descending, with original vocab indices.
+    top_logits, top_idx = jax.lax.top_k(scaled, cap)
+
+    ranks = jnp.arange(cap, dtype=jnp.int32)
+    # top-k: keep ranks < k (0 disables; anything beyond cap acts as cap).
+    # Greedy (temperature == 0) is expressed as k = 1: with only rank 0
+    # unmasked, a categorical draw deterministically returns the argmax —
+    # one select lane, no separate greedy branch.
+    k = jnp.where(top_k > 0, top_k, cap)
+    k = jnp.where(temperature > 0, k, 1)
+    keep = ranks < k[ctl + (None,)]
+    # top-p: keep the smallest prefix whose probability mass reaches p.
+    # (Mass is computed over the top-cap window — the tail beyond cap is
+    # treated as zero, see module docstring.)
+    probs = jax.nn.softmax(top_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # token i is kept if the mass strictly before it is < p (always keeps rank 0)
+    mass_before = cum - probs
+    keep &= mass_before < top_p[ctl + (None,)]
+
+    return jnp.where(keep, top_logits, NEG_INF), top_idx
+
+
 def sample_tokens(
     logits: jnp.ndarray,        # [B, V] float
     key: jax.Array,             # PRNG key — scalar, or [B] per-slot keys
@@ -36,32 +79,8 @@ def sample_tokens(
     cap = min(cap, V)
     logits = logits.astype(jnp.float32)
 
-    # Scale by temperature (guard 0 to keep the math finite; the greedy lane
-    # is selected by the final where, not by this value).
-    safe_t = jnp.where(temperature > 0, temperature, 1.0)
-    scaled = logits / safe_t[:, None]
-
-    # Partial sort: [B, cap] descending, with original vocab indices.
-    top_logits, top_idx = jax.lax.top_k(scaled, cap)
-
-    ranks = jnp.arange(cap, dtype=jnp.int32)[None, :]
-    # top-k: keep ranks < k (0 disables; anything beyond cap acts as cap).
-    # Greedy (temperature == 0) is expressed as k = 1: with only rank 0
-    # unmasked, the categorical below deterministically returns the argmax —
-    # one select lane, no separate greedy branch.
-    k = jnp.where(top_k > 0, top_k, cap)
-    k = jnp.where(temperature > 0, k, 1)
-    keep = ranks < k[:, None]
-    # top-p: keep the smallest prefix whose probability mass reaches p.
-    # (Mass is computed over the top-cap window — the tail beyond cap is
-    # treated as zero, see module docstring.)
-    probs = jax.nn.softmax(top_logits, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    # token i is kept if the mass strictly before it is < p (always keeps rank 0)
-    mass_before = cum - probs
-    keep &= mass_before < top_p[:, None]
-
-    masked = jnp.where(keep, top_logits, NEG_INF)
+    masked, top_idx = _masked_top_logits(logits, temperature, top_p, top_k,
+                                         cap)
     if key.ndim:  # [B] per-slot keys: each row draws from its own stream
         choice_rank = jax.vmap(
             lambda k, row: jax.random.categorical(k, row))(key, masked)
@@ -69,3 +88,81 @@ def sample_tokens(
         choice_rank = jax.random.categorical(key, masked, axis=-1)  # [B]
     sampled = jnp.take_along_axis(top_idx, choice_rank[:, None], axis=-1)[:, 0]
     return sampled.astype(jnp.int32)
+
+
+def verify_tokens(
+    logits: jnp.ndarray,        # [B, S, V] float; S = 1 + k draft lanes
+    draft: jnp.ndarray,         # [B, k] int32 proposed tokens
+    n_draft: jnp.ndarray,       # [B] int32 valid proposals per slot (0..k)
+    key: jax.Array,             # [B] per-slot PRNG keys
+    temperature: jnp.ndarray,   # [B] float; 0 => greedy
+    top_p: jnp.ndarray,         # [B] float in (0, 1]
+    top_k: jnp.ndarray,         # [B] int32
+    cap: int = SAMPLING_TOP_CAP,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Speculative-decoding acceptance (Leviathan et al.; PAPERS.md) over
+    one batched verify forward. `logits[:, j]` is the target model's
+    next-token distribution given the context plus draft[:, :j] — the
+    verify pass fed [last_token, draft...] so position j scores proposal
+    draft[:, j] and position n_draft holds the all-accepted bonus.
+
+    Acceptance per slot: draft tokens are accepted left to right while
+    u_j < p_target(draft_j) with u_j ~ U[0,1) — the n-gram drafter is a
+    DETERMINISTIC proposer (q = point mass), for which this rule is the
+    standard rejection test. On the first rejection the bonus token is
+    drawn from the residual distribution (the target with the rejected
+    proposal removed, renormalized); with every proposal accepted it is
+    drawn from the target at the next position. Net effect: every emitted
+    token is distributed EXACTLY as sequential sampling from the same
+    masked distribution — greedy lanes (temperature 0 => a one-hot keep
+    set) accept iff the draft equals the argmax, making speculative
+    greedy output token-identical to plain decode.
+
+    Returns (out [B, S], n_emit [B]): out[b, :n_emit[b]] are the tokens
+    to emit this dispatch — n_emit-1 accepted drafts plus the bonus —
+    and n_emit is always >= 1, so a slot with no proposals advances
+    exactly like a plain decode step.
+    """
+    B, S, V = logits.shape
+    cap = min(cap, V)
+    logits = logits.astype(jnp.float32)
+
+    masked, top_idx = _masked_top_logits(logits, temperature, top_p, top_k,
+                                         cap)  # [B, S, cap] x2
+    p = jax.nn.softmax(masked, axis=-1)  # target probs over the keep set
+
+    # Probability the target assigns to each proposal (0 when the proposal
+    # is outside the top-cap keep window). Lane S-1 has no proposal — pad
+    # with zeros; the validity mask below keeps it out of the accept scan.
+    draft_ext = jnp.concatenate(
+        [draft, jnp.zeros((B, 1), draft.dtype)], axis=1)      # [B, S]
+    match = top_idx == draft_ext[:, :, None]                  # [B, S, cap]
+    p_draft = jnp.sum(jnp.where(match, p, 0.0), axis=-1)      # [B, S]
+
+    ks = jax.vmap(lambda q: jax.random.split(q, 3))(key)      # [B, 3]
+    u = jax.vmap(lambda q: jax.random.uniform(q, (S,)))(ks[:, 0])
+    lane = jnp.arange(S, dtype=jnp.int32)[None, :]
+    accept = (u < p_draft) & (lane < n_draft[:, None])        # [B, S]
+    # Longest accepted prefix: rejections (and the padded tail) stop it.
+    n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+
+    # Bonus-token candidates at every position, selected by n_acc below:
+    #  - residual: the target with the rejected proposal removed (softmax
+    #    over the remaining keep set renormalizes), for a mid-run stop;
+    #  - full: a plain target draw, for the all-proposals-accepted lane.
+    resid = jnp.where(match, NEG_INF, masked)
+    r_rank = jax.vmap(lambda q, row: jax.random.categorical(q, row))(
+        ks[:, 1], resid)                                      # [B, S]
+    f_rank = jax.vmap(lambda q, row: jax.random.categorical(q, row))(
+        ks[:, 2], masked)
+    r_tok = jnp.take_along_axis(top_idx, r_rank[..., None], -1)[..., 0]
+    f_tok = jnp.take_along_axis(top_idx, f_rank[..., None], -1)[..., 0]
+
+    stop = n_acc[:, None]
+    bonus_r = jnp.take_along_axis(r_tok, stop, axis=1)[:, 0]
+    bonus_f = jnp.take_along_axis(f_tok, stop, axis=1)[:, 0]
+    bonus = jnp.where(n_acc < n_draft, bonus_r, bonus_f)
+
+    out = jnp.where(lane < stop, draft_ext, 0)
+    out = jnp.where(lane == stop, bonus[:, None], out)
+    return out.astype(jnp.int32), (n_acc + 1).astype(jnp.int32)

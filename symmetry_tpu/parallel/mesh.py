@@ -18,7 +18,7 @@ name them (XLA treats size-1 axes as free).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 import numpy as np

@@ -34,6 +34,9 @@ class InferenceRequest:
     top_p: float | None = None
     top_k: int | None = None
     seed: int | None = None
+    # Speculative decoding override (tpu_native with tpu.speculative on):
+    # False opts this request out of drafting; None defers to the engine.
+    speculative: bool | None = None
 
 
 @dataclass(slots=True)
@@ -42,10 +45,12 @@ class StreamChunk:
     text: str         # extracted completion delta ("" for control chunks)
     done: bool = False
     # Tokens this chunk represents. Engine backends report the true count
-    # (a block-decode chunk carries many tokens); proxy backends leave 0
-    # and the provider falls back to chunk counting — the reference's
-    # accounting (one chunk ≈ one token, src/provider.ts:243-246).
-    tokens: int = 0
+    # (a block-decode chunk carries many tokens, a finish's flush tail may
+    # carry zero); proxy backends leave None and the provider falls back
+    # to chunk counting — the reference's accounting (one chunk ≈ one
+    # token, src/provider.ts:243-246). None and 0 differ on purpose:
+    # 0 is an exact "no new tokens", None is "unknown, estimate".
+    tokens: int | None = None
 
 
 class InferenceBackend(abc.ABC):

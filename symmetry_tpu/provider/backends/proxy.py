@@ -15,7 +15,6 @@ reference src/provider.ts:247) with the delta extracted once per chunk.
 
 from __future__ import annotations
 
-import json
 from typing import Any, AsyncIterator
 
 import aiohttp

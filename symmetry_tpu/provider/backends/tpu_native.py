@@ -16,7 +16,7 @@ import time
 import uuid
 from typing import Any, AsyncIterator
 
-from symmetry_tpu.engine.engine import EngineError, InferenceEngine, SamplingParams
+from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
 from symmetry_tpu.engine.scheduler import AsyncSession, Scheduler
 from symmetry_tpu.provider.backends.base import (
     BackendError,
@@ -357,7 +357,8 @@ class TpuNativeBackend(InferenceBackend):
         session = AsyncSession(self._scheduler,
                                loop=asyncio.get_running_loop())
         session.submit(prompt_ids, SamplingParams.from_request(request),
-                       max_new, request_id=request_id)
+                       max_new, request_id=request_id,
+                       speculative=request.speculative)
 
         def chunk_line(delta: dict, finish: str | None = None) -> str:
             return self._chunk_line(request_id, created, delta, finish)
@@ -369,10 +370,12 @@ class TpuNativeBackend(InferenceBackend):
                 if ev.error is not None:
                     raise BackendError(ev.error)
                 if ev.text:
-                    # exact token accounting: tokens_generated is
-                    # cumulative, a block chunk carries the delta
-                    n_new = max(ev.tokens_generated - reported, 0)
-                    reported = max(ev.tokens_generated, reported)
+                    # exact token accounting: tokens_emitted is the
+                    # cumulative streamed-token count, a block chunk
+                    # carries the delta (EOS and discarded post-finish
+                    # tokens never appear in it)
+                    n_new = max(ev.tokens_emitted - reported, 0)
+                    reported = max(ev.tokens_emitted, reported)
                     yield StreamChunk(raw=chunk_line({"content": ev.text}),
                                       text=ev.text, tokens=n_new)
                 if ev.done:
@@ -420,7 +423,9 @@ class TpuNativeBackend(InferenceBackend):
                              "top_p": (request.top_p
                                        if request.top_p is not None else 1.0),
                              "top_k": getattr(request, "top_k", None) or 0,
-                             "seed": request.seed}})
+                             "seed": request.seed},
+                **({"speculative": request.speculative}
+                   if request.speculative is not None else {})})
             t_submit = time.monotonic()
             yield StreamChunk(
                 raw=self._chunk_line(request_id, created,

@@ -58,6 +58,19 @@ class TpuConfig:
     # KV into the slot lane, prefilling only the uncached suffix. None/0
     # disables the cache entirely (no lookups, no extra warmup compiles).
     prefix_cache_mb: float | None = None
+    # Speculative decoding (engine/spec/): n-gram prompt-lookup drafting
+    # with batched block verification. None/False disables it entirely —
+    # the decode path and warmup compile set are then byte-identical to a
+    # build without the feature. True enables defaults; an int sets
+    # k_draft (draft tokens per slot per verify dispatch); a mapping may
+    # set {k_draft, ngram_max, ngram_min, max_index_tokens}. Helps
+    # workloads whose output
+    # repeats spans of their own context (code edits, RAG quoting,
+    # extractive answers); hurts incompressible chat — watch the
+    # acceptance_rate counter in stats. Greedy output is token-identical
+    # with the knob on or off; sampled lanes stay unbiased via rejection
+    # sampling. Per-request opt-out: "speculative": false on the request.
+    speculative: Any = None
     # Decode steps per device dispatch. 16 measured throughput-equal to
     # 64 at the llama3-8b/128-slot point (double-buffered dispatch hides
     # the round-trips) with ~2x lower TTFT and inter-chunk latency.

@@ -626,6 +626,7 @@ class SymmetryProvider:
         if shed_reason is not None:
             await self._shed(peer, tag, shed_reason)
             return
+        spec = data.get("speculative")
         request = InferenceRequest(
             messages=messages,
             max_tokens=data.get("max_tokens"),
@@ -633,6 +634,7 @@ class SymmetryProvider:
             top_p=data.get("top_p"),
             top_k=data.get("top_k"),
             seed=data.get("seed"),
+            speculative=spec if isinstance(spec, bool) else None,
         )
         self._in_flight += 1
         self._unstarted += 1
@@ -658,10 +660,12 @@ class SymmetryProvider:
                     break
                 if chunk.text:
                     completion_parts.append(chunk.text)
-                    # Engine backends report exact per-chunk token counts;
-                    # proxies leave 0 and we fall back to the reference's
-                    # one-chunk≈one-token accounting.
-                    n_tokens += chunk.tokens or 1
+                    # Engine backends report exact per-chunk token counts
+                    # (0 included — e.g. a finish flushing held-back
+                    # bytes); proxies leave None and we fall back to the
+                    # reference's one-chunk≈one-token accounting.
+                    n_tokens += (chunk.tokens if chunk.tokens is not None
+                                 else 1)
                     if first_token_s is None:
                         first_token_s = time.monotonic() - start
                         self.tracer.record("ttft", start, first_token_s,

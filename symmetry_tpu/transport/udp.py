@@ -27,7 +27,6 @@ from symmetry_tpu.transport.base import (
     Listener,
     Transport,
 )
-from symmetry_tpu.utils.logging import logger
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))

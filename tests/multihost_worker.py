@@ -27,7 +27,7 @@ def main() -> None:
     jax.config.update("jax_default_matmul_precision", "highest")
 
     from symmetry_tpu.parallel.multihost import (
-        Command, CMD_DECODE, CMD_PREFILL, CommandLoop, MultihostEngine,
+        CMD_DECODE, CMD_PREFILL, CommandLoop, MultihostEngine,
         init_distributed,
     )
 

@@ -51,6 +51,20 @@ def test_tpu_native_needs_no_api_fields():
     assert cfg.tpu.model_family == "llama"
 
 
+def test_speculative_knob_accepted():
+    cfg = ConfigManager(config={
+        "name": "tpu-node", "public": False, "serverKey": "cd" * 32,
+        "modelName": "llama3:8b", "apiProvider": "tpu_native",
+        "tpu": {"speculative": {"k_draft": 4}},
+    })
+    assert cfg.tpu.speculative == {"k_draft": 4}
+    # off by default — the engine builds no verify path then
+    assert ConfigManager(config={
+        "name": "t", "public": False, "serverKey": "cd" * 32,
+        "modelName": "m", "apiProvider": "tpu_native",
+    }).tpu.speculative is None
+
+
 def test_unknown_provider_rejected():
     with pytest.raises(ConfigError, match="apiProvider"):
         ConfigManager(config={**BASE, "apiProvider": "vllm"})

@@ -6,7 +6,6 @@ sequential forward loop, regardless of what the other slots are doing.
 """
 
 import asyncio
-import queue
 import threading
 
 import jax

@@ -18,7 +18,6 @@ from symmetry_tpu.client.client import (
     SymmetryClient,
 )
 from symmetry_tpu.identity import Identity
-from symmetry_tpu.protocol.keys import MessageKey
 from symmetry_tpu.provider.backends.base import InferenceBackend, StreamChunk
 from symmetry_tpu.provider.config import ConfigManager
 from symmetry_tpu.provider.provider import SymmetryProvider

@@ -38,12 +38,17 @@ class TestHostEmitBatch:
         host._reported = {"r1": 0, "r2": 0, "r3": 0}
         batch = [
             (make_req("r1"), TokenEvent(text="ab", token_id=98,
-                                        tokens_generated=9)),
+                                        tokens_generated=9,
+                                        tokens_emitted=9)),
+            # r2 stops on EOS: generated counts it, emitted does not —
+            # tokens_new must ride the emitted count.
             (make_req("r2"), TokenEvent(text="c", token_id=99,
-                                        tokens_generated=4, done=True,
+                                        tokens_generated=4,
+                                        tokens_emitted=3, done=True,
                                         finish_reason="stop")),
             (make_req("r3"), TokenEvent(text="", token_id=None,
-                                        tokens_generated=2, done=True,
+                                        tokens_generated=2,
+                                        tokens_emitted=1, done=True,
                                         finish_reason="error",
                                         error="boom")),
         ]
@@ -61,7 +66,8 @@ class TestHostEmitBatch:
         assert e1 == {"id": "r1", "text": "ab", "tokens": 9,
                       "tokens_new": 9}
         assert e2["done"] and e2["finish_reason"] == "stop"
-        assert e2["tokens_new"] == 4
+        assert e2["tokens"] == 4       # generated keeps the EOS…
+        assert e2["tokens_new"] == 3   # …streamed-token deltas do not
         assert e3["finish_reason"] == "error" and e3["error"] == "boom"
         # done events retire their delta bookkeeping
         assert host._reported == {"r1": 9}
@@ -70,7 +76,8 @@ class TestHostEmitBatch:
         host = EngineHost(config=None)
         host._reported = {"r1": 3}
         host._emit_batch([(make_req("r1"), TokenEvent(
-            text="d", token_id=100, tokens_generated=5))])
+            text="d", token_id=100, tokens_generated=5,
+            tokens_emitted=5))])
         frame = json.loads(capsys.readouterr().out)
         assert frame["op"] == "event"  # wire-compatible with old readers
         assert frame["tokens_new"] == 2  # cumulative 5 - reported 3

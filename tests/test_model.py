@@ -13,7 +13,6 @@ import pytest
 
 from symmetry_tpu.engine.weights import convert_hf_state_dict
 from symmetry_tpu.models import (
-    KVCache,
     forward,
     init_cache,
     init_params,

@@ -105,10 +105,12 @@ class TestBatchedBlockEmit:
         for _req, ev in block_batch:
             assert ev.text == "b" * 8
             assert ev.tokens_generated == 9  # 1 (prefill) + 8 (block)
+            assert ev.tokens_emitted == 9    # all 9 streamed as text
             assert not ev.done
         assert sched.metrics["emit_flushes"] == 2
         assert sched.metrics["emit_events"] == 6
-        assert sched.metrics["tokens"] == 24
+        # tokens counts EMITTED tokens: 3 activation firsts + 24 block
+        assert sched.metrics["tokens"] == 27
 
     def test_eos_mid_block_finishes_and_discards_remainder(self):
         eng = FakeEngine(slots=2, block=8)
@@ -126,9 +128,15 @@ class TestBatchedBlockEmit:
         assert ev0.done and ev0.finish_reason == "stop"
         assert ev0.text == "bbb"          # tokens past the EOS discarded
         assert ev0.tokens_generated == 5  # 1 + 3 text + the EOS token
+        assert ev0.tokens_emitted == 4    # …but only 4 ever streamed
         (ev1,) = events_of(batches[-1], "r1")
         assert not ev1.done and ev1.text == "b" * 8
         assert slot0 in eng.released and slot0 in sched._free
+        # The 4 tokens the block produced past r0's EOS (and the EOS
+        # itself) are discarded AND uncounted: 2 activation firsts +
+        # 3 pushed for r0 + 8 for r1 — the number that matches what a
+        # client could actually stream (bench tokens_streamed).
+        assert sched.metrics["tokens"] == 13
 
     def test_token_budget_finishes_mid_block(self):
         eng = FakeEngine(slots=1, block=8)

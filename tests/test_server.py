@@ -1,6 +1,5 @@
 """Server: registry semantics, session tokens, load balancing."""
 
-import time
 
 from symmetry_tpu.identity import Identity
 from symmetry_tpu.server import tokens

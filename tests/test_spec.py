@@ -1,0 +1,461 @@
+"""Speculative decoding subsystem (engine/spec/ + verify path).
+
+Three layers, each tested at its own seam:
+
+  - NGramDrafter: prompt-lookup proposal rules on plain lists (no JAX).
+  - ops/sampling.verify_tokens + engine.verify_step: device-side
+    acceptance — greedy lanes must reproduce the plain decode chain
+    token-for-token whatever the drafter proposed.
+  - Scheduler integration: the hard decode-equivalence requirement —
+    greedy output with tpu.speculative ON is token-identical to OFF —
+    plus ragged accepted-runs through the EOS/budget scan, counters, and
+    the off-by-default contract (no drafter, no verify jit, no metrics).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symmetry_tpu.engine.engine import EngineError, InferenceEngine, SamplingParams
+from symmetry_tpu.engine.scheduler import GenRequest, Scheduler
+from symmetry_tpu.engine.spec import NGramDrafter, SpecConfig
+from symmetry_tpu.engine.tokenizer import ByteTokenizer
+from symmetry_tpu.models import init_params, preset
+from symmetry_tpu.ops.sampling import sample_tokens, verify_tokens
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = preset("tiny")
+    params = init_params(cfg, jax.random.key(0), jnp.float32)
+    return cfg, params
+
+
+def make_engine(cfg, params, spec=None, slots=2, seq=128, block=4):
+    return InferenceEngine(cfg, params, ByteTokenizer(), max_slots=slots,
+                           max_seq_len=seq, prefill_buckets=(16, 32),
+                           cache_dtype=jnp.float32, decode_block=block,
+                           speculative=spec)
+
+
+class TestSpecConfig:
+    def test_knob_parsing(self):
+        assert SpecConfig.from_knob(None) is None
+        assert SpecConfig.from_knob(False) is None
+        assert SpecConfig.from_knob(0) is None
+        assert SpecConfig.from_knob(True) == SpecConfig()
+        assert SpecConfig.from_knob(4).k_draft == 4
+        parsed = SpecConfig.from_knob({"k_draft": 6, "ngram_max": 2})
+        assert parsed.k_draft == 6 and parsed.ngram_max == 2
+        with pytest.raises(ValueError, match="unknown"):
+            SpecConfig.from_knob({"bogus": 1})
+        with pytest.raises(ValueError):
+            SpecConfig.from_knob("yes")
+        with pytest.raises(ValueError):
+            SpecConfig(k_draft=0)
+        with pytest.raises(ValueError):
+            SpecConfig(ngram_min=3, ngram_max=2)
+
+
+class TestNGramDrafter:
+    def test_no_match_proposes_nothing(self):
+        d = NGramDrafter(SpecConfig(k_draft=4))
+        d.begin(0, [1, 2, 3, 4, 5], 6)  # all tokens distinct
+        assert d.propose(0) == []
+
+    def test_matches_prior_occurrence(self):
+        d = NGramDrafter(SpecConfig(k_draft=4, ngram_max=3))
+        # context: 7 8 9 50 7 8 9 — suffix (7,8,9) recurs at the start,
+        # so the draft is what followed it: 50 7 8 9.
+        d.begin(0, [7, 8, 9, 50, 7, 8], 9)
+        assert d.propose(0) == [50, 7, 8, 9]
+
+    def test_longest_ngram_wins(self):
+        d = NGramDrafter(SpecConfig(k_draft=2, ngram_max=2, ngram_min=1))
+        # (5, 6) occurred with continuation (70, ...); a 1-gram (6,)
+        # also occurred with continuation 80 — the 2-gram must win.
+        d.begin(0, [5, 6, 70, 6, 80, 5], 6)
+        assert d.propose(0) == [70, 6]
+
+    def test_period_one_loop_drafts_full_width(self):
+        """A token loop's newest prior occurrences sit inside the tail —
+        the occurrence history must still supply a full k_draft run."""
+        d = NGramDrafter(SpecConfig(k_draft=5, ngram_max=3))
+        d.begin(0, [9] * 12, 9)
+        assert d.propose(0) == [9] * 5
+
+    def test_extend_and_release(self):
+        d = NGramDrafter(SpecConfig(k_draft=3))
+        d.begin(0, [1, 2, 3], 4)
+        assert d.propose(0) == []
+        d.extend(0, [1, 2, 3])  # suffix (1,2,3)... wait: ctx 1 2 3 4 1 2 3
+        assert d.propose(0) == [4, 1, 2]
+        d.release(0)
+        assert d.propose(0) == []
+        d.extend(0, [1, 2, 3])  # released slot: extend is a no-op
+        assert d.propose(0) == []
+
+    def test_long_prompt_indexing_is_bounded(self):
+        """Admission indexing runs on the serving thread: a long prompt
+        indexes only its last max_index_tokens — a match living solely
+        in the dropped head is forfeited, one in the kept tail works."""
+        d = NGramDrafter(SpecConfig(k_draft=3, max_index_tokens=16))
+        head = [71, 72, 73, 74] + [200 + i for i in range(40)]
+        d.begin(0, head + [5, 6, 7, 50, 51, 52, 5, 6], 7)
+        assert len(d._ctx[0]) <= 17  # 16 prompt tail + first token
+        assert d.propose(0) == [50, 51, 52]  # tail match still drafts
+        d.extend(0, [71, 72])  # head-only ngram (71,72) has no match
+        assert d.propose(0) == []
+
+    def test_slots_are_independent(self):
+        d = NGramDrafter(SpecConfig(k_draft=2))
+        d.begin(0, [1, 1, 1, 1, 1], 1)
+        d.begin(1, [2, 3, 4], 5)
+        assert d.propose(0) == [1, 1]
+        assert d.propose(1) == []
+
+
+class TestVerifyTokens:
+    """Acceptance math at the sampling-op level (no engine)."""
+
+    def _dists(self, B, S, V, seed=0):
+        logits = jax.random.normal(jax.random.key(seed), (B, S, V)) * 3.0
+        return jnp.asarray(logits, jnp.float32)
+
+    def test_greedy_accepts_exactly_matching_prefix(self):
+        B, k, V = 3, 4, 50
+        logits = self._dists(B, 1 + k, V)
+        greedy = np.asarray(jnp.argmax(logits, -1))  # [B, S]
+        draft = np.zeros((B, k), np.int32)
+        # row 0: all correct; row 1: wrong at position 2; row 2: no drafts
+        draft[0] = greedy[0, :k]
+        draft[1] = greedy[1, :k]
+        draft[1, 2] = (draft[1, 2] + 1) % V
+        n_draft = np.array([k, k, 0], np.int32)
+        out, n_emit = verify_tokens(
+            logits, jnp.asarray(draft), jnp.asarray(n_draft),
+            jax.random.split(jax.random.key(1), B),
+            jnp.zeros((B,)), jnp.ones((B,)), jnp.zeros((B,), jnp.int32))
+        out, n_emit = np.asarray(out), np.asarray(n_emit)
+        assert n_emit.tolist() == [k + 1, 3, 1]
+        # every emitted token is the greedy chain token at its position
+        for b in range(B):
+            for j in range(n_emit[b]):
+                assert out[b, j] == greedy[b, j]
+
+    def test_zero_draft_matches_sample_tokens_greedy(self):
+        """A no-proposal slot must advance exactly like a decode step."""
+        B, V = 4, 32
+        logits = self._dists(B, 1, V, seed=7)
+        keys = jax.random.split(jax.random.key(2), B)
+        want = np.asarray(sample_tokens(
+            logits[:, 0], keys, jnp.zeros((B,)), jnp.ones((B,)),
+            jnp.zeros((B,), jnp.int32)))
+        out, n_emit = verify_tokens(
+            logits, jnp.zeros((B, 0), jnp.int32),
+            jnp.zeros((B,), jnp.int32), keys,
+            jnp.zeros((B,)), jnp.ones((B,)), jnp.zeros((B,), jnp.int32))
+        assert np.asarray(n_emit).tolist() == [1] * B
+        assert np.asarray(out)[:, 0].tolist() == want.tolist()
+
+    def test_sampled_lane_emits_kept_tokens_only(self):
+        """Temperature/top-k lanes: every emitted token must come from
+        the top-k keep set (the masked target distribution)."""
+        B, k, V = 8, 3, 64
+        logits = self._dists(B, 1 + k, V, seed=3)
+        top2 = np.asarray(jax.lax.top_k(logits, 2)[1])  # [B, S, 2]
+        draft = np.asarray(
+            jax.random.randint(jax.random.key(4), (B, k), 0, V), np.int32)
+        out, n_emit = verify_tokens(
+            logits, jnp.asarray(draft), jnp.full((B,), k, jnp.int32),
+            jax.random.split(jax.random.key(5), B),
+            jnp.full((B,), 0.8), jnp.ones((B,)),
+            jnp.full((B,), 2, jnp.int32))
+        out, n_emit = np.asarray(out), np.asarray(n_emit)
+        for b in range(B):
+            for j in range(n_emit[b]):
+                assert out[b, j] in top2[b, j], (b, j)
+
+
+class TestEngineVerify:
+    def test_verify_step_reproduces_greedy_chain(self, setup):
+        """Greedy + speculation must be token-identical to plain decode,
+        for correct drafts, garbage drafts, and no drafts alike."""
+        cfg, params = setup
+        plain = make_engine(cfg, params, block=1)
+        prompt = list(b"verify chain")
+        ref = [plain.prefill_and_insert(0, prompt, SamplingParams())]
+        for _ in range(11):
+            ref.append(int(plain.decode_step()[0]))
+
+        spec = SpecConfig(k_draft=4)
+        eng = make_engine(cfg, params, spec=spec, block=1)
+        got = [eng.prefill_and_insert(0, prompt, SamplingParams())]
+        variants = [lambda nxt: (nxt, len(nxt)),            # true drafts
+                    lambda nxt: ([1, 2, 3, 4], 4),          # garbage
+                    lambda nxt: ([], 0)]                    # none
+        i = 0
+        while len(got) < 12:
+            draft = np.zeros((2, 4), np.int32)
+            n_draft = np.zeros((2,), np.int32)
+            prop, n = variants[i % 3](ref[len(got):len(got) + 4])
+            draft[0, :len(prop)] = prop
+            n_draft[0] = n
+            toks, n_emit = eng.verify_step(draft, n_draft)
+            got.extend(int(toks[j, 0]) for j in range(int(n_emit[0])))
+            i += 1
+        assert got[:12] == ref
+
+    def test_verify_interleaves_with_decode_blocks(self, setup):
+        """Cache-length rollback: a rejected tail must leave the slot in
+        a state plain block decode continues correctly from."""
+        cfg, params = setup
+        plain = make_engine(cfg, params, block=1)
+        prompt = list(b"mixed mode")
+        ref = [plain.prefill_and_insert(0, prompt, SamplingParams())]
+        for _ in range(8):
+            ref.append(int(plain.decode_step()[0]))
+
+        eng = make_engine(cfg, params, spec=SpecConfig(k_draft=4), block=2)
+        got = [eng.prefill_and_insert(0, prompt, SamplingParams())]
+        draft = np.zeros((2, 4), np.int32)
+        draft[0] = [9, 9, 9, 9]  # all rejected -> rollback to +1
+        toks, n_emit = eng.verify_step(draft, np.array([4, 0], np.int32))
+        got.extend(int(toks[j, 0]) for j in range(int(n_emit[0])))
+        blk = eng.decode_steps()  # plain block rides the rolled-back cache
+        got.extend(int(t) for t in blk[:, 0])
+        draft[0] = ref[len(got):len(got) + 4]
+        toks, n_emit = eng.verify_step(draft, np.array([4, 0], np.int32))
+        got.extend(int(toks[j, 0]) for j in range(int(n_emit[0])))
+        assert got[:9] == ref
+
+    def test_disabled_engine_has_no_verify_path(self, setup):
+        cfg, params = setup
+        eng = make_engine(cfg, params)
+        assert eng.spec is None
+        assert not hasattr(eng, "_verify")
+        with pytest.raises(EngineError, match="not enabled"):
+            eng.verify_step(np.zeros((2, 4), np.int32),
+                            np.zeros((2,), np.int32))
+
+    def test_warmup_compiles_verify_only_when_enabled(self, setup):
+        cfg, params = setup
+        eng = make_engine(cfg, params, spec=SpecConfig(k_draft=2))
+        eng.warmup()  # must include the verify shape — no compile below
+        prompt = list(b"after warmup")
+        plain = make_engine(cfg, params, block=1)
+        ref = [plain.prefill_and_insert(0, prompt, SamplingParams())]
+        for _ in range(3):
+            ref.append(int(plain.decode_step()[0]))
+        got = [eng.prefill_and_insert(0, prompt, SamplingParams())]
+        draft = np.zeros((2, 2), np.int32)
+        draft[0] = ref[1:3]
+        toks, n_emit = eng.verify_step(draft, np.array([2, 0], np.int32))
+        got.extend(int(toks[j, 0]) for j in range(int(n_emit[0])))
+        assert got == ref[:len(got)]
+
+    def test_k_draft_must_fit_context(self, setup):
+        cfg, params = setup
+        with pytest.raises(EngineError, match="k_draft"):
+            make_engine(cfg, params, spec=SpecConfig(k_draft=256), seq=64)
+
+
+def run_scheduler_requests(engine, requests):
+    sched = Scheduler(engine, debug_invariants=True)
+    results = {i: [] for i in range(len(requests))}
+    done = {i: threading.Event() for i in range(len(requests))}
+    for i, (ids, sampling, max_new) in enumerate(requests):
+        def emit(ev, i=i):
+            results[i].append(ev)
+            if ev.done:
+                done[i].set()
+        sched.submit(GenRequest(prompt_ids=ids, sampling=sampling,
+                                max_new_tokens=max_new, emit=emit,
+                                id=f"r{i}"))
+    sched.start()
+    for ev in done.values():
+        assert ev.wait(120), "request did not complete"
+    sched.stop()
+    return results, sched
+
+
+def cycling_params(params):
+    """Bias the LM head so greedy generation settles into one token —
+    the n-gram drafter then matches constantly, exercising the verify
+    path instead of the plain-block fallback."""
+    lm = np.array(params["lm_head"])
+    lm[:, 120] = 10.0
+    out = dict(params)
+    out["lm_head"] = jnp.asarray(lm)
+    return out
+
+
+class TestSchedulerSpeculative:
+    def test_greedy_token_identical_on_off(self, setup):
+        """THE acceptance gate: tpu.speculative on => greedy output
+        byte-identical to off, with verify blocks actually exercised."""
+        cfg, params = setup
+        biased = cycling_params(params)
+        prompts = [list(b"spec request one"), list(b"two!")]
+        reqs = [(p, SamplingParams(), 30) for p in prompts]
+
+        off, _ = run_scheduler_requests(make_engine(cfg, biased), reqs)
+        on, sched = run_scheduler_requests(
+            make_engine(cfg, biased, spec=SpecConfig(k_draft=4)), reqs)
+        for i in range(len(prompts)):
+            assert ("".join(ev.text for ev in on[i])
+                    == "".join(ev.text for ev in off[i]))
+            assert (on[i][-1].tokens_generated
+                    == off[i][-1].tokens_generated)
+            assert on[i][-1].finish_reason == off[i][-1].finish_reason
+        spec = sched.stats()["speculative"]
+        assert spec["verify_blocks"] > 0
+        assert spec["accepted"] > 0
+        assert spec["drafted"] == spec["accepted"] + spec["rolled_back"]
+        assert 0.0 <= spec["acceptance_rate"] <= 1.0
+        # emitted-token accounting matches across modes too
+        assert (sched.metrics["tokens"]
+                == sum(on[i][-1].tokens_emitted for i in on))
+
+    def test_no_proposals_keeps_overlapped_plain_path(self, setup):
+        """Knob on but traffic that never drafts (random tiny-model
+        output): every block must go through the plain double-buffered
+        dispatch — zero verify dispatches, zero early syncs forced by
+        the drafter (the peek predicts no proposal)."""
+        cfg, params = setup
+        engine = make_engine(cfg, params, spec=SpecConfig(k_draft=4))
+        prompt = list(b"abcdefgh")  # distinct ids; generation is diverse
+        on, sched = run_scheduler_requests(
+            engine, [(prompt, SamplingParams(), 16)])
+        off, _ = run_scheduler_requests(
+            make_engine(cfg, params), [(prompt, SamplingParams(), 16)])
+        assert ("".join(ev.text for ev in on[0])
+                == "".join(ev.text for ev in off[0]))
+        # No (or almost no) verify work: the plain path carried the run.
+        assert sched.metrics["spec_drafted"] <= 4
+
+    def test_off_means_off(self, setup):
+        """Engine without the knob: no drafter, no spec stats block."""
+        cfg, params = setup
+        results, sched = run_scheduler_requests(
+            make_engine(cfg, params),
+            [(list(b"plain"), SamplingParams(), 8)])
+        assert sched._drafter is None
+        assert "speculative" not in sched.stats()
+        assert sched.metrics["spec_verify_blocks"] == 0
+
+    def test_per_request_opt_out(self, setup):
+        """speculative=False requests never enter the drafter even when
+        the engine knob is on."""
+        cfg, params = setup
+        biased = cycling_params(params)
+        engine = make_engine(cfg, biased, spec=SpecConfig(k_draft=4))
+        sched = Scheduler(engine, debug_invariants=True)
+        evs, done = [], threading.Event()
+
+        def emit(ev):
+            evs.append(ev)
+            if ev.done:
+                done.set()
+
+        sched.submit(GenRequest(
+            prompt_ids=list(b"opted out"), sampling=SamplingParams(),
+            max_new_tokens=24, emit=emit, id="o", speculative=False))
+        sched.start()
+        assert done.wait(120)
+        sched.stop()
+        assert evs[-1].done
+        assert sched.metrics["spec_verify_blocks"] == 0
+        assert sched.stats()["speculative"]["drafted"] == 0
+
+    def test_eos_inside_accepted_run_finishes_stream(self, setup):
+        """An EOS accepted mid-proposal must finish the stream at the
+        EOS, discarding the accepted remainder — same rule as EOS inside
+        a plain block."""
+        cfg, params = setup
+        eos = ByteTokenizer().EOS
+        lm = np.array(params["lm_head"])
+        lm[:, eos] = 10.0  # greedy emits EOS forever
+        biased = dict(params)
+        biased["lm_head"] = jnp.asarray(lm)
+        results, _ = run_scheduler_requests(
+            make_engine(cfg, biased, spec=SpecConfig(k_draft=4)),
+            [(list(b"stop it"), SamplingParams(), 50)])
+        last = results[0][-1]
+        assert last.done and last.finish_reason == "stop"
+        ref, _ = run_scheduler_requests(
+            make_engine(cfg, biased),
+            [(list(b"stop it"), SamplingParams(), 50)])
+        assert last.tokens_generated == ref[0][-1].tokens_generated
+
+    def test_budget_finish_with_speculation(self, setup):
+        """max_new_tokens lands mid-accepted-run: finish as length with
+        the exact budgeted count, like the plain-block budget scan."""
+        cfg, params = setup
+        biased = cycling_params(params)
+        for budget in (7, 10):
+            on, _ = run_scheduler_requests(
+                make_engine(cfg, biased, spec=SpecConfig(k_draft=4)),
+                [(list(b"budget"), SamplingParams(), budget)])
+            off, _ = run_scheduler_requests(
+                make_engine(cfg, biased),
+                [(list(b"budget"), SamplingParams(), budget)])
+            assert on[0][-1].tokens_generated == budget
+            assert ("".join(ev.text for ev in on[0])
+                    == "".join(ev.text for ev in off[0]))
+
+    def test_backend_from_config_knob(self):
+        """tpu.speculative flows provider.yaml → from_tpu_config →
+        engine → scheduler drafter, through the inproc backend; warmup
+        covers the verify shape; streaming works end to end."""
+        import asyncio
+
+        from symmetry_tpu.provider.backends.base import InferenceRequest
+        from symmetry_tpu.provider.backends.tpu_native import (
+            TpuNativeBackend)
+        from symmetry_tpu.provider.config import ConfigManager
+
+        cfg_mgr = ConfigManager(config={
+            "name": "t", "public": False, "serverKey": "00" * 32,
+            "modelName": "tiny-test", "apiProvider": "tpu_native",
+            "tpu": {"model_preset": "tiny", "dtype": "float32",
+                    "max_batch_size": 2, "max_seq_len": 64,
+                    "prefill_buckets": [16, 32],
+                    "engine_isolation": "inproc",
+                    "speculative": {"k_draft": 3}},
+        })
+
+        async def drive():
+            backend = TpuNativeBackend(cfg_mgr)
+            await backend.start()
+            assert backend._engine.spec is not None
+            assert backend._engine.spec.k_draft == 3
+            assert backend._scheduler._drafter is not None
+            text = []
+            async for ch in backend.stream(InferenceRequest(
+                    messages=[{"role": "user", "content": "ping"}],
+                    max_tokens=5)):
+                text.append(ch.text)
+            stats = backend._scheduler.stats()
+            assert "speculative" in stats
+            await backend.stop()
+            return "".join(text)
+
+        assert asyncio.run(asyncio.wait_for(drive(), 180)) is not None
+
+    def test_seeded_sampled_stream_completes(self, setup):
+        """Temperature lanes under speculation: the stream completes and
+        every token is finite/valid (unbiasedness is the math's job —
+        ops-level tests pin the keep-set property)."""
+        cfg, params = setup
+        biased = cycling_params(params)
+        results, sched = run_scheduler_requests(
+            make_engine(cfg, biased, spec=SpecConfig(k_draft=4)),
+            [(list(b"sampled"), SamplingParams(temperature=0.9, seed=3),
+              24)])
+        last = results[0][-1]
+        assert last.done and last.finish_reason in ("length", "stop")
+        assert last.tokens_generated == 24

@@ -59,3 +59,73 @@ class TestStreamDecoder:
             dec.push(i)
         # Window is [prefix:], which must have stayed bounded.
         assert len(dec._ids) - dec._prefix <= 4
+
+
+class TestPushManyBlockBoundaries:
+    """push_many under the BLOCK emit path: multi-byte UTF-8 sequences
+    split across decode-block boundaries — and across the ragged run
+    sizes a speculative verify dispatch produces (1..1+k tokens per
+    dispatch, a rollback shrinking a run to a single token) — must
+    stream byte-identically to one-token-at-a-time decoding, holding
+    partial codepoints back and never emitting a replacement char
+    mid-stream."""
+
+    # 1-, 2-, 3-, and 4-byte codepoints interleaved with ASCII.
+    TEXT = "aé✓🌍xé🌍b✓✓é🌍🌍c"
+
+    @staticmethod
+    def _chunks(ids, sizes):
+        """Split ids into runs of the given sizes, cycling."""
+        out, i, s = [], 0, 0
+        while i < len(ids):
+            n = sizes[s % len(sizes)]
+            out.append(ids[i:i + n])
+            i += n
+            s += 1
+        return out
+
+    def _assert_stream_equal(self, sizes):
+        tok = ByteTokenizer()
+        ids = tok.encode(self.TEXT, bos=False)
+        ref_dec = StreamDecoder(tok)
+        ref_pieces = [ref_dec.push(i) for i in ids]
+        ref = "".join(ref_pieces) + ref_dec.flush()
+
+        dec = StreamDecoder(tok)
+        pieces = [dec.push_many(run) for run in self._chunks(ids, sizes)]
+        got = "".join(pieces) + dec.flush()
+        assert got == ref == self.TEXT
+        # Mid-stream pieces never carry a replacement char: incomplete
+        # codepoints are held back, not mangled.
+        assert all("�" not in p for p in pieces)
+
+    def test_fixed_block_boundaries(self):
+        """Plain decode blocks: every fixed run size must split at least
+        one multi-byte codepoint across a boundary."""
+        for size in (1, 2, 3, 4, 5, 7):
+            self._assert_stream_equal([size])
+
+    def test_speculative_ragged_runs(self):
+        """Verify-dispatch shapes: accepted-run lengths vary dispatch to
+        dispatch (full acceptance, partial, total rollback to 1)."""
+        self._assert_stream_equal([5, 1, 3, 1, 1, 4, 2])
+
+    def test_rollback_to_single_token_mid_codepoint(self):
+        """A speculative rollback landing mid-codepoint: the 4-byte 🌍
+        arrives as 2 + 1 + 1 tokens across three dispatches and must
+        emit exactly once, complete, on the final one."""
+        tok = ByteTokenizer()
+        ids = tok.encode("🌍", bos=False)
+        assert len(ids) == 4
+        dec = StreamDecoder(tok)
+        assert dec.push_many(ids[:2]) == ""
+        assert dec.push_many([ids[2]]) == ""
+        assert dec.push_many([ids[3]]) == "🌍"
+        assert dec.flush() == ""
+
+    def test_empty_run_is_noop(self):
+        """A slot whose whole run was discarded pushes nothing."""
+        tok = ByteTokenizer()
+        dec = StreamDecoder(tok)
+        assert dec.push_many([]) == ""
+        assert dec.push_many(tok.encode("é", bos=False)) == "é"

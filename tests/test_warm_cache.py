@@ -7,7 +7,6 @@ same shardings — and be strictly advisory: absent/corrupt caches fall
 back to the cold path.
 """
 
-import json
 import os
 
 import jax

@@ -15,7 +15,6 @@ image and never touches the network.
 
 import json
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
