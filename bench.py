@@ -80,7 +80,7 @@ async def _provider_process(cfg: dict, server, model_name: str, *,
 def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
               max_seq: int, dtype_name: str, mesh_model: int,
               block: int = 1, quant: str | None = None,
-              kv_quant: bool = False) -> dict:
+              kv_quant: bool = False, fused_dequant: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -114,7 +114,8 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
     engine = InferenceEngine(
         config, params, ByteTokenizer(), mesh=mesh, max_slots=slots,
         max_seq_len=max_seq, prefill_buckets=(prompt_len,),
-        cache_dtype=dtype, decode_block=block, kv_quant=kv_quant)
+        cache_dtype=dtype, decode_block=block, kv_quant=kv_quant,
+        fused_dequant=fused_dequant)
 
     # Compile the decode program BEFORE inserting real requests (warmup's
     # garbage device writes are only harmless pre-insert).
@@ -153,7 +154,14 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
     dtype_label = f"{dtype_name}+{quant}" if quant else dtype_name
     if kv_quant:
         dtype_label += "+kv8"
+    if fused_dequant:
+        dtype_label += "+fused"
     dtype_name = dtype_label
+    # Convert-wall accounting: the weight bytes every decode step streams
+    # and the effective HBM rate they moved at — the number the fused-
+    # dequant A/B exists to raise (BASELINE.md decode-floor section).
+    step_s = dt / done_steps
+    weight_bytes = engine.weight_stream_bytes()
     return {
         "metric": f"aggregate decode tok/s ({preset_name} {dtype_name}, "
                   f"{slots} slots, block {block}, "
@@ -163,7 +171,9 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
         "vs_baseline": round(tok_s / 2000.0, 3),
         "per_slot_tok_s": round(tok_s / slots, 1),
         "prefill_s_per_slot": round(prefill_s / slots, 3),
-        "decode_step_ms": round(1e3 * dt / done_steps, 2),
+        "decode_step_ms": round(1e3 * step_s, 2),
+        "weight_bytes_per_step": weight_bytes,
+        "weight_stream_gbs": round(weight_bytes / step_s / 1e9, 1),
     }
 
 
@@ -277,7 +287,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             max_ttft_s: float | None = None, client_procs: int = 1,
             shared_prefix: bool = False,
             prefix_cache_mb: float | None = None,
-            speculative: bool = False, draft_k: int = 8) -> dict:
+            speculative: bool = False, draft_k: int = 8,
+            fused_dequant: bool = False) -> dict:
     """The NORTH-STAR measurement (BASELINE.json metric): aggregate WIRE
     tok/s and p50/p99 TTFT through the full serving path — server +
     tpu_native provider + N concurrent streaming clients over TCP
@@ -342,6 +353,7 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                    if prefix_cache_mb else {}),
                 **({"speculative": {"k_draft": draft_k}}
                    if speculative else {}),
+                **({"fused_dequant": True} if fused_dequant else {}),
             },
         }
         # Provider log is ALWAYS captured (round-3 verdict #1: a 6-line
@@ -714,6 +726,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
         dtype_label = f"{dtype_name}+{quant}" if quant else dtype_name
         if kv_quant:
             dtype_label += "+kv8"
+        if fused_dequant:
+            dtype_label += "+fused"
 
         # ------------------------------------------------------------------
         # Per-phase breakdown (round-3 verdict #1): the capture must carry
@@ -798,6 +812,20 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                     f"({diag.get('wire_coalesced_frames')} corked)")
             if emit_parts:
                 print("[bench] emit path: " + " | ".join(emit_parts),
+                      file=sys.stderr)
+            # Convert-wall metrics (scheduler stats): per-step decode
+            # wall + the weight bytes it streams — the decode-floor
+            # number now lands in every BENCH_r*.json engine block, not
+            # only the engine-only bench (fused-dequant A/B reads it).
+            for key in ("decode_step_ms", "weight_bytes_per_step",
+                        "weight_stream_gbs"):
+                if engine_stats.get(key) is not None:
+                    diag[key] = engine_stats[key]
+            if diag.get("decode_step_ms") is not None:
+                wb = diag.get("weight_bytes_per_step") or 0
+                print(f"[bench] decode step {diag['decode_step_ms']} ms | "
+                      f"weight stream {wb / 1e6:.0f} MB/step @ "
+                      f"{diag.get('weight_stream_gbs')} GB/s effective",
                       file=sys.stderr)
             print(
                 "[bench] engine: "
@@ -1194,6 +1222,14 @@ def main() -> None:
                     help="weight quantization")
     ap.add_argument("--kv-quant", default="int8", choices=("none", "int8"),
                     help="KV cache quantization")
+    ap.add_argument("--fused-dequant", action="store_true",
+                    help="route int8 weight matmuls through the W8A16 "
+                         "fused-dequant Pallas kernel (tpu.fused_dequant): "
+                         "weights pre-packed to the kernel tile layout, "
+                         "dequantized in VMEM inside the double-buffered "
+                         "DMA/matmul pipeline. The convert-wall A/B is "
+                         "this flag on vs off at otherwise identical "
+                         "settings (BASELINE.md decode-floor section)")
     ap.add_argument("--max-queue", type=int, default=None,
                     help="requests allowed to queue beyond the decode "
                          "slots before the provider sheds with a busy "
@@ -1264,7 +1300,8 @@ def main() -> None:
                          dtype_name=args.dtype, mesh_model=args.mesh_model,
                          block=64 if user_block is None else user_block,
                          quant=None if args.quant == "none" else args.quant,
-                         kv_quant=args.kv_quant == "int8")
+                         kv_quant=args.kv_quant == "int8",
+                         fused_dequant=args.fused_dequant)
 
     if args.smoke:
         # Smoke mode must not touch a TPU: pin the CPU backend before any
@@ -1306,7 +1343,8 @@ def main() -> None:
                 max_ttft_s=args.max_ttft, client_procs=args.client_procs,
                 shared_prefix=args.shared_prefix,
                 prefix_cache_mb=args.prefix_cache_mb,
-                speculative=args.speculative, draft_k=args.draft_k)
+                speculative=args.speculative, draft_k=args.draft_k,
+                fused_dequant=args.fused_dequant)
 
         try:
             result = e2e_attempt(args.max_seq, args.max_new)

@@ -155,11 +155,43 @@ class InferenceEngine:
         prefill_token_budget: int | None = None,
         prefix_cache_bytes: int = 0,
         speculative: SpecConfig | None = None,
+        fused_dequant: bool = False,
     ) -> None:
         self.config = config
         self.params = params
         self.tokenizer = tokenizer
         self.mesh = mesh
+        # W8A16 fused-dequant routing (tpu.fused_dequant): pack the int8
+        # weight leaves into the Pallas kernel's tile layout ONCE, here —
+        # the layout is the routing (qmatmul dispatches on the leaf
+        # type), so every trunk program built below (prefill, chunk,
+        # decode, verify) traces fused with no extra knob plumbing, and
+        # knob-off leaves every compiled program byte-identical to a
+        # build without the feature.
+        self.fused_dequant = bool(fused_dequant)
+        if self.fused_dequant:
+            from symmetry_tpu.models.llama import pack_params
+            from symmetry_tpu.ops.quant import PackedQuantizedTensor
+
+            if mesh is not None:
+                # Same boundary as the fused KV append: the packed tile
+                # layout has no GSPMD partitioning rule. Loud, not
+                # silently inert — the operator asked for a fused build.
+                raise EngineError(
+                    "tpu.fused_dequant supports single-device engines "
+                    "only (the packed weight layout has no GSPMD "
+                    "partitioning rule); drop the knob or the mesh")
+            self.params = params = pack_params(params)
+
+            def is_packed(leaf):
+                return isinstance(leaf, PackedQuantizedTensor)
+
+            if not any(is_packed(leaf) for leaf in
+                       jax.tree.leaves(params, is_leaf=is_packed)):
+                raise EngineError(
+                    "tpu.fused_dequant found no packable int8 weights — "
+                    "it requires tpu.quantization: int8 (the knob would "
+                    "otherwise be silently inert)")
         # Pipeline-parallel serving (parallel/pipeline.py): a stage axis of
         # size > 1 routes prefill AND decode through the staged microbatch
         # schedule; params/cache must be stage-sharded (PIPELINE_RULES).
@@ -1172,6 +1204,32 @@ class InferenceEngine:
     def slot_capacity(self) -> int:
         return self.max_seq_len
 
+    def weight_stream_bytes(self) -> int:
+        """Bytes of parameter data one decode step must stream from HBM:
+        every matmul weight (int8 payload + f32 scales, or dense) is read
+        in full each step — the decode-floor denominator (BASELINE.md
+        convert-wall study). The input embedding is excluded unless tied:
+        it is gathered (B rows), not contracted; tied models re-read it
+        as the LM head. Metadata-only (nbytes), safe from any thread."""
+        total = sum(leaf.nbytes for leaf in jax.tree.leaves(self.params))
+        if not self.config.tie_embeddings:
+            total -= self.params["embed"].nbytes
+        return total
+
+    def compile_cache_sizes(self) -> dict[str, int]:
+        """Compiled-variant count per jitted primitive. Warmup fills
+        these; steady-state serving must never grow them — a mid-traffic
+        XLA compile is the stall every warmup path exists to prevent
+        (tests assert zero steady-state recompiles against this)."""
+        out: dict[str, int] = {}
+        for name in ("_prefill", "_decode", "_verify", "_chunk_step",
+                     "_chunk_final", "_insert_all", "_insert_from_prefix",
+                     "_extract_prefix_row"):
+            fn = getattr(self, name, None)
+            if fn is not None and hasattr(fn, "_cache_size"):
+                out[name] = fn._cache_size()
+        return out
+
     # ------------------------------------------------------------------
 
     @classmethod
@@ -1297,4 +1355,5 @@ class InferenceEngine:
                 (getattr(tpu_cfg, "prefix_cache_mb", None) or 0) * 2**20),
             speculative=SpecConfig.from_knob(
                 getattr(tpu_cfg, "speculative", None)),
+            fused_dequant=bool(getattr(tpu_cfg, "fused_dequant", False)),
         )

@@ -251,6 +251,26 @@ class Scheduler:
         out["engine_ttft_s"] = self._ttft_hist.to_dict()
         out["admit_dispatch_s"] = self._admit_hist.to_dict()
         out["block_interval_s"] = self._interval_hist.to_dict()
+        # Decode-floor metrics (the convert-wall number, in EVERY driver
+        # bench capture instead of only the engine-only bench): per-step
+        # decode wall from the block-interval p50 (intervals spanning
+        # admissions land in the upper percentiles, so p50 is the
+        # steady-state estimate), and the weight bytes that step must
+        # stream — their ratio is the effective weight-stream HBM GB/s.
+        # Speculative mode interleaves ONE-forward verify dispatches into
+        # the same interval histogram, so interval/decode_block would be
+        # wrong by up to decode_block× there — the metrics are omitted
+        # rather than reported poisoned (the convert-wall A/B runs with
+        # drafting off).
+        iv_p50 = self._interval_hist.percentile(50)
+        wsb = getattr(self.engine, "weight_stream_bytes", None)
+        if iv_p50 and self._drafter is None:
+            step_s = iv_p50 / self.engine.decode_block
+            out["decode_step_ms"] = round(1e3 * step_s, 3)
+            if wsb is not None:
+                nbytes = int(wsb())
+                out["weight_bytes_per_step"] = nbytes
+                out["weight_stream_gbs"] = round(nbytes / step_s / 1e9, 1)
         # Shared-prefix KV cache counters (hit/miss/evict/bytes) ride the
         # same host stats op so they surface provider- and bench-side.
         pc_stats = getattr(self.engine, "prefix_cache_stats", None)

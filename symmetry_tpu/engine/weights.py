@@ -377,12 +377,21 @@ def _warm_path(checkpoint_path: str, dtype, quantize: bool) -> str:
 
 
 def _flatten_params(params: dict, prefix: str = "") -> Iterator[tuple[str, Any]]:
-    from symmetry_tpu.ops.quant import QuantizedTensor
+    from symmetry_tpu.ops.quant import (
+        PackedQuantizedTensor, QuantizedTensor, unpack_quantized)
 
     for name, child in sorted(params.items()):
         path = f"{prefix}{name}"
         if isinstance(child, dict):
             yield from _flatten_params(child, path + "/")
+        elif isinstance(child, PackedQuantizedTensor):
+            # The cache stores the FLAT int8 layout: tile geometry is a
+            # kernel tuning detail (tpu.fused_dequant re-packs at engine
+            # construction), not checkpoint state — a cache written by a
+            # fused build must stay readable by a non-fused one.
+            flat = unpack_quantized(child)
+            yield path + ":q", flat.q
+            yield path + ":scale", flat.scale
         elif isinstance(child, QuantizedTensor):
             yield path + ":q", child.q
             yield path + ":scale", child.scale

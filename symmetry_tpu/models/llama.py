@@ -582,6 +582,19 @@ def quantize_params(params: dict) -> dict:
     return quantize_tree(params, QUANT_KEYS)
 
 
+def pack_params(params: dict) -> dict:
+    """In-place tile-packing of quantized QUANT_KEYS leaves into the
+    W8A16 fused-dequant kernel layout (`tpu.fused_dequant`; ops/quant.py
+    pack_tree). Layout is routing: qmatmul sends PackedQuantizedTensor
+    leaves through the Pallas kernel and leaves everything else on the
+    mixed dot, so per-leaf tileability fallback is automatic. Single-
+    device only — the packed layout has no GSPMD partitioning rule, and
+    the engine refuses the knob on a mesh."""
+    from symmetry_tpu.ops.quant import pack_tree
+
+    return pack_tree(params, QUANT_KEYS)
+
+
 def quantized_logical_axes(axes: dict) -> dict:
     """Map a dense logical-axes tree to its quantized counterpart: the int8
     payload keeps the dense axes; per-column scales drop the contraction

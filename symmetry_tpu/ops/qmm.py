@@ -1,26 +1,44 @@
-"""Native int8 MXU matmul (W8A8) — measured, NOT routed (see below).
+"""Quantized-weight Pallas matmuls: W8A8 (measured, not routed) and the
+W8A16 fused-dequant kernel (`tpu.fused_dequant`, off by default).
 
 The regime matters (all numbers measured on this v5e, fetch-fenced,
 carry-dependent loops — tools/probe_s8_mxu.py, tools/bisect_decode.py):
 
-  - DECODE (M ≈ slot count, ~128 rows): bandwidth-bound. Every int8 form
-    is convert-throughput-limited; this kernel measured ~50% SLOWER than
-    the XLA mixed dot in the full trunk (48.5 vs 32.1 ms). Decode stays
-    on ops/quant.qmatmul's mixed dot.
-  - PREFILL (M ≥ ~256 token rows): the kernel's s8×s8 MXU tiles measure
-    ~172 TFLOP/s in ISOLATION at M=512 (vs the convert-limited mixed
-    dot), but routed into the real prefill path the end-to-end group
-    time is identical (165.3 vs 167.6 ms) — prefill is not matmul-bound.
-    Since W8A8 adds per-row activation-quant noise for zero measured
-    gain, it is NOT routed; the mixed dot serves both regimes.
+  - DECODE (M ≈ slot count, ~128 rows): bandwidth-bound, and the floor is
+    the int8→bf16 CONVERT, not HBM: XLA's mixed dot materializes a full
+    bf16 copy of every int8 weight before each dot (~480 GB/s effective
+    vs the 740-860 a pure bf16 matmul streams).
+  - W8A8 (this file's first kernel): every int8 form is convert-
+    throughput-limited; the s8×s8 kernel measured ~50% SLOWER than the
+    XLA mixed dot in the full trunk (48.5 vs 32.1 ms). Decode stays on
+    ops/quant.qmatmul's mixed dot.
+  - PREFILL (M ≥ ~256 token rows): the s8×s8 MXU tiles measure
+    ~172 TFLOP/s in ISOLATION at M=512, but routed into the real prefill
+    path the end-to-end group time is identical (165.3 vs 167.6 ms) —
+    prefill is not matmul-bound. Since W8A8 adds per-row activation-quant
+    noise for zero measured gain, it is NOT routed.
 
-Kept as a correct, tested building block (tests/test_qmm.py pins the
-arithmetic against a bit-exact integer reference in interpret mode) and
-as the measurement record — a future TPU generation or a genuinely
-matmul-bound workload may flip the verdict. The activation is quantized
-dynamically per row to int8; the s32 tile products are rescaled in the
-kernel epilogue by (row activation scale × per-output-channel weight
-scale).
+W8A16 (`w8a16_matmul`, the round-8 convert-wall lever) is the one form
+the rounds-3/4 study did NOT cover: weights stay int8 in HBM and are
+dequantized TILE BY TILE in VMEM — the pallas_call grid pipeline
+double-buffers each weight-tile DMA against the previous tile's MXU
+work, so the convert rides inside the DMA/matmul pipeline instead of
+materializing a full bf16 weight tensor per decode step. Activations
+stay bf16 (no per-row activation-quant noise — exactly the path the
+W8A8 negative result does not condemn). Weights are PRE-PACKED into the
+kernel's [K/bk, N/bn, bk, bn] tile layout at load (ops/quant.py
+pack_quantized) so each grid step's DMA is one contiguous read.
+Numerics are the mixed dot's exactly: int8 values are exact in bf16,
+products accumulate in f32, the per-output-channel scale is applied in
+the epilogue — `(x @ q_bf16) * scale`, cast to the activation dtype.
+
+The W8A8 kernel is kept as a correct, tested building block
+(tests/test_qmm.py pins the arithmetic against a bit-exact integer
+reference in interpret mode) and as the measurement record — a future
+TPU generation or a genuinely matmul-bound workload may flip the
+verdict. The activation is quantized dynamically per row to int8; the
+s32 tile products are rescaled in the kernel epilogue by (row
+activation scale × per-output-channel weight scale).
 """
 
 from __future__ import annotations
@@ -122,3 +140,140 @@ def w8a8_matmul(
         scratch_shapes=[pltpu.VMEM((M, bn), jnp.int32)],
         interpret=interpret,
     )(xq, wq, xs, ws)
+
+
+# ---------------------------------------------------------------------------
+# W8A16 fused-dequant matmul (tpu.fused_dequant): bf16 activations against
+# tile-packed int8 weights, dequantized in VMEM inside the DMA/matmul
+# pipeline. See the module docstring for the regime analysis; the measured
+# on-chip A/B lives in BASELINE.md and tools/probe_w8a16.py.
+
+# Tile defaults: bn/bk are the DMA granularity AND the effective double-
+# buffer depth lever (the pallas grid pipeline keeps the next (bk, bn)
+# tile's DMA in flight behind the current tile's MXU work). 512×512 int8
+# = 256 KiB per tile, two in flight, well inside VMEM next to the
+# activation block and f32 accumulator. tools/probe_w8a16.py sweeps this.
+W8A16_BLOCK_K = 512
+W8A16_BLOCK_N = 512
+# Row-block cap: x [bm, bk] + acc [bm, bn] f32 + out [bm, bn] must fit
+# VMEM beside the weight tiles. Decode (M = slots ≈ 128) and verify
+# (M = slots × (1+k)) fit in one block; wide prefill shapes grid over M.
+W8A16_BLOCK_M = 1024
+# On-TPU floors: int8 native tiling is (32, 128) — narrower tiles pad in
+# VMEM and starve the DMA. Interpret mode (CPU tests) accepts any
+# divisor down to 8 so the tiny presets exercise the real kernel.
+_TPU_MIN_BK = 32
+_TPU_MIN_BN = 128
+
+
+def pick_w8a16_block(dim: int, prefer: int, floor: int = 8) -> int | None:
+    """Largest candidate ≤ prefer (and ≥ floor) that divides dim."""
+    for cand in (1024, 512, 256, 128, 64, 32, 16, 8):
+        if floor <= cand <= prefer and dim % cand == 0:
+            return cand
+    return None
+
+
+def w8a16_supports(k: int, n: int, backend: str) -> bool:
+    """Static pack-time gate: True when (k, n) tiles into a layout the
+    fused kernel can stream efficiently on `backend`. Untileable leaves
+    stay in the flat [K, N] layout and keep the XLA mixed dot."""
+    if backend == "tpu":
+        bk = pick_w8a16_block(k, W8A16_BLOCK_K, floor=_TPU_MIN_BK)
+        bn = pick_w8a16_block(n, W8A16_BLOCK_N, floor=_TPU_MIN_BN)
+    else:
+        bk = pick_w8a16_block(k, W8A16_BLOCK_K)
+        bn = pick_w8a16_block(n, W8A16_BLOCK_N)
+    return bk is not None and bn is not None
+
+
+def _w8a16_kernel(x_ref, w_ref, ws_ref, o_ref, acc_scr, *, n_k: int,
+                  out_dtype):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    x = x_ref[:]
+    # The fused dequant: ONE (bk, bn) int8 tile, freshly DMA'd into VMEM
+    # by the grid pipeline, converted to the activation dtype right here
+    # — int8 values are exact in bf16, so this is the mixed dot's
+    # arithmetic without its full-tensor bf16 materialization. The
+    # per-output-channel scale waits for the epilogue (scaling commutes
+    # with the K-sum).
+    w = w_ref[0, 0].astype(x.dtype)
+    acc_scr[:] += jax.lax.dot_general(
+        x, w,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(k == n_k - 1)
+    def _():
+        o_ref[:] = (acc_scr[:] * ws_ref[:]).astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
+def w8a16_matmul(
+    x: jnp.ndarray,        # [M, K] float (bf16/f32)
+    w_tiles: jnp.ndarray,  # [K//bk, N//bn, bk, bn] int8 (pack_quantized)
+    w_scale: jnp.ndarray,  # [N] f32 per-output-channel
+    *,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """x @ dequant(w) with the weight streamed as pre-packed int8 tiles
+    and dequantized in VMEM — semantically identical to ops/quant.qmatmul
+    on the unpacked QuantizedTensor: (x @ q) accumulated f32, scaled per
+    output channel, cast back to the activation dtype."""
+    M, K = x.shape
+    n_kt, n_nt, bk, bn = w_tiles.shape
+    assert n_kt * bk == K, (w_tiles.shape, x.shape)
+    N = n_nt * bn
+    out_dtype = out_dtype or x.dtype
+    bm = M if M <= W8A16_BLOCK_M else pick_w8a16_block(M, W8A16_BLOCK_M,
+                                                       floor=64)
+    if bm is None:
+        raise ValueError(f"w8a16 row count {M} untileable past "
+                         f"{W8A16_BLOCK_M}")
+    ws = w_scale.astype(jnp.float32).reshape(1, N)
+
+    return pl.pallas_call(
+        functools.partial(_w8a16_kernel, n_k=n_kt, out_dtype=out_dtype),
+        grid=(M // bm, n_nt, n_kt),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda m, n, k: (m, k)),
+            # One contiguous packed tile per grid step: this DMA is the
+            # weight stream, and the grid pipeline double-buffers it.
+            pl.BlockSpec((1, 1, bk, bn), lambda m, n, k: (k, n, 0, 0)),
+            pl.BlockSpec((1, bn), lambda m, n, k: (0, n)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda m, n, k: (m, n)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+    )(x, w_tiles, ws)
+
+
+def w8a16_apply(x: jnp.ndarray, w_tiles: jnp.ndarray,
+                w_scale: jnp.ndarray) -> jnp.ndarray:
+    """qmatmul's fused-path entry: any leading batch shape on `x`,
+    flattened to rows for the kernel. Falls back to the mixed dot on an
+    unpacked view for row counts the kernel can't tile (never an engine
+    shape — engine row counts are slot/bucket products)."""
+    *lead, K = x.shape
+    M = 1
+    for d in lead:
+        M *= d
+    n_kt, n_nt, bk, bn = w_tiles.shape
+    N = n_nt * bn
+    if M > W8A16_BLOCK_M and pick_w8a16_block(M, W8A16_BLOCK_M,
+                                              floor=64) is None:
+        from symmetry_tpu.ops.quant import (
+            PackedQuantizedTensor, qmatmul, unpack_quantized)
+
+        return qmatmul(x, unpack_quantized(
+            PackedQuantizedTensor(q=w_tiles, scale=w_scale)))
+    out = w8a16_matmul(x.reshape(M, K), w_tiles, w_scale,
+                       interpret=jax.default_backend() != "tpu")
+    return out.reshape(*lead, N)

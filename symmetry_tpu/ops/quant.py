@@ -24,6 +24,19 @@ class QuantizedTensor(NamedTuple):
     scale: jnp.ndarray  # f32, weight shape minus the contraction dim
 
 
+class PackedQuantizedTensor(NamedTuple):
+    """Tile-packed int8 weight for the fused W8A16 dequant matmul
+    (ops/qmm.py w8a16_matmul, `tpu.fused_dequant`): the flat [.., K, N]
+    int8 payload re-laid-out as [.., K/bk, N/bn, bk, bn] so each kernel
+    grid step DMAs ONE contiguous tile from HBM. Same pytree discipline
+    as QuantizedTensor — stacks under lax.scan (the leading layers dim
+    strips off both leaves together) and donates like a dense leaf. The
+    scale stays the flat per-output-channel [.., N]."""
+
+    q: jnp.ndarray      # int8 [.., K/bk, N/bn, bk, bn] tile layout
+    scale: jnp.ndarray  # f32 [.., N] per-output-channel
+
+
 def quantize(w: jnp.ndarray, *, contract_axis: int = -2) -> QuantizedTensor:
     """Quantize a dense weight along its contraction (input) axis."""
     wf = w.astype(jnp.float32)
@@ -40,20 +53,36 @@ def dequantize(qt: QuantizedTensor, dtype=jnp.float32,
 
 
 def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
-    """x @ w for dense arrays or QuantizedTensor ([in, out] contraction).
+    """x @ w for dense arrays, QuantizedTensor, or PackedQuantizedTensor
+    ([in, out] contraction).
 
-    Uses a mixed-precision dot with the int8 operand passed directly — no
-    `astype` on the weight, so XLA never materializes a bf16 copy (for a
-    128k-vocab head that copy alone is >1 GB). Accumulates f32, applies the
-    per-column scales, casts back to the activation dtype.
+    QuantizedTensor: a mixed-precision dot with the int8 operand passed
+    directly — no `astype` on the weight, so XLA never materializes a
+    bf16 copy as a SEPARATE op (for a 128k-vocab head that copy alone is
+    >1 GB)... except it does anyway: on v5e the mixed dot's effective
+    bandwidth (~480 GB/s) is the int8→bf16 convert's, not HBM's, because
+    XLA converts the full weight ahead of the dot. Accumulates f32,
+    applies the per-column scales, casts back to the activation dtype.
+
+    PackedQuantizedTensor (`tpu.fused_dequant`): routes through the
+    W8A16 Pallas kernel (ops/qmm.py w8a16_matmul) — int8 tiles stream
+    from HBM double-buffered and dequantize in VMEM inside the
+    DMA/matmul pipeline. Same arithmetic as the mixed dot (int8 exact in
+    bf16, f32 accumulation, epilogue scale); the layout IS the routing,
+    chosen once at weight load (engine/engine.py packs when the knob is
+    on), so this hot-path dispatch stays a type check.
 
     Measured alternative, not routed: the native s8×s8 MXU kernel
     (ops/qmm.py) is ~50% slower in-trunk at decode-sized M and exactly
     NEUTRAL at prefill-sized M (165.3 vs 167.6 ms per coalesced prefill
     group on-chip, despite winning isolated matmul microbenchmarks —
     prefill is not matmul-bound). Since W8A8 would add activation-quant
-    noise for zero measured gain, the mixed dot serves both regimes.
+    noise for zero measured gain, the mixed dot serves the default path.
     """
+    if isinstance(w, PackedQuantizedTensor):
+        from symmetry_tpu.ops.qmm import w8a16_apply
+
+        return w8a16_apply(x, w.q, w.scale)
     if isinstance(w, QuantizedTensor):
         y = jax.lax.dot_general(
             x, w.q,
@@ -80,6 +109,79 @@ def quantize_tree(params: dict, keys: tuple[str, ...]) -> dict:
                 visit(child)
             elif name in keys:
                 node[name] = quantize_jit(child)
+
+    visit(params)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# W8A16 tile packing (tpu.fused_dequant): performed ONCE at weight load so
+# every decode-step weight DMA is contiguous. Packing is pure layout — the
+# int8 payload bytes and the scales are untouched, so a packed tree is
+# bit-equivalent to its flat original (unpack_quantized round-trips).
+
+
+@functools.partial(jax.jit, static_argnames=("bk", "bn"))
+def _pack_leaf(q: jnp.ndarray, bk: int, bn: int) -> jnp.ndarray:
+    """[.., K, N] int8 → [.., K/bk, N/bn, bk, bn]. The tile transpose is
+    a real copy; pack_tree replaces each leaf as it goes, so the flat
+    original is freed right after and peak HBM overhead stays one int8
+    leaf (~0.5 GB for an 8B lm_head), paid once at load."""
+    *lead, K, N = q.shape
+    q = q.reshape(*lead, K // bk, bk, N // bn, bn)
+    return jnp.swapaxes(q, -3, -2)
+
+
+def pack_quantized(qt: QuantizedTensor, *, bk: int | None = None,
+                   bn: int | None = None):
+    """Pack one QuantizedTensor into the fused kernel's tile layout, or
+    return it unchanged when its shape doesn't tile on this backend (the
+    leaf then keeps the XLA mixed dot — per-leaf fallback, no all-or-
+    nothing). Explicit bk/bn override the kernel defaults (probe sweeps).
+    """
+    from symmetry_tpu.ops import qmm
+
+    *_, K, N = qt.q.shape
+    if bk is None and bn is None:
+        if not qmm.w8a16_supports(K, N, jax.default_backend()):
+            return qt
+        floor_k = qmm._TPU_MIN_BK if jax.default_backend() == "tpu" else 8
+        floor_n = qmm._TPU_MIN_BN if jax.default_backend() == "tpu" else 8
+        bk = qmm.pick_w8a16_block(K, qmm.W8A16_BLOCK_K, floor=floor_k)
+        bn = qmm.pick_w8a16_block(N, qmm.W8A16_BLOCK_N, floor=floor_n)
+    elif bk is None or bn is None:
+        raise ValueError("pack_quantized tile override needs BOTH bk and "
+                         "bn (a partial override would mix a default-"
+                         "derived block with the explicit one)")
+    elif K % bk or N % bn:
+        # Explicit overrides (probe sweeps) fail loudly, not deep inside
+        # the jitted reshape — the default path's fallback-to-flat is for
+        # load-time packing only.
+        raise ValueError(f"tiles ({bk}, {bn}) do not divide weight "
+                         f"({K}, {N})")
+    return PackedQuantizedTensor(q=_pack_leaf(qt.q, bk, bn), scale=qt.scale)
+
+
+def unpack_quantized(pt: PackedQuantizedTensor) -> QuantizedTensor:
+    """Tile layout back to flat [.., K, N] (tests, re-export)."""
+    *lead, n_kt, n_nt, bk, bn = pt.q.shape
+    q = jnp.swapaxes(pt.q, -3, -2).reshape(*lead, n_kt * bk, n_nt * bn)
+    return QuantizedTensor(q=q, scale=pt.scale)
+
+
+def pack_tree(params: dict, keys: tuple[str, ...]) -> dict:
+    """Pack the named QuantizedTensor leaves of a params dict in place
+    (mirrors quantize_tree). Only 2-D weights and [L, K, N] layer stacks
+    pack — MoE expert stacks ([L, E, K, N]) and untileable shapes keep
+    the flat layout and the mixed dot."""
+
+    def visit(node):
+        for name, child in list(node.items()):
+            if isinstance(child, dict):
+                visit(child)
+            elif (name in keys and isinstance(child, QuantizedTensor)
+                  and child.q.ndim in (2, 3)):
+                node[name] = pack_quantized(child)
 
     visit(params)
     return params

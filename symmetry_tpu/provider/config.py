@@ -44,6 +44,15 @@ class TpuConfig:
     dtype: str = "bfloat16"            # parameter/compute dtype
     quantization: str | None = None    # None | "int8" (weights)
     kv_quantization: str | None = None  # None | "int8" (KV cache)
+    # W8A16 fused-dequant matmul (ops/qmm.py w8a16_matmul): int8 weights
+    # pre-packed into the kernel's tile layout at load and dequantized in
+    # VMEM inside the double-buffered DMA/matmul pipeline, instead of
+    # XLA's full bf16 weight materialization per decode step (the
+    # rounds-3/4 convert wall). Requires quantization: int8 and a
+    # single-device engine (no GSPMD rule for the packed layout). Off by
+    # default pending the on-chip A/B (BASELINE.md decode-floor section;
+    # bench.py --fused-dequant and tools/probe_w8a16.py measure it).
+    fused_dequant: bool = False
     max_batch_size: int = 8            # decode slots (continuous batching)
     max_seq_len: int = 2048            # KV capacity per slot
     prefill_buckets: tuple[int, ...] = (128, 512, 2048)
