@@ -288,7 +288,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             shared_prefix: bool = False,
             prefix_cache_mb: float | None = None,
             speculative: bool = False, draft_k: int = 8,
-            fused_dequant: bool = False) -> dict:
+            fused_dequant: bool = False, trace_out: str | None = None,
+            tracing: bool = True) -> dict:
     """The NORTH-STAR measurement (BASELINE.json metric): aggregate WIRE
     tok/s and p50/p99 TTFT through the full serving path — server +
     tpu_native provider + N concurrent streaming clients over TCP
@@ -354,6 +355,11 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 **({"speculative": {"k_draft": draft_k}}
                    if speculative else {}),
                 **({"fused_dequant": True} if fused_dequant else {}),
+                # tracing=False empties the engine-side span rings — the
+                # A/B knob for proving the recorder's overhead stays
+                # under 1% of greedy decode tok/s (--no-trace vs default
+                # at otherwise identical settings).
+                **({"tracing": False} if not tracing else {}),
             },
         }
         # Provider log is ALWAYS captured (round-3 verdict #1: a 6-line
@@ -657,6 +663,31 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                     try:
                         provider_stats = await stats_session.stats()
                         engine_stats = provider_stats.get("engine")
+                        if trace_out:
+                            # Distributed-trace capture (utils/trace.py):
+                            # one traced request measures the session's
+                            # provider clock offset AND threads its trace
+                            # id through provider → host → scheduler, then
+                            # the merged component rings (the whole run's
+                            # recent window — this request and the fleet's
+                            # tail) export as one Perfetto timeline.
+                            # After the stats read so counters above are
+                            # unaffected; provider still up.
+                            async for _ in stats_session.chat(
+                                    [{"role": "user",
+                                      "content": "trace capture probe"}],
+                                    max_tokens=8, temperature=0.0):
+                                pass
+                            perfetto = await stats_client.export_trace(
+                                stats_session)
+                            with open(trace_out, "w") as tf:
+                                json.dump(perfetto, tf)
+                            comps = {e["args"]["name"]
+                                     for e in perfetto["traceEvents"]
+                                     if e.get("name") == "process_name"}
+                            print(f"[bench] perfetto trace → {trace_out} "
+                                  f"({len(perfetto['traceEvents'])} events "
+                                  f"from {sorted(comps)})", file=sys.stderr)
                     finally:
                         await stats_session.close()
                 except Exception as exc:  # noqa: BLE001 — diagnostics only
@@ -1246,6 +1277,18 @@ def main() -> None:
                          "wire tails measure the service, not one client "
                          "event loop (default: 8 when clients >= 64, "
                          "else 1)")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write a merged Perfetto/Chrome-trace JSON "
+                         "(client + provider + host + scheduler spans on "
+                         "one reconciled clock) captured from the "
+                         "provider at the end of the run (--e2e). Load "
+                         "at ui.perfetto.dev; BASELINE.md bench rounds "
+                         "attach this artifact")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="disable the engine-side span rings "
+                         "(tpu.tracing=false). The tracing-overhead A/B "
+                         "is this flag on vs off at otherwise identical "
+                         "settings; acceptance: within 1%% tok/s")
     ap.add_argument("--e2e-client-worker", action="store_true",
                     help=argparse.SUPPRESS)  # internal: one fleet shard
     args = ap.parse_args()
@@ -1344,7 +1387,8 @@ def main() -> None:
                 shared_prefix=args.shared_prefix,
                 prefix_cache_mb=args.prefix_cache_mb,
                 speculative=args.speculative, draft_k=args.draft_k,
-                fused_dequant=args.fused_dequant)
+                fused_dequant=args.fused_dequant,
+                trace_out=args.trace_out, tracing=not args.no_trace)
 
         try:
             result = e2e_attempt(args.max_seq, args.max_new)

@@ -14,6 +14,7 @@ exists; SURVEY §0.1). This is its re-creation against our wire protocol:
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator
 
@@ -26,6 +27,7 @@ from symmetry_tpu.provider.backends.proxy import (
 )
 from symmetry_tpu.transport.base import Transport
 from symmetry_tpu.utils.logging import logger
+from symmetry_tpu.utils.trace import Tracer, new_trace_id
 
 
 class ClientError(RuntimeError):
@@ -84,7 +86,8 @@ class ProviderSession:
     An abandoned stream is cancelled provider-side (inferenceCancel) and
     its stragglers dropped, instead of desyncing the whole session."""
 
-    def __init__(self, peer: Peer, details: ProviderDetails) -> None:
+    def __init__(self, peer: Peer, details: ProviderDetails,
+                 tracer: Tracer | None = None) -> None:
         self._peer = peer
         self._details = details
         # Usage of the last completed chat, from inferenceEnded:
@@ -93,8 +96,19 @@ class ProviderSession:
         self._queues: dict[str, asyncio.Queue] = {}
         self._stats_q: asyncio.Queue = asyncio.Queue()
         self._stats_lock = asyncio.Lock()
+        self._trace_q: asyncio.Queue = asyncio.Queue()
+        self._trace_lock = asyncio.Lock()
         self._reader: asyncio.Task | None = None
         self._closed = False
+        # Client-side spans (chat round trip, first delta) land in the
+        # owning SymmetryClient's tracer so one merge covers every
+        # session. The provider clock offset (provider monotonic − ours)
+        # is estimated from the stream-start marker's tMono stamp
+        # bracketed by our send/receive stamps — a piggybacked handshake;
+        # the lowest-RTT estimate seen so far wins.
+        self.tracer = tracer if tracer is not None else Tracer()
+        self.clock_offset: float | None = None
+        self._clock_rtt = float("inf")
 
     def _ensure_reader(self) -> None:
         if self._reader is None:
@@ -111,6 +125,9 @@ class ProviderSession:
                 data = msg.data or {}
                 if msg.key == MessageKey.METRICS:
                     self._stats_q.put_nowait(data)
+                    continue
+                if msg.key == MessageKey.TRACE:
+                    self._trace_q.put_nowait(data)
                     continue
                 req_id = str(data.get("requestId", ""))
                 q = self._queues.get(req_id)
@@ -150,6 +167,7 @@ class ProviderSession:
             for q in self._queues.values():
                 q.put_nowait(None)  # wire gone
             self._stats_q.put_nowait(None)
+            self._trace_q.put_nowait(None)
 
     async def __aenter__(self) -> "ProviderSession":
         return self
@@ -170,15 +188,23 @@ class ProviderSession:
         top_k: int | None = None,
         seed: int | None = None,
         speculative: bool | None = None,
+        trace_id: str | None = None,
     ) -> AsyncIterator[str]:
         """Send one inference request; yield text deltas as they stream.
-        Safe to call concurrently on one session (requestId multiplexing)."""
+        Safe to call concurrently on one session (requestId multiplexing).
+
+        Every chat carries a trace id (minted here unless the caller
+        brings one): the provider threads it through its backend and the
+        engine host, so one id keys the request's spans in every
+        component of the merged timeline (session.trace / export)."""
         import uuid as _uuid
 
         self._check_usable()
         req_id = _uuid.uuid4().hex[:16]
+        trace_id = trace_id or new_trace_id()
         payload: dict[str, Any] = {"key": "inference", "messages": messages,
-                                   "requestId": req_id}
+                                   "requestId": req_id,
+                                   "traceId": trace_id}
         if self._details.session_token is not None:
             payload["sessionToken"] = self._details.session_token
         for k, v in (("max_tokens", max_tokens), ("temperature", temperature),
@@ -190,6 +216,9 @@ class ProviderSession:
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[req_id] = queue
         ended = False
+        t_send = time.monotonic()
+        t_first: float | None = None
+        n_deltas = 0
         try:
             await self._peer.send(MessageKey.INFERENCE, payload)
             dialect = self._details.provider_dialect
@@ -200,8 +229,19 @@ class ProviderSession:
                     raise ProviderGoneError(
                         "provider closed connection mid-stream")
                 if msg.key == MessageKey.INFERENCE:
-                    # stream-start marker; carries the backend dialect
-                    dialect = (msg.data or {}).get("provider", dialect)
+                    # stream-start marker; carries the backend dialect —
+                    # and the provider's monotonic stamp, bracketed by our
+                    # send/receive stamps for the clock-offset estimate.
+                    data = msg.data or {}
+                    dialect = data.get("provider", dialect)
+                    t_mono = data.get("tMono")
+                    if isinstance(t_mono, (int, float)):
+                        now = time.monotonic()
+                        rtt = now - t_send
+                        if rtt < self._clock_rtt:
+                            self._clock_rtt = rtt
+                            self.clock_offset = (
+                                float(t_mono) - (t_send + now) / 2.0)
                 elif msg.key == MessageKey.TOKEN_CHUNK:
                     raw = (msg.data or {}).get("raw", "")
                     parsed = safe_parse_stream_response(raw)
@@ -209,6 +249,12 @@ class ProviderSession:
                         continue
                     delta = get_chat_data_from_provider(dialect, parsed)
                     if delta:
+                        if t_first is None:
+                            t_first = time.monotonic()
+                            self.tracer.record(
+                                "client_ttft", t_send, t_first - t_send,
+                                request_id=req_id, trace_id=trace_id)
+                        n_deltas += 1
                         yield delta
                 elif msg.key == MessageKey.INFERENCE_ENDED:
                     ended = True
@@ -235,6 +281,10 @@ class ProviderSession:
                     raise ClientError(
                         data.get("error", "inference failed"))
         finally:
+            self.tracer.record("client_request", t_send,
+                               time.monotonic() - t_send,
+                               request_id=req_id, trace_id=trace_id,
+                               deltas=n_deltas, completed=ended)
             self._queues.pop(req_id, None)
             if not ended and not self._peer.closed:
                 # Abandoned mid-stream: cancel provider-side (frees the
@@ -280,6 +330,42 @@ class ProviderSession:
                 raise ProviderGoneError("provider closed during stats query")
             return data
 
+    async def trace(self) -> dict:
+        """Query the provider's merged span-ring snapshot (provider +
+        host + scheduler components, stamps on the provider's clock).
+        Same reader/serialization discipline as stats()."""
+        self._check_usable()
+        self._ensure_reader()
+        async with self._trace_lock:
+            self._check_usable()
+            while not self._trace_q.empty():
+                if self._trace_q.get_nowait() is None:
+                    raise ProviderGoneError("provider closed connection")
+            await self._peer.send(MessageKey.TRACE)
+            try:
+                data = await asyncio.wait_for(self._trace_q.get(), 30.0)
+            except asyncio.TimeoutError:
+                raise ProviderGoneError(
+                    "no trace reply within 30s") from None
+            if data is None:
+                raise ProviderGoneError("provider closed during trace query")
+            return data
+
+    async def trace_components(self) -> list[dict]:
+        """Provider-side components reconciled onto THIS client's clock:
+        every component's clock_offset_s gains the session's measured
+        provider offset, plus the client's own span ring at offset 0 —
+        ready for utils.trace.export_perfetto."""
+        payload = await self.trace()
+        off = self.clock_offset or 0.0
+        comps = []
+        for comp in payload.get("components") or []:
+            if isinstance(comp, dict):
+                comps.append({**comp, "clock_offset_s":
+                              float(comp.get("clock_offset_s", 0.0)) + off})
+        comps.append(self.tracer.component("client"))
+        return comps
+
     async def close(self) -> None:
         self._closed = True
         if self._reader is not None:
@@ -301,6 +387,20 @@ class SymmetryClient:
 
             transport = TcpTransport()  # CLI passes transport_for(server)
         self._transport = transport
+        # One span ring for all this client's sessions: chat round trips
+        # and first-delta spans, merged with provider-side components by
+        # export_trace / ProviderSession.trace_components.
+        self.tracer = Tracer()
+
+    async def export_trace(self, session: "ProviderSession") -> dict:
+        """One request's (or session's) end-to-end timeline as Chrome
+        trace-event JSON: the provider's merged components (provider,
+        host, scheduler — reconciled through the measured clock offsets)
+        plus this client's spans. Write it to a file and load it in
+        Perfetto (ui.perfetto.dev) or chrome://tracing."""
+        from symmetry_tpu.utils.trace import export_perfetto
+
+        return export_perfetto(await session.trace_components())
 
     async def request_provider(
         self, server_address: str, server_key: bytes, model_name: str | None = None,
@@ -507,7 +607,7 @@ class SymmetryClient:
         peer = await Peer.connect(
             conn, self.identity, initiator=True, expected_remote_key=expected
         )
-        return ProviderSession(peer, details)
+        return ProviderSession(peer, details, tracer=self.tracer)
 
     async def connect_relay(self, server_address: str, server_key: bytes,
                             provider_key_hex: str):

@@ -11,11 +11,21 @@ equivalent of that isolation, with a pipe instead of HTTP.
 
 Protocol: JSON lines.
   stdin  ← {"op": "submit", "id", "messages", "max_new", "sampling": {…},
-            "speculative": bool?}   (optional per-request opt-out of
+            "speculative": bool?,   (optional per-request opt-out of
             speculative decoding; ignored unless tpu.speculative is on)
+            "trace": str?}          (request trace id, threaded into
+            scheduler spans so the request correlates across processes)
            {"op": "cancel", "id"}
+           {"op": "clock", "t0": float}   (clock-offset handshake: the
+            provider brackets our CLOCK_MONOTONIC read with its own —
+            the NTP midpoint replaces the old assume-zero-offset policy)
+           {"op": "trace"}   (span-ring snapshot for the Perfetto export)
            {"op": "stats"} | {"op": "shutdown"}
   stdout → {"op": "ready", "model": …}            (after warmup)
+           {"op": "clock", "t0", "t": our monotonic at receipt}
+           {"op": "trace", "clock", "components": [{name, spans,
+            counters, clock_offset_s}, …]}   (host + scheduler rings,
+            stamps on THIS process's clock)
            {"op": "event", "id", "text", "done", "finish_reason",
             "error", "ttft_s", "tokens", "tokens_new",
             "t": {"recv", "picked", "first", "out"}}   ("t" on the
@@ -62,6 +72,7 @@ from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
 from symmetry_tpu.engine.scheduler import GenRequest, Scheduler
 from symmetry_tpu.provider.config import ConfigManager
 from symmetry_tpu.utils.logging import logger
+from symmetry_tpu.utils.trace import Tracer
 
 if TYPE_CHECKING:
     from symmetry_tpu.engine.scheduler import TokenEvent
@@ -75,6 +86,11 @@ class EngineHost:
         self._wlock = threading.Lock()
         self._cancelled: set[str] = set()
         self._reported: dict[str, int] = {}  # id -> tokens already reported
+        # The host's OWN trace ring (the pipe/framing layer): per-request
+        # submit spans (pipe read → tokenized → enqueued) and per-frame
+        # flush spans. The scheduler's ring lives on the scheduler; the
+        # `trace` op ships both.
+        self.tracer = Tracer()
         # Emit-path counters (under _wlock): every stdout line counts one
         # pipe_write; pipe_event_writes counts only lines that carry
         # TokenEvents (the writes-per-block contract is about THESE —
@@ -90,6 +106,7 @@ class EngineHost:
 
     def _write(self, obj: dict[str, Any], *, events: int = 0) -> None:
         line = json.dumps(obj, separators=(",", ":"))
+        t0 = time.monotonic()
         with self._wlock:
             self.emit_stats["pipe_writes"] += 1
             self.emit_stats["pipe_events"] += events
@@ -100,6 +117,12 @@ class EngineHost:
                 self.emit_stats["pipe_batched_frames"] += 1
             sys.stdout.write(line + "\n")
             sys.stdout.flush()
+        if events > 0:
+            # Event frames only (one per block): the flush hold is the
+            # "emit" leg of the TTFT chain, worth a span; ready/stats
+            # frames are not emit-path traffic.
+            self.tracer.record("pipe_flush", t0, time.monotonic() - t0,
+                               events=events, bytes=len(line) + 1)
 
     def _event_dict(self, req_id: str, ev: "TokenEvent") -> dict[str, Any]:
         """One event's wire fields (shared by legacy and batched frames),
@@ -178,6 +201,11 @@ class EngineHost:
         t_warmup = time.perf_counter() - t1
         self._scheduler = Scheduler(sched_engine,
                                     emit_batch=self._emit_batch)
+        # tpu.tracing=False empties every ring (the bench A/B knob); the
+        # default leaves the bounded always-on recorder running.
+        tracing = bool(getattr(self._config.tpu, "tracing", True))
+        self.tracer.enabled = tracing
+        self._scheduler.tracer.enabled = tracing
         self._scheduler.start()
         self._write({"op": "ready",
                      "model": self._config.model_name,
@@ -210,6 +238,10 @@ class EngineHost:
                 req_id = str(msg.get("id", ""))
                 if req_id in self._reported:  # only live requests; a late
                     self._cancelled.add(req_id)  # cancel must not leak ids
+            elif op == "clock":
+                self._handle_clock(msg)
+            elif op == "trace":
+                self._handle_trace()
             elif op == "stats":
                 stats = getattr(self._scheduler, "stats", None)
                 m = stats() if stats is not None else dict(
@@ -232,10 +264,32 @@ class EngineHost:
             self._command_loop.stop()
         return 0
 
+    def _handle_clock(self, msg: dict) -> None:
+        """Clock-offset handshake: echo the provider's send stamp and add
+        our CLOCK_MONOTONIC read. The provider brackets this read with its
+        own stamps and takes the min-RTT NTP midpoint — the measured
+        offset the per-stage TTFT attribution applies instead of clamping
+        negative cross-process spans to zero."""
+        self._write({"op": "clock", "t0": msg.get("t0"),
+                     "t": time.monotonic()})
+
+    def _handle_trace(self) -> None:
+        """Span-ring snapshot: this process's host + scheduler rings,
+        stamps on this process's clock (the provider adds its measured
+        offset when merging)."""
+        comps = [self.tracer.component("host")]
+        trace_export = getattr(self._scheduler, "trace_export", None)
+        if trace_export is not None:
+            comps.append(trace_export())
+        self._write({"op": "trace", "clock": time.monotonic(),
+                     "components": comps})
+
     # --------------------------------------------------------------- submit
 
     def _submit(self, msg: dict) -> None:
+        t_recv = time.monotonic()
         req_id = str(msg.get("id", ""))
+        trace_id = str(msg.get("trace") or "")
         s = msg.get("sampling") or {}
         sampling = SamplingParams(
             temperature=float(s.get("temperature", 0.0)),
@@ -266,7 +320,13 @@ class EngineHost:
             emit=emit,
             cancelled=lambda: req_id in self._cancelled,
             id=req_id,
-            speculative=spec if isinstance(spec, bool) else None))
+            speculative=spec if isinstance(spec, bool) else None,
+            trace_id=trace_id))
+        # The pipe_in leg as a span: command read → tokenized → enqueued.
+        self.tracer.record("host_submit", t_recv,
+                           time.monotonic() - t_recv,
+                           request_id=req_id, trace_id=trace_id,
+                           prompt_len=len(prompt_ids))
 
 
 def main() -> int:

@@ -51,6 +51,10 @@ class GenRequest:
     # out of drafting (its slot rides plain decode lanes); None/True defer
     # to the engine's tpu.speculative knob. No effect when the knob is off.
     speculative: bool | None = None
+    # Request trace context: the id the client minted, threaded through
+    # provider → host pipe → here, so scheduler spans for this request
+    # land on the same Perfetto timeline as everyone else's.
+    trace_id: str = ""
     enqueued_at: float = field(default_factory=time.monotonic)
     # Stamped when the request enters a placement group (the admission
     # moment); re-stamped on re-pick after a budget deferral, so
@@ -194,8 +198,17 @@ class Scheduler:
                         "spec_verify_blocks": 0, "spec_drafted": 0,
                         "spec_accepted": 0, "spec_rolled_back": 0,
                         "spec_tokens": 0, "spec_verify_s": 0.0}
-        from symmetry_tpu.utils.trace import Histogram
+        from symmetry_tpu.utils.trace import Histogram, Tracer
 
+        # Request-scoped tracing (dispatch granularity — never per token):
+        # every device dispatch (prefill/chunk/decode block/verify) and
+        # every request's queue → prefill → generate phases land as spans
+        # in this bounded ring, with queue-depth/occupancy counter tracks
+        # stamped at block boundaries. Read via trace_export() through the
+        # host-pipe `trace` op — a ring snapshot off the hot loop, never a
+        # blocking call inside it. ~10 records per block: noise next to
+        # the device sync it sits beside.
+        self.tracer = Tracer(capacity=8192)
         # Engine-side latency distributions: TTFT as the scheduler saw it
         # (enqueue → first sampled token), admission dispatch wall, and the
         # interval between consecutive decode-block syncs while streams are
@@ -298,6 +311,11 @@ class Scheduler:
             }
         return out
 
+    def trace_export(self) -> dict[str, Any]:
+        """Span/counter rings as one export_perfetto component (the
+        host-pipe `trace` op's scheduler entry)."""
+        return self.tracer.component("scheduler")
+
     # ------------------------------------------------------------- the loop
 
     def _run(self) -> None:
@@ -339,8 +357,10 @@ class Scheduler:
         # slot snapshot at dispatch). The snapshot attributes each lane's
         # tokens to the request that occupied it AT DISPATCH — a lane
         # freed-and-reused between dispatch and processing must not leak
-        # the old request's block into the new one.
-        pending: tuple[Any, dict[int, _ActiveSlot]] | None = None
+        # the old request's block into the new one. The third element is
+        # the dispatch stamp (monotonic) so the processed block's span
+        # covers dispatch → device done, not just the sync.
+        pending: tuple[Any, dict[int, _ActiveSlot], float] | None = None
         while True:
             self._spent_this_block = 0.0
             drained = self._admit_new()
@@ -395,14 +415,15 @@ class Scheduler:
             did_verify = False
             if self._slots and self._drafter is not None:
                 if pending is not None and self._spec_peek():
-                    self._process_block(pending[0], pending[1])
+                    self._process_block(pending[0], pending[1],
+                                        dispatched_at=pending[2])
                     pending = None
                 if self._slots and pending is None:
                     did_verify = self._maybe_verify_block()
             nxt = None
             if self._slots and not did_verify:
                 nxt = (self.engine.decode_steps_dispatch(),
-                       dict(self._slots))
+                       dict(self._slots), time.monotonic())
                 self.metrics["steps"] += self.engine.decode_block
             # Chunked prefills ride between decode dispatches: a bounded
             # number of chunk dispatches per block keeps long-prompt
@@ -416,7 +437,8 @@ class Scheduler:
             # extra pipe write per block at most: still O(1).
             self._flush_events()
             if pending is not None:
-                self._process_block(pending[0], pending[1])
+                self._process_block(pending[0], pending[1],
+                                    dispatched_at=pending[2])
             pending = nxt
             # Block boundary: everything this iteration produced (block
             # deltas, finishes) leaves as one batch — the O(1)-writes-
@@ -427,7 +449,8 @@ class Scheduler:
 
     def _process_block(self, device_toks: Any,
                        snapshot: dict[int, _ActiveSlot],
-                       n_valid: np.ndarray | None = None) -> None:
+                       n_valid: np.ndarray | None = None,
+                       dispatched_at: float | None = None) -> None:
         """Sync one decode block to host and stream its tokens out.
 
         Batched pass (the block-granular emit path): ONE vectorized EOS
@@ -458,6 +481,21 @@ class Scheduler:
         if self._last_sync_done is not None:
             self._interval_hist.observe(t1 - self._last_sync_done)
         self._last_sync_done = t1
+        if self.tracer.enabled:
+            # Block span covers dispatch → device done (the device-side
+            # wall the double buffer hides host work behind); the gauge
+            # tracks are stamped once per block — boundary-granular, so
+            # the hot loop never pays more than a few ring appends.
+            t1m = time.monotonic()
+            if dispatched_at is not None:
+                self.tracer.record("decode_block", dispatched_at,
+                                   t1m - dispatched_at,
+                                   slots=len(snapshot),
+                                   steps=int(toks.shape[0]))
+            self.tracer.counter("occupancy", len(self._slots), t=t1m)
+            self.tracer.counter(
+                "queue_depth",
+                self._inbox.qsize() + len(self._deferred), t=t1m)
         K = toks.shape[0]
         eos_mask = (np.isin(toks, self._eos_arr) if self._eos_arr.size
                     else np.zeros(toks.shape, dtype=bool))
@@ -553,10 +591,13 @@ class Scheduler:
         if not proposed:
             return False
         snapshot = dict(self._slots)
+        t0m = time.monotonic()
         t0 = time.perf_counter()
         toks, n_emit = engine.verify_step(draft, n_draft)
         dt = time.perf_counter() - t0
         accepted = int(np.sum(np.minimum(n_emit - 1, n_draft)))
+        self.tracer.record("verify_dispatch", t0m, dt,
+                           drafted=proposed, accepted=accepted)
         self.metrics["spec_verify_blocks"] += 1
         self.metrics["spec_verify_s"] += dt
         self.metrics["spec_drafted"] += proposed
@@ -777,6 +818,7 @@ class Scheduler:
                         self._free.append(slot)
                         self._deferred.append(req)
                 break
+            t0m = time.monotonic()
             t0 = time.perf_counter()
             try:
                 if hit is not None:
@@ -808,6 +850,8 @@ class Scheduler:
             self.metrics["admit_dispatches"] += 1
             self.metrics["admit_s"] += dt
             self._admit_hist.observe(dt)
+            self.tracer.record("prefill_dispatch", t0m, dt, n=len(sub),
+                               cached=hit is not None)
             for (slot, req), first in zip(sub, firsts):
                 self._activate(slot, req, first)
         return n_dispatches
@@ -839,6 +883,7 @@ class Scheduler:
                     text="", token_id=None, done=True,
                     finish_reason="cancelled"))
                 continue
+            t0m = time.monotonic()
             t0 = time.perf_counter()
             try:
                 first = self.engine.advance_chunked_prefill(job)
@@ -854,6 +899,8 @@ class Scheduler:
             self.metrics["chunk_dispatches"] += 1
             self.metrics["chunk_s"] += dt
             self._spent_this_block += dt
+            self.tracer.record("chunk_dispatch", t0m, dt,
+                               request_id=req.id, trace_id=req.trace_id)
             progressed += 1
             budget -= 1
             if first is not None:
@@ -865,6 +912,19 @@ class Scheduler:
                              prompt_len=len(req.prompt_ids))
         active.first_token_at = time.monotonic()
         self._ttft_hist.observe(active.first_token_at - req.enqueued_at)
+        if self.tracer.enabled:
+            # The request's admission phases as spans: scheduler-queue
+            # wait (enqueue → placement pick) and prefill (pick → first
+            # sampled token) — the engine-side legs of the per-stage TTFT
+            # chain, now on the merged timeline too.
+            picked = req.picked_at or active.first_token_at
+            self.tracer.record("queue", req.enqueued_at,
+                               picked - req.enqueued_at,
+                               request_id=req.id, trace_id=req.trace_id)
+            self.tracer.record("prefill", picked,
+                               active.first_token_at - picked,
+                               request_id=req.id, trace_id=req.trace_id,
+                               prompt_len=len(req.prompt_ids))
         self._slots[slot] = active
         self.metrics["peak_occupancy"] = max(self.metrics["peak_occupancy"],
                                              len(self._slots))
@@ -902,6 +962,12 @@ class Scheduler:
         tail = text + active.decoder.flush()
         ttft = (active.first_token_at - active.req.enqueued_at
                 if active.first_token_at else None)
+        if self.tracer.enabled and active.first_token_at is not None:
+            self.tracer.record("generate", active.first_token_at,
+                               time.monotonic() - active.first_token_at,
+                               request_id=active.req.id,
+                               trace_id=active.req.trace_id,
+                               tokens=active.generated, finish=reason)
         self._emit(active, TokenEvent(
             text=tail, token_id=tok, done=True, finish_reason=reason,
             ttft_s=ttft, tokens_generated=active.generated,
@@ -942,10 +1008,13 @@ class Scheduler:
         self.metrics["emit_flushes"] += 1
         self.metrics["emit_events"] += len(batch)
         if self._emit_batch is not None:
+            t0 = time.monotonic()
             try:
                 self._emit_batch(batch)
             except Exception as exc:  # noqa: BLE001 — must never kill the loop
                 log.error(f"emit batch sink failed: {exc}")
+            self.tracer.record("emit_flush", t0, time.monotonic() - t0,
+                               events=len(batch))
             return
         for req, ev in batch:
             try:
@@ -983,7 +1052,8 @@ class AsyncSession:
 
     def submit(self, prompt_ids: list[int], sampling: SamplingParams,
                max_new_tokens: int, request_id: str = "",
-               speculative: bool | None = None) -> None:
+               speculative: bool | None = None,
+               trace_id: str = "") -> None:
         def emit(ev: TokenEvent) -> None:
             self._loop.call_soon_threadsafe(self._queue.put_nowait, ev)
 
@@ -991,7 +1061,7 @@ class AsyncSession:
             prompt_ids=prompt_ids, sampling=sampling,
             max_new_tokens=max_new_tokens, emit=emit,
             cancelled=lambda: self._cancelled, id=request_id,
-            speculative=speculative))
+            speculative=speculative, trace_id=trace_id))
 
     async def events(self):
         while True:

@@ -41,6 +41,10 @@ class MessageKey:
     DRAIN = "drain"                           # graceful shutdown: stop accepting, finish in-flight
     METRICS = "metrics"                       # provider → server load metrics (tok/s, queue depth)
     PROVIDER_LIST = "providerList"            # server → client available models
+    TRACE = "trace"                           # client ⇄ provider: merged span-ring
+                                              # snapshot (client, provider, host,
+                                              # scheduler components) for the
+                                              # Perfetto timeline export
 
     # --- relay (NAT fallback: server splices client↔provider, payload
     #     stays end-to-end Noise-encrypted — the reference gets this leg

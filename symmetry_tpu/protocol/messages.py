@@ -5,6 +5,14 @@ Same envelope shape as the reference (`ProviderMessage<T>`, src/types.ts:23-26;
 post-handshake, encrypted) frames instead of raw unframed JSON writes — the
 reference relies on each `peer.write` arriving as exactly one `data` event
 (src/provider.ts:110-115,174-179), which TCP does not guarantee.
+
+Trace context convention: an `inference` frame's data may carry
+`"traceId"` (client-minted, utils/trace.new_trace_id) — providers thread
+it through the backend and host pipe so every component's spans correlate
+on one timeline — and the provider's stream-start reply carries `"tMono"`
+(its CLOCK_MONOTONIC read at send) so the client can estimate the
+provider-clock offset for the merged Perfetto export. Both fields are
+optional: peers that ignore them interoperate unchanged.
 """
 
 from __future__ import annotations

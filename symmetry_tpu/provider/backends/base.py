@@ -37,6 +37,11 @@ class InferenceRequest:
     # Speculative decoding override (tpu_native with tpu.speculative on):
     # False opts this request out of drafting; None defers to the engine.
     speculative: bool | None = None
+    # Request trace context (utils/trace.py): the client-minted trace id
+    # from the inference frame's "traceId" field; engine backends thread
+    # it through the host pipe so scheduler spans correlate with the
+    # client's on one Perfetto timeline. "" = untraced.
+    trace_id: str = ""
 
 
 @dataclass(slots=True)
@@ -84,6 +89,14 @@ class InferenceBackend(abc.ABC):
         """Liveness for failure detection (SURVEY §5.3): engine wedge must
         unregister the provider."""
         return True
+
+    async def trace_components(self) -> list[dict]:
+        """Span-ring snapshots this backend contributes to the merged
+        Perfetto export (utils/trace.export_perfetto component shape).
+        Each entry's clock_offset_s must already be relative to THIS
+        process's CLOCK_MONOTONIC (tpu_native applies its measured
+        host-pipe offset before returning). Default: nothing to add."""
+        return []
 
 
 class BackendError(RuntimeError):

@@ -3,12 +3,19 @@
 Streams the last user message back word-by-word as OpenAI-style SSE chunks —
 the 'fake echo model' seam SURVEY §4 calls for, letting the full
 client→server→provider path run with no TPU and no external server.
+
+It participates in request tracing like a real engine would: each stream
+records a backend span (with the request's trace id) into its own bounded
+ring and contributes it to the provider's merged Perfetto export — so the
+trace pipeline (client → provider → backend components, one reconciled
+clock) is exercisable in CI with no TPU and no subprocess.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import time
 from typing import AsyncIterator
 
 from symmetry_tpu.provider.backends.base import (
@@ -16,6 +23,7 @@ from symmetry_tpu.provider.backends.base import (
     InferenceRequest,
     StreamChunk,
 )
+from symmetry_tpu.utils.trace import Tracer
 
 
 class EchoBackend(InferenceBackend):
@@ -23,8 +31,14 @@ class EchoBackend(InferenceBackend):
 
     def __init__(self, delay_s: float = 0.0) -> None:
         self._delay = delay_s
+        self.tracer = Tracer()
+
+    async def trace_components(self) -> list[dict]:
+        # Same process as the provider — offset 0 by construction.
+        return [self.tracer.component("echo")]
 
     async def stream(self, request: InferenceRequest) -> AsyncIterator[StreamChunk]:
+        t0 = time.monotonic()
         last_user = ""
         for m in reversed(request.messages):
             if m.get("role") == "user":
@@ -41,4 +55,6 @@ class EchoBackend(InferenceBackend):
             yield StreamChunk(raw=f"data: {json.dumps(chunk)}", text=token)
             if self._delay:
                 await asyncio.sleep(self._delay)
+        self.tracer.record("echo_stream", t0, time.monotonic() - t0,
+                           trace_id=request.trace_id, tokens=len(words))
         yield StreamChunk(raw="data: [DONE]", text="", done=True)

@@ -58,6 +58,13 @@ class TpuNativeBackend(InferenceBackend):
         self._host_dead = False
         self._engine_alive = True  # host-reported scheduler liveness
         self._stats_waiters: list[asyncio.Future] = []
+        self._trace_waiters: list[asyncio.Future] = []
+        # Measured host-pipe clock offset (host monotonic − provider
+        # monotonic), from the startup clock handshake. On Linux both
+        # processes read one CLOCK_MONOTONIC so it lands near zero — but
+        # it is MEASURED, not assumed: host stamps are reconciled through
+        # it instead of clamping negative cross-process spans to zero.
+        self._clock_offset: float = 0.0
         # Admission capacity for the provider's overload shedding: the
         # engine serves `slots` streams concurrently; beyond
         # slots + max_queue, new requests would wait more than ~one slot
@@ -158,7 +165,13 @@ class TpuNativeBackend(InferenceBackend):
             self._cfg_path = fh.name
         self._proc = await asyncio.create_subprocess_exec(
             sys.executable, "-m", "symmetry_tpu.engine.host", self._cfg_path,
-            stdin=asyncio.subprocess.PIPE, stdout=asyncio.subprocess.PIPE)
+            stdin=asyncio.subprocess.PIPE, stdout=asyncio.subprocess.PIPE,
+            # readline() is bounded by the StreamReader limit (64 KiB
+            # default) and raises past it — a full-ring {"op":"trace"}
+            # reply is a single multi-MB line, which would kill the
+            # reader task and wedge every stream. 32 MiB matches the
+            # wire-frame bound.
+            limit=32 * 1024 * 1024)
         # await the ready line (weight loading + warmup happen in the host)
         while True:
             line = await self._proc.stdout.readline()
@@ -172,10 +185,40 @@ class TpuNativeBackend(InferenceBackend):
                 continue
             if msg.get("op") == "ready":
                 break
+        await self._clock_handshake()
         self._reader = asyncio.get_running_loop().create_task(
             self._read_events())
         log.info(f"tpu_native engine host up (pid {self._proc.pid}): "
-                 f"model={self._model_name}")
+                 f"model={self._model_name} "
+                 f"clock_offset={self._clock_offset * 1e6:+.0f}us")
+
+    async def _clock_handshake(self, rounds: int = 5) -> None:
+        """Measure the host's monotonic-clock offset before any traffic.
+
+        Each round brackets the host's clock read between two local
+        stamps; the min-RTT sample's NTP midpoint wins (utils/trace.
+        clock_handshake_offset). Runs before the reader task exists, so
+        replies are read directly off the pipe — nothing else can be
+        writing yet (no requests submitted, stats only on demand)."""
+        from symmetry_tpu.utils.trace import clock_handshake_offset
+
+        samples: list[tuple[float, float, float]] = []
+        for _ in range(rounds):
+            t0 = time.monotonic()
+            await self._host_send({"op": "clock", "t0": t0})
+            while True:
+                line = await self._proc.stdout.readline()
+                if not line:
+                    raise BackendError(
+                        "engine host died during clock handshake")
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue
+                if msg.get("op") == "clock" and msg.get("t0") == t0:
+                    samples.append((t0, float(msg["t"]), time.monotonic()))
+                    break
+        self._clock_offset = clock_handshake_offset(samples)
 
     async def _read_events(self) -> None:
         assert self._proc is not None and self._proc.stdout is not None
@@ -193,6 +236,12 @@ class TpuNativeBackend(InferenceBackend):
                 # scheduler breakdown for engine_stats() consumers
                 self._engine_alive = bool(msg.get("engine_alive", True))
                 waiters, self._stats_waiters = self._stats_waiters, []
+                for w in waiters:
+                    if not w.done():
+                        w.set_result(msg)
+                continue
+            if op == "trace":
+                waiters, self._trace_waiters = self._trace_waiters, []
                 for w in waiters:
                     if not w.done():
                         w.set_result(msg)
@@ -282,6 +331,46 @@ class TpuNativeBackend(InferenceBackend):
             if fut in self._stats_waiters:
                 self._stats_waiters.remove(fut)
 
+    async def _probe_host_trace(self, timeout: float = 10.0) -> dict | None:
+        """One trace-ring round-trip to the host; None on timeout."""
+        import contextlib
+
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._trace_waiters.append(fut)
+        try:
+            with contextlib.suppress(ConnectionError, OSError):
+                await self._host_send({"op": "trace"})
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return None
+        finally:
+            if fut in self._trace_waiters:
+                self._trace_waiters.remove(fut)
+
+    async def trace_components(self) -> list[dict]:
+        """Host + scheduler span rings, reconciled onto THIS process's
+        clock: each component's clock_offset_s gains the measured
+        host-pipe offset, so the provider's merge needs no knowledge of
+        which process a span came from."""
+        if self._proc is not None:
+            if self._host_dead or self._proc.returncode is not None:
+                return []
+            msg = await self._probe_host_trace()
+            if msg is None:
+                return []
+            comps = []
+            for comp in msg.get("components") or []:
+                if isinstance(comp, dict):
+                    comps.append({**comp, "clock_offset_s":
+                                  float(comp.get("clock_offset_s", 0.0))
+                                  + self._clock_offset})
+            return comps
+        if self._scheduler is not None:
+            trace_export = getattr(self._scheduler, "trace_export", None)
+            if trace_export is not None:
+                return [trace_export()]  # same process — offset 0
+        return []
+
     async def engine_stats(self) -> dict | None:
         """The scheduler's serving breakdown (counters, engine-side TTFT,
         admission dispatch and block-interval percentiles) — surfaced
@@ -295,6 +384,7 @@ class TpuNativeBackend(InferenceBackend):
                 return None
             out = {k: v for k, v in msg.items() if k != "op"}
             out["relay"] = dict(self.relay_stats)
+            out["clock_offset_s"] = round(self._clock_offset, 6)
             out["stages"] = {name: h.to_dict()
                              for name, h in self.stage_hists.items()
                              if h.count}
@@ -358,7 +448,8 @@ class TpuNativeBackend(InferenceBackend):
                                loop=asyncio.get_running_loop())
         session.submit(prompt_ids, SamplingParams.from_request(request),
                        max_new, request_id=request_id,
-                       speculative=request.speculative)
+                       speculative=request.speculative,
+                       trace_id=request.trace_id)
 
         def chunk_line(delta: dict, finish: str | None = None) -> str:
             return self._chunk_line(request_id, created, delta, finish)
@@ -389,13 +480,22 @@ class TpuNativeBackend(InferenceBackend):
     def _observe_stages(self, t_recv: float, t_submit: float,
                         t: dict) -> None:
         """Fold one request's first-event stage stamps into the per-stage
-        TTFT histograms. Negative spans (sub-ms cross-process clock read
-        ordering) clamp to zero rather than poisoning the distribution."""
+        TTFT histograms.
+
+        Host stamps are mapped onto THIS process's clock through the
+        measured handshake offset (host − provider) before differencing —
+        the old code assumed zero offset and clamped the resulting
+        negative cross-process spans to zero, which silently zeroed the
+        pipe_in/relay legs whenever clock reads interleaved. Spans are
+        recorded as measured: residual sub-RTT jitter may still produce a
+        microsecond-negative value, and hiding it would misstate the
+        distribution the same way the clamp did."""
         now = time.monotonic()
-        recv = t.get("recv", t_submit)
-        picked = t.get("picked", recv)
-        first = t.get("first", picked)
-        out = t.get("out", first)
+        off = self._clock_offset
+        recv = t["recv"] - off if "recv" in t else t_submit
+        picked = t["picked"] - off if "picked" in t else recv
+        first = t["first"] - off if "first" in t else picked
+        out = t["out"] - off if "out" in t else first
         spans = {"submit": t_submit - t_recv,
                  "pipe_in": recv - t_submit,
                  "queue": picked - recv,
@@ -403,7 +503,7 @@ class TpuNativeBackend(InferenceBackend):
                  "emit": out - first,
                  "relay": now - out}
         for name, span in spans.items():
-            self.stage_hists[name].observe(max(span, 0.0))
+            self.stage_hists[name].observe(span)
 
     async def _stream_host(self, request: InferenceRequest, request_id: str,
                            created: int, max_new: int
@@ -425,7 +525,9 @@ class TpuNativeBackend(InferenceBackend):
                              "top_k": getattr(request, "top_k", None) or 0,
                              "seed": request.seed},
                 **({"speculative": request.speculative}
-                   if request.speculative is not None else {})})
+                   if request.speculative is not None else {}),
+                **({"trace": request.trace_id}
+                   if request.trace_id else {})})
             t_submit = time.monotonic()
             yield StreamChunk(
                 raw=self._chunk_line(request_id, created,

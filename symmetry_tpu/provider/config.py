@@ -92,6 +92,13 @@ class TpuConfig:
     # service time instead of growing with the backlog. 0 disables
     # queueing (shed the moment every slot is busy).
     max_queue: int | None = None
+    # Request-scoped tracing (utils/trace.py): bounded span/counter rings
+    # in the scheduler and host, read through the host-pipe `trace` op and
+    # exported as a Perfetto timeline (provider `trace` op, bench.py
+    # --trace-out). Cheap enough to leave on (a few ring appends per
+    # decode block); False empties the rings entirely — the bench A/B
+    # knob for proving the overhead stays under 1%.
+    tracing: bool = True
     # TTFT-bounded admission: shed a new request when the provider's
     # ESTIMATED first-token wait (requests awaiting their first token ÷
     # recent first-token rate) exceeds this many seconds. Catches the

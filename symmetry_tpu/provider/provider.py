@@ -39,8 +39,8 @@ from symmetry_tpu.provider.collect import DataCollector
 from symmetry_tpu.provider.config import ConfigManager
 from symmetry_tpu.server import tokens as session_tokens
 from symmetry_tpu.transport.base import Connection, Listener, Transport
-from symmetry_tpu.utils.logging import logger
-from symmetry_tpu.utils.trace import Tracer
+from symmetry_tpu.utils.logging import log_context, logger
+from symmetry_tpu.utils.trace import FlightRecorder, Tracer
 
 RECONNECT_BASE_S = 1.0
 RECONNECT_MAX_S = 60.0
@@ -142,6 +142,26 @@ class SymmetryProvider:
         self._unstarted = 0
         self._first_token_stamps: deque[float] = deque(maxlen=512)
         self._started_at = time.monotonic()
+        # Always-on flight recorder (utils/trace.py): the span rings are
+        # already recording; this owns the trigger — SLO breach, backend
+        # error, or SIGUSR2 dumps the merged last-window timeline + a
+        # stats snapshot to one JSON file, so the LAST bad request is
+        # debuggable after the fact. Config (all optional):
+        #   flightRecorder: {enabled, dir, windowS, minIntervalS, sloE2eS}
+        fr_cfg = self.config.get("flightRecorder") or {}
+        self.flight: FlightRecorder | None = None
+        if fr_cfg.get("enabled", True):
+            slo = fr_cfg.get("sloE2eS")
+            self.flight = FlightRecorder(
+                fr_cfg.get("dir") or os.path.join(
+                    self.config.get("path", "~/.config/symmetry"),
+                    "flight"),
+                window_s=float(fr_cfg.get("windowS", 30.0)),
+                min_interval_s=float(fr_cfg.get("minIntervalS", 30.0)),
+                # Coerced at construction like its siblings: a quoted
+                # YAML value must fail/convert HERE, not as a TypeError
+                # in the per-request SLO comparison.
+                slo_e2e_s=float(slo) if slo is not None else None)
 
     # ----- lifecycle (reference: init(), src/provider.ts:37-81) -----
 
@@ -167,6 +187,26 @@ class SymmetryProvider:
         self._spawn(self._health_loop())
         await self._join_dht()
         self._start_puncher()
+        self._install_sigusr2()
+
+    def _install_sigusr2(self) -> None:
+        """SIGUSR2 → flight-recorder dump (operator-triggered capture of
+        the last N seconds, no restart, no client needed). Best-effort:
+        unavailable off the main thread and on non-Unix loops."""
+        self._sigusr2_installed = False
+        if self.flight is None:
+            return
+        import signal
+
+        try:
+            asyncio.get_running_loop().add_signal_handler(
+                signal.SIGUSR2,
+                lambda: self._spawn(self._flight_dump("sigusr2",
+                                                      force=True)))
+            self._sigusr2_installed = True
+        except (NotImplementedError, ValueError, RuntimeError):
+            logger.debug("SIGUSR2 flight-recorder trigger unavailable "
+                         "on this platform/thread")
 
     def _start_puncher(self) -> None:
         """NAT hole punching (network/natpunch.py): keep this provider
@@ -236,6 +276,13 @@ class SymmetryProvider:
     async def stop(self, drain_timeout_s: float = 30.0) -> None:
         """Graceful drain: stop accepting, finish in-flight, leave, close."""
         self._draining = True
+        if getattr(self, "_sigusr2_installed", False):
+            import signal
+
+            with contextlib.suppress(Exception):
+                asyncio.get_running_loop().remove_signal_handler(
+                    signal.SIGUSR2)
+            self._sigusr2_installed = False
         if getattr(self, "_puncher", None) is not None:
             await self._puncher.stop()
             self._puncher = None
@@ -406,6 +453,43 @@ class SymmetryProvider:
                if self._dht is not None else {}),
         }
 
+    async def gather_trace(self) -> dict[str, Any]:
+        """Merged span-ring snapshot: this provider's tracer plus every
+        component the backend contributes (tpu_native: host + scheduler,
+        already reconciled onto this process's clock through the measured
+        pipe offset). The `trace` wire op's reply payload; also what the
+        flight recorder dumps."""
+        comps = [self.tracer.component("provider")]
+        fn = getattr(self.backend, "trace_components", None)
+        if fn is not None:
+            try:
+                comps.extend(await fn() or [])
+            except Exception as exc:  # noqa: BLE001 — diagnostics only
+                logger.warning(f"backend trace snapshot failed: {exc}")
+        return {"components": comps, "clock": time.monotonic()}
+
+    async def _flight_dump(self, reason: str,
+                           force: bool = False) -> str | None:
+        """Trigger one flight-recorder dump (rate-limited unless forced)."""
+        if self.flight is None:
+            return None
+        if not force and not self.flight.should_dump():
+            return None
+        payload = await self.gather_trace()
+        stats = self.stats()
+        engine_stats = getattr(self.backend, "engine_stats", None)
+        if engine_stats is not None:
+            with contextlib.suppress(Exception):
+                stats["engine"] = await engine_stats()
+        try:
+            path = self.flight.dump(reason, payload["components"],
+                                    stats=stats)
+        except OSError as exc:
+            logger.error(f"flight recorder write failed: {exc}")
+            return None
+        logger.warning(f"flight recorder: {reason} → {path}")
+        return path
+
     async def _health_loop(self) -> None:
         """Backend health → presence (SURVEY §5.3: engine wedge must
         unregister the provider); piggybacks the load-metrics report the
@@ -505,6 +589,12 @@ class SymmetryProvider:
                         with contextlib.suppress(Exception):
                             payload["engine"] = await engine_stats()
                     await peer.send(MessageKey.METRICS, payload)
+                elif msg.key == MessageKey.TRACE:
+                    # Merged span-ring snapshot (provider + backend/host/
+                    # scheduler components) for the client-side Perfetto
+                    # export — the request-tracing analog of METRICS.
+                    await peer.send(MessageKey.TRACE,
+                                    await self.gather_trace())
                 elif msg.key == MessageKey.LEAVE:
                     break
         finally:
@@ -627,6 +717,7 @@ class SymmetryProvider:
             await self._shed(peer, tag, shed_reason)
             return
         spec = data.get("speculative")
+        trace_id = str(data.get("traceId") or "")
         request = InferenceRequest(
             messages=messages,
             max_tokens=data.get("max_tokens"),
@@ -635,6 +726,7 @@ class SymmetryProvider:
             top_k=data.get("top_k"),
             seed=data.get("seed"),
             speculative=spec if isinstance(spec, bool) else None,
+            trace_id=trace_id,
         )
         self._in_flight += 1
         self._unstarted += 1
@@ -646,12 +738,22 @@ class SymmetryProvider:
         # cancellation can land before the stream loop assigns anything
         n_chunks = 0
         n_tokens = 0
+        # Every log record of this request (including the backend's,
+        # which runs inside this task) carries the trace/request ids —
+        # logs and the Perfetto timeline then correlate by the same keys.
+        ctx = log_context(trace_id=trace_id,
+                          request_id=str(req_id or request_id))
         try:
+            ctx.__enter__()
             # Stream-start marker (reference src/provider.ts:234-238).
+            # tMono = our CLOCK_MONOTONIC at send: the client brackets it
+            # with its own stamps — a piggybacked clock handshake, so its
+            # spans land on our timeline without an extra round trip.
             await peer.send(
                 MessageKey.INFERENCE,
                 {"status": "start", "provider": self.backend.name,
-                 "model": self.config.model_name, **tag},
+                 "model": self.config.model_name,
+                 "tMono": time.monotonic(), **tag},
             )
             async for chunk in self.backend.stream(request):
                 if peer.closed:
@@ -669,7 +771,8 @@ class SymmetryProvider:
                     if first_token_s is None:
                         first_token_s = time.monotonic() - start
                         self.tracer.record("ttft", start, first_token_s,
-                                           request_id=request_id)
+                                           request_id=request_id,
+                                           trace_id=trace_id)
                         self._unstarted -= 1
                         self._first_token_stamps.append(time.monotonic())
                 # Raw passthrough; Connection.send awaits drain = backpressure
@@ -684,9 +787,18 @@ class SymmetryProvider:
                     {"chunks": n_chunks, "tokens": n_tokens, **tag},
                 )
             self.metrics["tokens_out"] += n_tokens
-            self.tracer.record("inference", start, time.monotonic() - start,
-                               request_id=request_id,
+            e2e_s = time.monotonic() - start
+            self.tracer.record("inference", start, e2e_s,
+                               request_id=request_id, trace_id=trace_id,
                                tokens=n_tokens, chunks=n_chunks)
+            if (self.flight is not None and self.flight.slo_e2e_s
+                    and e2e_s > self.flight.slo_e2e_s):
+                # Latency-SLO breach: capture the window that CONTAINS
+                # the slow request while it is still in the rings.
+                logger.warning(f"request {request_id} breached e2e SLO "
+                               f"({e2e_s:.2f}s > "
+                               f"{self.flight.slo_e2e_s:.2f}s)")
+                self._spawn(self._flight_dump("slo"))
             # Data collection (reference: saveCompletion, src/provider.ts:277-297).
             peer_key = peer.remote_public_hex
             await self.collector.save(
@@ -699,6 +811,8 @@ class SymmetryProvider:
         except BackendError as exc:
             self.metrics["errors"] += 1
             logger.error(f"backend error: {exc}")
+            if self.flight is not None:
+                self._spawn(self._flight_dump("backend_error"))
             if not peer.closed:
                 with contextlib.suppress(ConnectionError, OSError):
                     await peer.send(MessageKey.INFERENCE_ERROR,
@@ -713,6 +827,7 @@ class SymmetryProvider:
                                      "tokens": n_tokens, **tag})
             raise
         finally:
+            ctx.__exit__(None, None, None)
             self._in_flight -= 1
             if first_token_s is None:
                 # Never started streaming (error/cancel before the first

@@ -5,15 +5,45 @@ four levels, emoji-prefixed colored console output, global singleton. Unlike
 the reference — where only `info` respects the level and warning/error/debug
 always print (src/logger.ts:29-44) — every level here is gated consistently,
 and output is structured enough to grep.
+
+Structured JSON mode (SYMMETRY_LOG_JSON=1 or set_json_mode(True)): every
+record becomes one JSON line on stderr — `{"ts", "level", "msg"}` plus
+the ambient `trace_id`/`request_id` from log_context(), so log lines
+correlate with the request-tracing timeline (utils/trace.py) by the same
+ids. The context rides a contextvars.ContextVar: set once around a
+request's handling, stamped on every record logged inside it (async tasks
+inherit it across awaits; other requests' tasks never see it).
 """
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import enum
+import json
 import os
 import sys
 import threading
 import time
+
+_log_ctx: contextvars.ContextVar[dict[str, str]] = contextvars.ContextVar(
+    "symmetry_log_ctx", default={})
+
+
+@contextlib.contextmanager
+def log_context(trace_id: str = "", request_id: str = ""):
+    """Stamp trace_id/request_id on every record logged inside the block
+    (and inside anything it awaits/spawns via context inheritance)."""
+    ctx = {**_log_ctx.get()}
+    if trace_id:
+        ctx["trace_id"] = trace_id
+    if request_id:
+        ctx["request_id"] = request_id
+    token = _log_ctx.set(ctx)
+    try:
+        yield
+    finally:
+        _log_ctx.reset(token)
 
 
 class LogLevel(enum.IntEnum):
@@ -67,10 +97,16 @@ class Logger:
                     os.environ.get("SYMMETRY_LOG_LEVEL")
                 )
                 cls._instance._color = sys.stderr.isatty()
+                cls._instance._json = os.environ.get(
+                    "SYMMETRY_LOG_JSON", "") not in ("", "0", "false")
             return cls._instance
 
     def set_log_level(self, level: LogLevel | int) -> None:
         self._level = LogLevel(level)
+
+    def set_json_mode(self, enabled: bool) -> None:
+        """One-JSON-object-per-line records with trace/request ids."""
+        self._json = bool(enabled)
 
     @property
     def level(self) -> LogLevel:
@@ -79,9 +115,18 @@ class Logger:
     def _emit(self, level: LogLevel, *parts: object) -> None:
         if level > self._level:
             return
-        ts = time.strftime("%H:%M:%S")
         msg = " ".join(str(p) for p in parts)
-        line = f"{_EMOJI[level]} [{ts}] {msg}"
+        if self._json:
+            record = {"ts": round(time.time(), 3),
+                      "level": level.name.lower(), "msg": msg,
+                      **_log_ctx.get()}
+            print(json.dumps(record, ensure_ascii=False), file=sys.stderr,
+                  flush=True)
+            return
+        ts = time.strftime("%H:%M:%S")
+        ctx = _log_ctx.get()
+        tag = (f" [{ctx['trace_id']}]" if ctx.get("trace_id") else "")
+        line = f"{_EMOJI[level]} [{ts}]{tag} {msg}"
         if self._color:
             line = f"{_COLORS[level]}{line}{_RESET}"
         print(line, file=sys.stderr, flush=True)

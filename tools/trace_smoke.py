@@ -1,0 +1,120 @@
+"""CI trace smoke: one echo-backend request, end to end, with tracing on.
+
+Runs the full client → server → provider path on the in-memory transport
+(no TPU, no subprocess), chats once with a known trace id, pulls the
+merged trace through the provider `trace` op, and validates the Perfetto
+export the way a reviewer would load it:
+
+  - parses as Chrome trace-event JSON (traceEvents list, well-formed
+    "X"/"C"/"M" events);
+  - spans from >= 3 distinct components (client, provider, echo backend);
+  - the chat's trace id appears in >= 3 components' spans (propagation,
+    not just co-residence);
+  - every event timestamp is non-negative (one reconciled clock, no
+    negative spans).
+
+Exit 0 and write the JSON to --out on success; exit 1 with a reason
+otherwise. The CI workflow uploads the JSON as an artifact.
+
+Run: python tools/trace_smoke.py --out trace_smoke_perfetto.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+async def run(out_path: str) -> int:
+    from symmetry_tpu.client.client import SymmetryClient
+    from symmetry_tpu.identity import Identity
+    from symmetry_tpu.provider.config import ConfigManager
+    from symmetry_tpu.provider.provider import SymmetryProvider
+    from symmetry_tpu.server.broker import SymmetryServer
+    from symmetry_tpu.transport.memory import MemoryTransport
+    from symmetry_tpu.utils.trace import new_trace_id
+
+    hub = MemoryTransport()
+    server_ident = Identity.from_name("trace-smoke-server")
+    server = SymmetryServer(server_ident, hub, ping_interval_s=30.0)
+    await server.start("mem://server")
+
+    cfg = ConfigManager(config={
+        "name": "trace-smoke-prov",
+        "public": True,
+        "serverKey": server_ident.public_hex,
+        "modelName": "echo:smoke",
+        "apiProvider": "echo",
+        "dataCollectionEnabled": False,
+        "flightRecorder": {"enabled": False},
+    })
+    provider = SymmetryProvider(
+        cfg, transport=hub, identity=Identity.from_name("trace-smoke-prov"),
+        server_address="mem://server")
+    await provider.start("mem://trace-smoke-prov")
+    await provider.wait_registered()
+
+    client = SymmetryClient(Identity.from_name("trace-smoke-cli"), hub)
+    details = await client.request_provider(
+        "mem://server", server_ident.public_key, "echo:smoke")
+    session = await client.connect(details)
+    trace_id = new_trace_id()
+    try:
+        text = "".join([d async for d in session.chat(
+            [{"role": "user", "content": "hello observable world"}],
+            trace_id=trace_id)])
+        assert text == "hello observable world", f"echo mismatch: {text!r}"
+        perfetto = await client.export_trace(session)
+    finally:
+        await session.close()
+        await provider.stop()
+        await server.stop()
+
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(perfetto, fh)
+
+    # ---- validation ----------------------------------------------------
+    events = perfetto.get("traceEvents")
+    assert isinstance(events, list) and events, "no traceEvents"
+    comp_by_pid: dict[int, str] = {}
+    for ev in events:
+        assert isinstance(ev, dict), f"non-dict event: {ev!r}"
+        assert ev.get("ph") in ("X", "C", "M"), f"bad phase: {ev!r}"
+        if ev["ph"] == "M" and ev.get("name") == "process_name":
+            comp_by_pid[ev["pid"]] = ev["args"]["name"]
+        if ev["ph"] in ("X", "C"):
+            assert isinstance(ev.get("ts"), (int, float)), f"no ts: {ev!r}"
+            assert ev["ts"] >= 0, f"negative ts (unreconciled clock): {ev!r}"
+        if ev["ph"] == "X":
+            assert isinstance(ev.get("dur"), (int, float)), f"no dur: {ev!r}"
+            assert isinstance(ev.get("name"), str) and ev["name"]
+
+    span_comps = {comp_by_pid[e["pid"]] for e in events if e["ph"] == "X"}
+    traced_comps = {comp_by_pid[e["pid"]] for e in events
+                    if e["ph"] == "X"
+                    and e.get("args", {}).get("trace_id") == trace_id}
+    print(f"trace smoke: {len(events)} events; spans from {sorted(span_comps)}; "
+          f"trace_id {trace_id} seen in {sorted(traced_comps)}")
+    assert len(span_comps) >= 3, \
+        f"need spans from >= 3 components, got {sorted(span_comps)}"
+    assert len(traced_comps) >= 3, \
+        f"trace id propagated to only {sorted(traced_comps)}"
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="trace_smoke_perfetto.json")
+    args = ap.parse_args()
+    try:
+        return asyncio.new_event_loop().run_until_complete(
+            asyncio.wait_for(run(args.out), 120))
+    except AssertionError as exc:
+        print(f"trace smoke FAILED: {exc}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
