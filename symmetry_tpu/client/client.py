@@ -14,6 +14,7 @@ exists; SURVEY §0.1). This is its re-creation against our wire protocol:
 from __future__ import annotations
 
 import asyncio
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator
@@ -48,10 +49,68 @@ class ProviderBusyError(ClientError):
     queue depth/limit for backoff decisions."""
 
     def __init__(self, message: str, queue_depth: int | None = None,
-                 queue_limit: int | None = None) -> None:
+                 queue_limit: int | None = None,
+                 draining: bool = False) -> None:
         super().__init__(message)
         self.queue_depth = queue_depth
         self.queue_limit = queue_limit
+        # A draining provider is shutting down for good: fail over NOW
+        # and don't come back — unlike a backlog shed, no backoff round
+        # will ever find it admitting again.
+        self.draining = draining
+
+
+class ProviderRestartingError(ProviderBusyError):
+    """The provider's engine host crashed/wedged mid-service and its
+    supervisor is respawning it — retryable on ANOTHER provider now, and
+    on this one after ~retry_after_s. Subclasses ProviderBusyError so it
+    joins the existing busy-shed failover + backoff machinery (the
+    provider is transiently unable, not dead — it must not be excluded
+    from the pool as a corpse)."""
+
+    def __init__(self, message: str, retry_after_s: float | None = None,
+                 **kw) -> None:
+        super().__init__(message, **kw)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(ClientError):
+    """The request's end-to-end deadline_s expired before it was served.
+    Deliberately NOT retryable (plain ClientError lineage): nobody is
+    waiting for the answer anymore, so replaying it on another provider
+    would burn pool capacity for a result that gets thrown away."""
+
+
+def busy_retry_backoff(queue_depth: int | None, queue_limit: int | None,
+                       round_idx: int = 0,
+                       retry_after_s: float | None = None,
+                       rand=random.random) -> float:
+    """Backoff before a busy-shed retry round.
+
+    Base wait scales with how deep the shedding provider's backlog was
+    relative to its limit (bounded at 2 s so a huge depth never becomes
+    a stall of our own) and doubles per retry round. The ±50% JITTER is
+    the point: a burst of clients shed together would otherwise sleep
+    the same formula and re-stampede the recovering provider in
+    lockstep. The provider's retry_after hint (a restarting provider
+    knows its respawn backoff better than we do) is ADDED UNDER the
+    jittered wait, never multiplied into it: retrying before the hint is
+    guaranteed to be shed again, and jittering the hint downward would
+    do exactly that — so everyone waits at least the hint, desynchronized
+    beyond it."""
+    depth = queue_depth or 0
+    limit = queue_limit or 0
+    over = depth / limit if limit > 0 else 1.0
+    # Round-0 base is bounded at 2 s (a huge reported depth must never
+    # become a stall of our own) and the per-round doubling has its own
+    # ceiling (×16) for the same reason — a caller asking for many retry
+    # rounds gets persistence, not quarter-hour sleeps.
+    base = (min(2.0, 0.25 * (1.0 + over))
+            * (2 ** min(max(0, round_idx), 4)))
+    wait = base * (0.5 + rand())
+    if retry_after_s is not None:
+        wait += float(retry_after_s)
+    return wait
 
 
 @dataclass(slots=True)
@@ -189,6 +248,7 @@ class ProviderSession:
         seed: int | None = None,
         speculative: bool | None = None,
         trace_id: str | None = None,
+        deadline_s: float | None = None,
     ) -> AsyncIterator[str]:
         """Send one inference request; yield text deltas as they stream.
         Safe to call concurrently on one session (requestId multiplexing).
@@ -196,7 +256,12 @@ class ProviderSession:
         Every chat carries a trace id (minted here unless the caller
         brings one): the provider threads it through its backend and the
         engine host, so one id keys the request's spans in every
-        component of the merged timeline (session.trace / export)."""
+        component of the merged timeline (session.trace / export).
+
+        `deadline_s` is the end-to-end deadline: it threads provider →
+        engine, and a request whose deadline expires while still queued
+        is shed (DeadlineExceededError, non-retryable) instead of being
+        prefilled for nobody."""
         import uuid as _uuid
 
         self._check_usable()
@@ -209,7 +274,8 @@ class ProviderSession:
             payload["sessionToken"] = self._details.session_token
         for k, v in (("max_tokens", max_tokens), ("temperature", temperature),
                      ("top_p", top_p), ("top_k", top_k), ("seed", seed),
-                     ("speculative", speculative)):
+                     ("speculative", speculative),
+                     ("deadline_s", deadline_s)):
             if v is not None:
                 payload[k] = v
         self._ensure_reader()
@@ -270,14 +336,30 @@ class ProviderSession:
                 elif msg.key == MessageKey.INFERENCE_ERROR:
                     ended = True
                     data = msg.data or {}
+                    if data.get("expired"):
+                        # Deadline shed: terminal, not retryable — nobody
+                        # is waiting for this answer anymore.
+                        raise DeadlineExceededError(
+                            data.get("error", "deadline expired"))
+                    if data.get("restarting"):
+                        # Engine-host crash/wedge, supervisor respawning:
+                        # retryable — fail over now, optionally come back
+                        # after retryAfterS.
+                        raise ProviderRestartingError(
+                            data.get("error", "provider restarting"),
+                            retry_after_s=data.get("retryAfterS"),
+                            queue_depth=data.get("queueDepth"),
+                            queue_limit=data.get("queueLimit"))
                     if data.get("busy"):
-                        # Structured shed (provider over queue_limit):
-                        # distinguishable so failover retries elsewhere
-                        # instead of treating it as a bad request.
+                        # Structured shed (provider over queue_limit, or
+                        # draining): distinguishable so failover retries
+                        # elsewhere instead of treating it as a bad
+                        # request.
                         raise ProviderBusyError(
                             data.get("error", "provider busy"),
                             queue_depth=data.get("queueDepth"),
-                            queue_limit=data.get("queueLimit"))
+                            queue_limit=data.get("queueLimit"),
+                            draining=bool(data.get("draining")))
                     raise ClientError(
                         data.get("error", "inference failed"))
         finally:
@@ -459,6 +541,7 @@ class SymmetryClient:
         messages: list[dict[str, str]],
         *,
         attempts: int = 3,
+        busy_retry_rounds: int = 1,
         **chat_kw,
     ) -> AsyncIterator[str | "ChatRestart"]:
         """Streaming chat with provider failover.
@@ -471,12 +554,21 @@ class SymmetryClient:
         half-finished completion cannot be resumed token-exactly on
         another node). chat_text_failover does that bookkeeping for you.
 
-        Busy-shed backoff: when the pool is exhausted and busy sheds
-        exhausted it (the providers are healthy, just over their backlog
-        bound — a transient), the busy providers are un-excluded and ONE
-        more round runs after a short backoff sized from the last shed
-        reply's queue_depth/queue_limit, instead of failing a retryable
-        burst outright. Genuinely-dead providers stay excluded.
+        Busy-shed backoff: when busy (or restarting) sheds exhausted the
+        pool — the providers are healthy, just over their backlog bound
+        or mid-respawn, a transient — the busy providers are un-excluded
+        and up to `busy_retry_rounds` extra rounds run, each after a
+        JITTERED backoff (busy_retry_backoff: sized from the shed reply's
+        queue_depth/queue_limit, doubled per round, on top of the
+        provider's retryAfterS hint, ±50% jitter so synchronized clients
+        don't re-stampede a recovering provider in lockstep).
+        `busy_retry_rounds=0` disables the retry entirely.
+        Genuinely-dead providers stay excluded throughout.
+
+        `deadline_s` (via chat_kw) is END-TO-END across all attempts:
+        each retry carries only the time remaining, and the loop raises
+        DeadlineExceededError itself once the budget is spent — failing
+        over with a reset deadline would admit work nobody awaits.
         """
         dead: list[str] = []
         busy: list[str] = []
@@ -487,9 +579,25 @@ class SymmetryClient:
         # sheds emptied the pool — the case the backoff exists for.
         last_busy: ProviderBusyError | None = None
         n_tries = 0
-        for round_idx in range(2):
+        # End-to-end deadline across ALL attempts: passing the original
+        # deadline_s verbatim on each retry would re-anchor the window
+        # at every provider's receipt, turning a 2 s budget into 2 s per
+        # hop — the caller stopped waiting, but the pool keeps admitting.
+        deadline_s = chat_kw.pop("deadline_s", None)
+        t_deadline0 = time.monotonic()
+        total_rounds = 1 + max(0, busy_retry_rounds)
+        for round_idx in range(total_rounds):
             pool_exhausted = False
             for _ in range(attempts):
+                kw = chat_kw
+                if deadline_s is not None:
+                    remaining = deadline_s - (time.monotonic()
+                                              - t_deadline0)
+                    if remaining <= 0:
+                        raise DeadlineExceededError(
+                            f"deadline_s={deadline_s} spent after "
+                            f"{n_tries} provider attempt(s)")
+                    kw = {**chat_kw, "deadline_s": remaining}
                 try:
                     details = await self.request_provider(
                         server_address, server_key, model_name,
@@ -513,7 +621,7 @@ class SymmetryClient:
                         dead.append(details.peer_key)
                     continue
                 try:
-                    async for delta in session.chat(messages, **chat_kw):
+                    async for delta in session.chat(messages, **kw):
                         yield delta
                     return
                 except (ProviderGoneError, ProviderBusyError,
@@ -526,7 +634,8 @@ class SymmetryClient:
                     # propagates: replaying it elsewhere would fail
                     # identically while blacklisting healthy providers.
                     last_exc = exc
-                    if isinstance(exc, ProviderBusyError):
+                    if (isinstance(exc, ProviderBusyError)
+                            and not getattr(exc, "draining", False)):
                         # Tracked even for a keyless provider row (no
                         # exclusion possible): the shed itself is what
                         # makes the end-of-round backoff retry eligible.
@@ -534,6 +643,9 @@ class SymmetryClient:
                         if details.peer_key:
                             busy.append(details.peer_key)
                     elif details.peer_key:
+                        # Dead — or DRAINING: a shutting-down provider
+                        # will never admit again, so it is excluded like
+                        # a corpse and earns no backoff retry round.
                         dead.append(details.peer_key)
                 finally:
                     await session.close()
@@ -542,22 +654,39 @@ class SymmetryClient:
             # attempt itself was shed. A round that merely PASSED THROUGH
             # a busy provider before dying on dead ones gets no bonus
             # attempts beyond the caller's budget.
-            if (round_idx == 0 and last_busy is not None
+            if (round_idx + 1 < total_rounds and last_busy is not None
                     and (pool_exhausted
                          or isinstance(last_exc, ProviderBusyError))):
-                # One retry round: the backlog that shed us drains at
-                # roughly one slot rotation; scale the wait by how deep
-                # the queue was relative to its limit, bounded so a huge
-                # depth never turns into a stall of our own.
-                depth = last_busy.queue_depth or 0
-                limit = last_busy.queue_limit or 0
-                over = depth / limit if limit > 0 else 1.0
-                backoff = min(2.0, 0.25 * (1.0 + over))
+                # The backlog that shed us drains at roughly one slot
+                # rotation; the jittered backoff (see busy_retry_backoff)
+                # spreads the returning herd over it.
+                backoff = busy_retry_backoff(
+                    last_busy.queue_depth, last_busy.queue_limit,
+                    round_idx=round_idx,
+                    retry_after_s=getattr(last_busy, "retry_after_s",
+                                          None))
+                if deadline_s is not None:
+                    remaining = deadline_s - (time.monotonic()
+                                              - t_deadline0)
+                    if remaining <= backoff:
+                        # Sleeping through the rest of the budget just to
+                        # raise on the next attempt is strictly worse
+                        # than raising now.
+                        raise DeadlineExceededError(
+                            f"deadline_s={deadline_s}: {remaining:.2f}s "
+                            f"left, retry backoff {backoff:.2f}s would "
+                            f"overrun it")
                 logger.debug(
-                    f"pool exhausted on busy sheds (depth={depth} "
-                    f"limit={limit}); retrying once in {backoff:.2f}s")
+                    f"pool exhausted on busy sheds "
+                    f"(depth={last_busy.queue_depth} "
+                    f"limit={last_busy.queue_limit}); retry round "
+                    f"{round_idx + 1}/{total_rounds - 1} in {backoff:.2f}s")
                 await asyncio.sleep(backoff)
                 busy.clear()
+                # Each retry round must earn the NEXT one with fresh
+                # sheds — a stale shed from round 0 must not keep the
+                # loop alive after a round of pure dial failures.
+                last_busy = None
                 continue
             break
         raise ClientError(

@@ -13,8 +13,11 @@ Protocol: JSON lines.
   stdin  ← {"op": "submit", "id", "messages", "max_new", "sampling": {…},
             "speculative": bool?,   (optional per-request opt-out of
             speculative decoding; ignored unless tpu.speculative is on)
-            "trace": str?}          (request trace id, threaded into
+            "trace": str?,          (request trace id, threaded into
             scheduler spans so the request correlates across processes)
+            "deadline_s": float?}   (seconds of end-to-end deadline left
+            at submit; the scheduler sheds the request at admission with
+            finish_reason "expired" if it has already passed)
            {"op": "cancel", "id"}
            {"op": "clock", "t0": float}   (clock-offset handshake: the
             provider brackets our CLOCK_MONOTONIC read with its own —
@@ -71,6 +74,7 @@ from typing import TYPE_CHECKING, Any
 from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
 from symmetry_tpu.engine.scheduler import GenRequest, Scheduler
 from symmetry_tpu.provider.config import ConfigManager
+from symmetry_tpu.utils.faults import FAULTS
 from symmetry_tpu.utils.logging import logger
 from symmetry_tpu.utils.trace import Tracer
 
@@ -81,6 +85,12 @@ if TYPE_CHECKING:
 class EngineHost:
     def __init__(self, config: ConfigManager) -> None:
         self._config = config
+        # Fault injection (utils/faults.py): env SYMMETRY_FAULTS is
+        # inherited from the provider and already loaded at import; a
+        # provider-config `faults:` mapping rides the config file here.
+        # (config is None in protocol unit tests that never start().)
+        if config is not None:
+            FAULTS.load(config.get("faults"))
         self._engine: InferenceEngine | None = None
         self._scheduler: Scheduler | None = None
         self._wlock = threading.Lock()
@@ -105,6 +115,8 @@ class EngineHost:
     # ---------------------------------------------------------------- wire
 
     def _write(self, obj: dict[str, Any], *, events: int = 0) -> None:
+        if FAULTS.enabled and FAULTS.point("host.pipe_write"):
+            return  # injected drop_frame: the frame is lost on the wire
         line = json.dumps(obj, separators=(",", ":"))
         t0 = time.monotonic()
         with self._wlock:
@@ -226,6 +238,8 @@ class EngineHost:
             line = line.strip()
             if not line:
                 continue
+            if FAULTS.enabled and FAULTS.point("host.pipe_read"):
+                continue  # injected drop_frame: the command is lost
             try:
                 msg = json.loads(line)
             except ValueError:
@@ -256,6 +270,11 @@ class EngineHost:
                 # reentrant), and a dict-of-ints copy is GIL-atomic enough
                 # for a stats read.
                 m["emit"] = dict(self.emit_stats)
+                if FAULTS.enabled:
+                    # Armed-fault accounting: a chaos run's stats carry
+                    # which seams fired, so the test/bench can assert the
+                    # injection actually happened.
+                    m["faults"] = FAULTS.counters()
                 self._write(m)
             elif op == "shutdown":
                 break
@@ -314,6 +333,7 @@ class EngineHost:
                         events=1)
 
         spec = msg.get("speculative")
+        deadline = msg.get("deadline_s")
         self._scheduler.submit(GenRequest(
             prompt_ids=prompt_ids, sampling=sampling,
             max_new_tokens=int(msg.get("max_new", 512)),
@@ -321,7 +341,12 @@ class EngineHost:
             cancelled=lambda: req_id in self._cancelled,
             id=req_id,
             speculative=spec if isinstance(spec, bool) else None,
-            trace_id=trace_id))
+            trace_id=trace_id,
+            # deadline_s is RELATIVE (seconds left at provider submit);
+            # anchor it to this process's clock at receipt so the
+            # scheduler's admission check needs no cross-process offset.
+            deadline_at=(t_recv + float(deadline)
+                         if deadline is not None else None)))
         # The pipe_in leg as a span: command read → tokenized → enqueued.
         self.tracer.record("host_submit", t_recv,
                            time.monotonic() - t_recv,

@@ -33,6 +33,7 @@ import numpy as np
 
 from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
 from symmetry_tpu.engine.tokenizer import StreamDecoder
+from symmetry_tpu.utils.faults import FAULTS, InjectedFault
 from symmetry_tpu.utils.logging import logger as log
 
 
@@ -55,6 +56,13 @@ class GenRequest:
     # provider → host pipe → here, so scheduler spans for this request
     # land on the same Perfetto timeline as everyone else's.
     trace_id: str = ""
+    # Absolute CLOCK_MONOTONIC deadline (client deadline_s mapped through
+    # provider → host receipt). A request whose deadline has already
+    # passed when admission picks it is shed with finish_reason
+    # "expired" instead of prefilled — under backlog, prefilling work
+    # nobody is waiting for steals device time from requests that still
+    # have a live consumer. None = no deadline.
+    deadline_at: float | None = None
     enqueued_at: float = field(default_factory=time.monotonic)
     # Stamped when the request enters a placement group (the admission
     # moment); re-stamped on re-pick after a budget deferral, so
@@ -70,7 +78,8 @@ class TokenEvent:
     text: str
     token_id: int | None
     done: bool = False
-    finish_reason: str | None = None  # "stop" | "length" | "cancelled" | "error"
+    # "stop" | "length" | "cancelled" | "error" | "expired"
+    finish_reason: str | None = None
     error: str | None = None
     # serving metrics (SURVEY §5.1: TTFT and tok/s are first-class)
     ttft_s: float | None = None
@@ -176,6 +185,10 @@ class Scheduler:
             (1 + spec.k_draft) if spec is not None else 0)
         self.metrics = {"requests": 0, "tokens": 0, "evictions": 0,
                         "steps": 0, "peak_occupancy": 0,
+                        # Requests shed at admission because their
+                        # end-to-end deadline had already expired (the
+                        # overload-round accounting: prefill work saved).
+                        "deadline_shed": 0,
                         # Per-phase wall accounting (round-3 verdict: a
                         # benchmark capture must carry its own explanation):
                         # admission prefill dispatches, chunked-prefill
@@ -661,12 +674,41 @@ class Scheduler:
                         break
                 if item is None:
                     continue
+                if FAULTS.enabled:
+                    # scheduler.admit seam: error → this request fails
+                    # with an error event; drop_frame → it silently
+                    # vanishes (lost work — exactly what the supervisor's
+                    # watchdog exists to notice); crash/hang act on the
+                    # engine thread itself.
+                    try:
+                        if FAULTS.point("scheduler.admit"):
+                            continue
+                    except InjectedFault as exc:
+                        self._emit_cb(item, TokenEvent(
+                            text="", token_id=None, done=True,
+                            finish_reason="error", error=str(exc)))
+                        continue
                 if item.cancelled():
                     # Cancelled while queued still gets its terminal event —
                     # the consumer is awaiting it.
                     self._emit_cb(item, TokenEvent(
                         text="", token_id=None, done=True,
                         finish_reason="cancelled"))
+                    continue
+                if (item.deadline_at is not None
+                        and time.monotonic() > item.deadline_at):
+                    # Deadline shed: the client (or its caller) stopped
+                    # waiting before we could even place the request —
+                    # prefilling it would bill the device for an answer
+                    # nobody reads. Covers inbox and deferred entries
+                    # alike (both pop through here).
+                    self.metrics["deadline_shed"] += 1
+                    late = time.monotonic() - item.deadline_at
+                    self._emit_cb(item, TokenEvent(
+                        text="", token_id=None, done=True,
+                        finish_reason="expired",
+                        error=f"deadline expired {late:.2f}s before "
+                              f"admission"))
                     continue
                 group.append((self._free.pop(), item))
             if not group:
@@ -1053,7 +1095,8 @@ class AsyncSession:
     def submit(self, prompt_ids: list[int], sampling: SamplingParams,
                max_new_tokens: int, request_id: str = "",
                speculative: bool | None = None,
-               trace_id: str = "") -> None:
+               trace_id: str = "",
+               deadline_s: float | None = None) -> None:
         def emit(ev: TokenEvent) -> None:
             self._loop.call_soon_threadsafe(self._queue.put_nowait, ev)
 
@@ -1061,7 +1104,9 @@ class AsyncSession:
             prompt_ids=prompt_ids, sampling=sampling,
             max_new_tokens=max_new_tokens, emit=emit,
             cancelled=lambda: self._cancelled, id=request_id,
-            speculative=speculative, trace_id=trace_id))
+            speculative=speculative, trace_id=trace_id,
+            deadline_at=(time.monotonic() + deadline_s
+                         if deadline_s is not None else None)))
 
     async def events(self):
         while True:

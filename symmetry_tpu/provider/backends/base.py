@@ -42,6 +42,11 @@ class InferenceRequest:
     # it through the host pipe so scheduler spans correlate with the
     # client's on one Perfetto timeline. "" = untraced.
     trace_id: str = ""
+    # End-to-end deadline in seconds from provider receipt (client
+    # "deadline_s"). Engine backends thread it to the scheduler, which
+    # sheds an already-expired request at admission instead of prefilling
+    # work nobody is waiting for. None = no deadline.
+    deadline_s: float | None = None
 
 
 @dataclass(slots=True)
@@ -101,6 +106,25 @@ class InferenceBackend(abc.ABC):
 
 class BackendError(RuntimeError):
     pass
+
+
+class BackendRestartingError(BackendError):
+    """The engine host died (crash or wedge) and its supervisor is
+    respawning it. RETRYABLE: the request itself is fine, the provider
+    will be back — the provider relays this as a structured
+    ``{"restarting": true}`` shed and clients fail over immediately
+    (client.ProviderRestartingError joins the busy-shed backoff path)."""
+
+    def __init__(self, message: str,
+                 retry_after_s: float | None = None) -> None:
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class BackendDeadlineError(BackendError):
+    """The request's end-to-end deadline expired before it was served
+    (scheduler admission shed). NOT retryable — by definition nobody is
+    waiting for the answer anymore."""
 
 
 def get_backend(config: Any) -> InferenceBackend:

@@ -115,6 +115,28 @@ class TpuConfig:
     # latency with it (engine/host.py). "inproc": same-process engine
     # thread (tests, debugging).
     engine_isolation: str = "process"
+    # Engine-host supervision (process isolation only): a heartbeat
+    # watchdog piggybacked on the host stats op detects crashes AND
+    # wedges with a much tighter deadline than the 15 s provider health
+    # loop, fails every in-flight stream with a retryable
+    # {"restarting": true} shed, and auto-respawns the host (warm
+    # compile cache makes a config-identical respawn cheap) with
+    # exponential backoff; only after max_respawns CONSECUTIVE failed
+    # respawns does the circuit breaker open and the provider deregister
+    # (the pre-supervisor behavior). Keys (all optional):
+    #   enabled: bool = true         supervision on/off
+    #   heartbeat_s: float = 5.0     watchdog probe cadence
+    #   wedge_timeout_s: float = 5.0 no stats reply within this → wedged
+    #   backoff_base_s: float = 0.5  first-respawn delay (doubles per
+    #                                consecutive failure)
+    #   backoff_max_s: float = 15.0  backoff ceiling
+    #   max_respawns: int = 3        consecutive failures → circuit open
+    #   min_stable_s: float = 5.0    a life must survive this long to
+    #                                reset the failure count (crash-LOOPs
+    #                                trip the breaker, not flap forever)
+    #   spawn_timeout_s: float = 600 respawn must reach ready within this
+    #   stop_grace_s: float = 30     shutdown drain before SIGKILL
+    supervisor: dict[str, Any] | None = None
     pipeline_microbatches: int = 1     # GPipe microbatches (mesh stage > 1)
     checkpoint_path: str | None = None  # HF safetensors dir; None → random init
     # Cache the finished (stacked/transposed/quantized) param tree beside

@@ -30,7 +30,9 @@ from symmetry_tpu.identity import Identity
 from symmetry_tpu.network.peer import Peer
 from symmetry_tpu.protocol.keys import MessageKey
 from symmetry_tpu.provider.backends.base import (
+    BackendDeadlineError,
     BackendError,
+    BackendRestartingError,
     InferenceBackend,
     InferenceRequest,
     get_backend,
@@ -39,6 +41,7 @@ from symmetry_tpu.provider.collect import DataCollector
 from symmetry_tpu.provider.config import ConfigManager
 from symmetry_tpu.server import tokens as session_tokens
 from symmetry_tpu.transport.base import Connection, Listener, Transport
+from symmetry_tpu.utils.faults import FAULTS, InjectedFault
 from symmetry_tpu.utils.logging import log_context, logger
 from symmetry_tpu.utils.trace import FlightRecorder, Tracer
 
@@ -162,6 +165,11 @@ class SymmetryProvider:
                 # YAML value must fail/convert HERE, not as a TypeError
                 # in the per-request SLO comparison.
                 slo_e2e_s=float(slo) if slo is not None else None)
+        # Fault injection (utils/faults.py): a `faults:` mapping in
+        # provider.yaml arms seams in THIS process (the host subprocess
+        # loads the same mapping from its config copy; SYMMETRY_FAULTS
+        # env reaches both at import). No-op when absent.
+        FAULTS.load(self.config.get("faults"))
 
     # ----- lifecycle (reference: init(), src/provider.ts:37-81) -----
 
@@ -172,6 +180,11 @@ class SymmetryProvider:
 
     async def start(self, listen_address: str | None = None) -> None:
         await self.backend.start()
+        if hasattr(self.backend, "on_host_restart"):
+            # Supervised engine host (tpu_native process mode): every
+            # crash/wedge the supervisor handles dumps the flight
+            # recorder FIRST — the restart must not erase the evidence.
+            self.backend.on_host_restart = self._on_backend_restart
         listen_address = listen_address or (
             f"{self._transport.scheme}://"
             f"{self.config.get('listenHost', '0.0.0.0')}"
@@ -207,6 +220,14 @@ class SymmetryProvider:
         except (NotImplementedError, ValueError, RuntimeError):
             logger.debug("SIGUSR2 flight-recorder trigger unavailable "
                          "on this platform/thread")
+
+    def _on_backend_restart(self, reason: str) -> None:
+        """Backend supervisor hook: an engine-host death/wedge is being
+        handled. Leave the debuggable artifact (forced flight dump — the
+        window still holds the death) and say so loudly."""
+        logger.error(f"engine host {reason}; supervisor restarting it")
+        if self.flight is not None:
+            self._spawn(self._flight_dump(f"host_{reason}", force=True))
 
     def _start_puncher(self) -> None:
         """NAT hole punching (network/natpunch.py): keep this provider
@@ -451,6 +472,9 @@ class SymmetryProvider:
             # (clock skew → silently undiscoverable; network/dht.py).
             **({"dht_discoverable": self._dht.is_discoverable}
                if self._dht is not None else {}),
+            # Chaos-drill accounting: which armed fault seams fired in
+            # this process (absent when no faults are configured).
+            **({"faults": FAULTS.counters()} if FAULTS.enabled else {}),
         }
 
     async def gather_trace(self) -> dict[str, Any]:
@@ -512,9 +536,43 @@ class SymmetryProvider:
 
     # ----- client peers (reference: listeners(), src/provider.ts:173-193) -----
 
+    async def _refuse_peer(self, conn: Connection, reason: str,
+                           draining: bool = False) -> None:
+        """Refuse a new connection LOUDLY: complete the handshake, send a
+        structured shed, close. The old silent close left the dialer
+        hanging in its Noise handshake until some timeout — a refusing
+        provider must cost a client milliseconds, not a timeout, before
+        it fails over. `draining` marks the shed terminal for THIS
+        provider (shutting down — never coming back), vs a busy/capacity
+        shed that a backoff retry may legitimately revisit."""
+        self.metrics["shed"] += 1
+        try:
+            # Short handshake hold on purpose: the refusal path runs
+            # exactly when the provider is saturated (or leaving), and a
+            # slow/hostile dialer must not pin refused connections open —
+            # the handshake work per refusal is the price of a structured
+            # shed, the hold time doesn't have to be.
+            peer = await asyncio.wait_for(
+                Peer.connect(conn, self.identity, initiator=False), 2.0)
+            await peer.send(MessageKey.INFERENCE_ERROR,
+                            {"error": reason, "busy": True,
+                             **({"draining": True} if draining else {})})
+            await peer.close()
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            with contextlib.suppress(Exception):
+                await conn.close()
+
     async def _on_peer(self, conn: Connection) -> None:
-        if self._draining or len(self._client_peers) >= self.config.max_connections:
-            await conn.close()  # maxConnections cap (src/provider.ts:38-40)
+        if self._draining:
+            await self._refuse_peer(conn, "provider draining",
+                                    draining=True)
+            return
+        if len(self._client_peers) >= self.config.max_connections:
+            # maxConnections cap (src/provider.ts:38-40) — refused with
+            # the same structured shed as draining (minus the terminal
+            # flag): the dialer fails over in milliseconds instead of
+            # timing out in its handshake against a silent close.
+            await self._refuse_peer(conn, "provider at connection limit")
             return
         peer = await Peer.connect(conn, self.identity, initiator=False)
         self._client_peers.add(peer)
@@ -716,6 +774,24 @@ class SymmetryProvider:
         if shed_reason is not None:
             await self._shed(peer, tag, shed_reason)
             return
+        deadline_s = data.get("deadline_s")
+        if deadline_s is not None:
+            try:
+                deadline_s = float(deadline_s)
+            except (TypeError, ValueError):
+                await peer.send(MessageKey.INFERENCE_ERROR,
+                                {"error": "invalid deadline_s", **tag})
+                return
+            if deadline_s <= 0:
+                # Already expired on arrival: shed without touching the
+                # backend. NOT retryable (no "busy") — by definition the
+                # caller stopped waiting, so failover would only burn
+                # another provider's admission slot.
+                self.metrics["shed"] += 1
+                await peer.send(MessageKey.INFERENCE_ERROR,
+                                {"error": "deadline_s already expired",
+                                 "expired": True, **tag})
+                return
         spec = data.get("speculative")
         trace_id = str(data.get("traceId") or "")
         request = InferenceRequest(
@@ -727,6 +803,7 @@ class SymmetryProvider:
             seed=data.get("seed"),
             speculative=spec if isinstance(spec, bool) else None,
             trace_id=trace_id,
+            deadline_s=deadline_s,
         )
         self._in_flight += 1
         self._unstarted += 1
@@ -760,6 +837,8 @@ class SymmetryProvider:
                     # Mid-stream client death tolerated (src/provider.ts:242,253-254).
                     logger.debug("client gone mid-stream; aborting pump")
                     break
+                if FAULTS.enabled and await FAULTS.apoint("provider.relay"):
+                    continue  # injected drop_frame: this chunk is lost
                 if chunk.text:
                     completion_parts.append(chunk.text)
                     # Engine backends report exact per-chunk token counts
@@ -808,6 +887,35 @@ class SymmetryProvider:
                 completion=completion,
             )
             await self._report_completion(data, n_tokens)
+        except BackendRestartingError as exc:
+            # Engine host crash/wedge: the STRUCTURED retryable shed —
+            # the client fails over immediately and (after a backoff
+            # round) may return once the supervisor finishes the respawn.
+            # No per-stream flight dump: the supervisor's restart hook
+            # already captured the death once, and N in-flight streams
+            # must not race N dumps of the same window.
+            self.metrics["errors"] += 1
+            logger.error(f"backend restarting: {exc}")
+            if not peer.closed:
+                with contextlib.suppress(ConnectionError, OSError):
+                    await peer.send(MessageKey.INFERENCE_ERROR,
+                                    {"error": str(exc), "busy": True,
+                                     "restarting": True,
+                                     **({"retryAfterS":
+                                         round(exc.retry_after_s, 3)}
+                                        if exc.retry_after_s is not None
+                                        else {}),
+                                     **tag})
+        except BackendDeadlineError as exc:
+            # Deadline expired before service (scheduler admission shed):
+            # terminal for this request, not a provider failure.
+            self.metrics["shed"] += 1
+            logger.debug(f"deadline shed: {exc}")
+            if not peer.closed:
+                with contextlib.suppress(ConnectionError, OSError):
+                    await peer.send(MessageKey.INFERENCE_ERROR,
+                                    {"error": str(exc), "expired": True,
+                                     **tag})
         except BackendError as exc:
             self.metrics["errors"] += 1
             logger.error(f"backend error: {exc}")
@@ -817,6 +925,13 @@ class SymmetryProvider:
                 with contextlib.suppress(ConnectionError, OSError):
                     await peer.send(MessageKey.INFERENCE_ERROR,
                                     {"error": str(exc), **tag})
+        except InjectedFault as exc:
+            # A fault armed at a provider-level seam fired: simulate the
+            # crash it stands in for — drop the client cold (no error
+            # frame), exactly what a dying provider process would do.
+            self.metrics["errors"] += 1
+            logger.error(f"injected fault: {exc}; dropping peer")
+            await peer.close()
         except asyncio.CancelledError:
             # inferenceCancel (or shutdown): closing the generator frees
             # the engine slot; tell the client the stream is over

@@ -1,0 +1,131 @@
+"""Protocol-faithful engine-host stand-in for the supervisor chaos suite.
+
+Speaks exactly the engine/host.py JSON-lines pipe protocol (ready, clock
+handshake, stats, submit → event stream, cancel, shutdown) without
+importing JAX or building a model, so a supervisor test can kill, wedge,
+and respawn host "lives" in milliseconds instead of paying an engine
+build per life. The chaos seams are the REAL ones — every pipe write
+passes `FAULTS.point("host.pipe_write")` and every command read passes
+`FAULTS.point("host.pipe_read")` (symmetry_tpu/utils/faults.py), armed
+through the same `faults:` config mapping / SYMMETRY_FAULTS env the real
+host honors.
+
+Extra config under `fakeHost:` (test-only):
+  failFile:   if this path exists at startup, exit(1) BEFORE ready —
+              simulates a persistently failing respawn (circuit-breaker
+              fixture; each life re-checks, so the test controls when
+              respawns start failing by creating/removing the file)
+  tokenDelayS: inter-event sleep while streaming (default 0.02 s), wide
+              enough that an armed crash reliably lands mid-stream
+  dieAfterS:  hard-crash (os._exit) this long after ready — the
+              crash-LOOP fixture: every spawn succeeds, every life dies
+              young, and the supervisor's stability accounting (not
+              spawn success) must walk it into the circuit breaker
+
+Run: python tests/fake_host.py <config.yaml>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import yaml
+
+# Script-path execution puts tests/ (not the repo root) on sys.path; the
+# real host avoids this via `-m`. Make symmetry_tpu importable regardless
+# of the spawning process's cwd.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from symmetry_tpu.utils.faults import FAULTS  # noqa: E402
+
+
+class FakeHost:
+    def __init__(self, cfg: dict) -> None:
+        self._cfg = cfg
+        self._wlock = threading.Lock()
+        self._cancelled: set[str] = set()
+        fh = cfg.get("fakeHost") or {}
+        self._fail_path = fh.get("failFile")
+        self._delay = float(fh.get("tokenDelayS", 0.02))
+        self._die_after = fh.get("dieAfterS")
+        FAULTS.load(cfg.get("faults"))
+
+    def write(self, obj: dict) -> None:
+        if FAULTS.enabled and FAULTS.point("host.pipe_write"):
+            return  # injected drop_frame
+        with self._wlock:
+            sys.stdout.write(json.dumps(obj, separators=(",", ":")) + "\n")
+            sys.stdout.flush()
+
+    def _stream(self, msg: dict) -> None:
+        req_id = str(msg.get("id", ""))
+        n = max(1, min(int(msg.get("max_new", 4)), 64))
+        for i in range(n - 1):
+            if req_id in self._cancelled:
+                break
+            self.write({"op": "event", "id": req_id, "text": f"t{i} ",
+                        "tokens": i + 1, "tokens_new": 1})
+            time.sleep(self._delay)
+        self.write({"op": "event", "id": req_id, "text": "", "done": True,
+                    "finish_reason": "stop", "tokens": n, "tokens_new": 0})
+        self._cancelled.discard(req_id)
+
+    def serve(self) -> int:
+        if self._fail_path and os.path.exists(self._fail_path):
+            print("fake host: failFile present; dying before ready",
+                  file=sys.stderr)
+            return 1
+        if self._die_after is not None:
+            threading.Timer(float(self._die_after),
+                            lambda: os._exit(86)).start()
+        self.write({"op": "ready", "model": self._cfg.get("modelName", "fake"),
+                    "slots": 4, "max_seq_len": 128,
+                    "build_s": 0.0, "warmup_s": 0.0})
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            if FAULTS.enabled and FAULTS.point("host.pipe_read"):
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            op = msg.get("op")
+            if op == "clock":
+                self.write({"op": "clock", "t0": msg.get("t0"),
+                            "t": time.monotonic()})
+            elif op == "stats":
+                self.write({"op": "stats", "engine_alive": True,
+                            "requests": 0, "tokens": 0,
+                            **({"faults": FAULTS.counters()}
+                               if FAULTS.enabled else {})})
+            elif op == "submit":
+                threading.Thread(target=self._stream, args=(msg,),
+                                 daemon=True).start()
+            elif op == "cancel":
+                self._cancelled.add(str(msg.get("id", "")))
+            elif op == "trace":
+                self.write({"op": "trace", "clock": time.monotonic(),
+                            "components": []})
+            elif op == "shutdown":
+                return 0
+        return 0
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print("usage: python tests/fake_host.py <config.yaml>",
+              file=sys.stderr)
+        return 2
+    with open(sys.argv[1], "r", encoding="utf-8") as fh:
+        cfg = yaml.safe_load(fh) or {}
+    return FakeHost(cfg).serve()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,17 +8,26 @@ excluded, completing the chat on the survivor (SURVEY §5.3).
 """
 
 import asyncio
+import time
 
 import pytest
 
 from symmetry_tpu.client.client import (
     ChatRestart,
     ClientError,
+    DeadlineExceededError,
     ProviderBusyError,
+    ProviderGoneError,
+    ProviderRestartingError,
     SymmetryClient,
+    busy_retry_backoff,
 )
 from symmetry_tpu.identity import Identity
-from symmetry_tpu.provider.backends.base import InferenceBackend, StreamChunk
+from symmetry_tpu.provider.backends.base import (
+    BackendRestartingError,
+    InferenceBackend,
+    StreamChunk,
+)
 from symmetry_tpu.provider.config import ConfigManager
 from symmetry_tpu.provider.provider import SymmetryProvider
 from symmetry_tpu.server.broker import SymmetryServer
@@ -260,6 +269,209 @@ class TestFailover:
         assert reason is not None and reason["queueLimit"] == 4
         assert reason["queueDepth"] == 2  # 4 in flight - 2 slots
 
+    def test_restarting_shed_fails_over_to_second_provider(self):
+        """An engine-host restart mid-service is the structured
+        {"restarting": true} shed: chat_failover treats it like a busy
+        shed (fail over NOW, provider not excluded as dead) and the
+        request completes on the survivor."""
+        class RestartingBackend(InferenceBackend):
+            name = "restarting"
+
+            async def stream(self, request):
+                raise BackendRestartingError("engine host restarting",
+                                             retry_after_s=0.25)
+                yield  # pragma: no cover — makes this an async generator
+
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("fo-server6")
+            server, p1, p2 = await start_network(hub, ident,
+                                                 slow_first=False)
+            p1.backend = RestartingBackend()
+            client = SymmetryClient(Identity.from_name("fo-cli6"), hub)
+            server.registry.set_connections(p2.identity.public_hex, 5)
+
+            events = []
+            async for item in client.chat_failover(
+                    "mem://server", ident.public_key, "tiny:fo",
+                    [{"role": "user", "content": "restart path"}]):
+                events.append(item)
+
+            restarts = [e for e in events if isinstance(e, ChatRestart)]
+            assert len(restarts) == 1
+            assert restarts[0].provider_key == p2.identity.public_hex
+            assert "".join(e for e in events
+                           if isinstance(e, str)) == "restart path"
+            assert p1.metrics["errors"] == 1
+            await p1.stop(drain_timeout_s=1)
+            await p2.stop(drain_timeout_s=1)
+            await server.stop()
+
+        run(main())
+
+    def test_restarting_raises_structured_error_direct(self):
+        """A non-failover client sees ProviderRestartingError (a
+        ProviderBusyError subclass — same backoff machinery) carrying
+        the provider's retry_after hint."""
+        class RestartingBackend(InferenceBackend):
+            name = "restarting"
+
+            async def stream(self, request):
+                raise BackendRestartingError("engine host restarting",
+                                             retry_after_s=1.5)
+                yield  # pragma: no cover
+
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("fo-server7")
+            server, p1, p2 = await start_network(hub, ident,
+                                                 slow_first=False)
+            p1.backend = RestartingBackend()
+            client = SymmetryClient(Identity.from_name("fo-cli7"), hub)
+            server.registry.set_connections(p2.identity.public_hex, 5)
+
+            details = await client.request_provider(
+                "mem://server", ident.public_key, "tiny:fo")
+            assert details.peer_key == p1.identity.public_hex
+            session = await client.connect(details)
+            try:
+                with pytest.raises(ProviderRestartingError) as exc_info:
+                    async for _ in session.chat(
+                            [{"role": "user", "content": "x"}]):
+                        pass
+                assert exc_info.value.retry_after_s == 1.5
+                assert isinstance(exc_info.value, ProviderBusyError)
+            finally:
+                await session.close()
+            await p1.stop(drain_timeout_s=1)
+            await p2.stop(drain_timeout_s=1)
+            await server.stop()
+
+        run(main())
+
+    def test_draining_provider_sheds_structurally_and_fails_over(self):
+        """provider.py used to refuse new connections while draining by
+        silently closing them — the dialer hung in its handshake until a
+        timeout. Now the refusal is a structured busy/draining shed after
+        a completed handshake: a direct client fails FAST with a
+        retryable error, and chat_failover completes on the survivor."""
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("fo-server8")
+            server, p1, p2 = await start_network(hub, ident,
+                                                 slow_first=False)
+            client = SymmetryClient(Identity.from_name("fo-cli8"), hub)
+            server.registry.set_connections(p2.identity.public_hex, 5)
+
+            p1._draining = True  # drain began; in-flight would continue
+
+            # Direct: the refusal must arrive fast and be retryable —
+            # the structured busy/draining error, or (if the close
+            # outraces the client's send) a gone/connection error; never
+            # a silent multi-second hang.
+            t0 = time.monotonic()
+            details = await client.request_provider(
+                "mem://server", ident.public_key, "tiny:fo")
+            assert details.peer_key == p1.identity.public_hex
+            with pytest.raises((ProviderBusyError, ProviderGoneError,
+                                ConnectionError, OSError)):
+                session = await client.connect(details)
+                try:
+                    async for _ in session.chat(
+                            [{"role": "user", "content": "x"}]):
+                        pass
+                finally:
+                    await session.close()
+            assert time.monotonic() - t0 < 5.0
+            assert p1.metrics["shed"] >= 1
+
+            # Failover: the draining provider costs one fast attempt.
+            events = []
+            async for item in client.chat_failover(
+                    "mem://server", ident.public_key, "tiny:fo",
+                    [{"role": "user", "content": "drain path"}]):
+                events.append(item)
+            assert "".join(e for e in events
+                           if isinstance(e, str)) == "drain path"
+            await p1.stop(drain_timeout_s=1)
+            await p2.stop(drain_timeout_s=1)
+            await server.stop()
+
+        run(main())
+
+    def test_expired_deadline_shed_is_terminal_not_retried(self):
+        """deadline_s <= 0 on arrival: the provider sheds with the
+        structured expired error, the client raises the non-retryable
+        DeadlineExceededError, and failover does NOT burn the second
+        provider on an answer nobody awaits."""
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("fo-server9")
+            server, p1, p2 = await start_network(hub, ident,
+                                                 slow_first=False)
+            client = SymmetryClient(Identity.from_name("fo-cli9"), hub)
+            server.registry.set_connections(p2.identity.public_hex, 5)
+
+            details = await client.request_provider(
+                "mem://server", ident.public_key, "tiny:fo")
+            session = await client.connect(details)
+            try:
+                with pytest.raises(DeadlineExceededError):
+                    async for _ in session.chat(
+                            [{"role": "user", "content": "x"}],
+                            deadline_s=0):
+                        pass
+            finally:
+                await session.close()
+            assert p1.metrics["shed"] == 1
+
+            with pytest.raises(DeadlineExceededError):
+                async for _ in client.chat_failover(
+                        "mem://server", ident.public_key, "tiny:fo",
+                        [{"role": "user", "content": "x"}], deadline_s=0):
+                    pass
+            assert p2.metrics["requests"] == 0  # never failed over
+            await p1.stop(drain_timeout_s=1)
+            await p2.stop(drain_timeout_s=1)
+            await server.stop()
+
+        run(main())
+
+    def test_busy_retry_rounds_zero_disables_retry(self):
+        """The retry-round cap: busy_retry_rounds=0 fails a fully-shed
+        pool after ONE round (2 sheds), where the default would come
+        back for a second."""
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("fo-server10")
+            server, p1, p2 = await start_network(hub, ident,
+                                                 slow_first=False)
+            for prov in (p1, p2):
+                prov.backend.slots = 0
+                prov.backend.queue_limit = 0
+            client = SymmetryClient(Identity.from_name("fo-cli10"), hub)
+
+            with pytest.raises(ClientError, match="chat failed"):
+                async for _ in client.chat_failover(
+                        "mem://server", ident.public_key, "tiny:fo",
+                        [{"role": "user", "content": "x"}],
+                        busy_retry_rounds=0):
+                    pass
+            assert p1.metrics["shed"] + p2.metrics["shed"] == 2
+
+            with pytest.raises(ClientError, match="chat failed"):
+                async for _ in client.chat_failover(
+                        "mem://server", ident.public_key, "tiny:fo",
+                        [{"role": "user", "content": "x"}]):
+                    pass
+            # default: one jittered retry round re-tried both providers
+            assert p1.metrics["shed"] + p2.metrics["shed"] == 4
+            await p1.stop(drain_timeout_s=1)
+            await p2.stop(drain_timeout_s=1)
+            await server.stop()
+
+        run(main())
+
     def test_failover_exhaustion_raises(self):
         async def main():
             hub = MemoryTransport()
@@ -275,3 +487,42 @@ class TestFailover:
             await server.stop()
 
         run(main())
+
+
+class TestBusyRetryBackoff:
+    """The jittered backoff formula (client.busy_retry_backoff): herd
+    desynchronization is load-bearing for recovering providers, so the
+    bounds are pinned."""
+
+    def test_jitter_bounds(self):
+        lo = busy_retry_backoff(4, 4, rand=lambda: 0.0)
+        hi = busy_retry_backoff(4, 4, rand=lambda: 1.0)
+        assert lo == pytest.approx(0.25)   # 0.5 × base 0.5
+        assert hi == pytest.approx(0.75)   # 1.5 × base 0.5
+        # jitter actually varies across calls with the real RNG
+        vals = {round(busy_retry_backoff(4, 4), 6) for _ in range(16)}
+        assert len(vals) > 1
+
+    def test_round_escalation_doubles_base_with_ceiling(self):
+        r0 = busy_retry_backoff(4, 4, round_idx=0, rand=lambda: 0.5)
+        r1 = busy_retry_backoff(4, 4, round_idx=1, rand=lambda: 0.5)
+        assert r1 == pytest.approx(2 * r0)
+        # escalation is capped: many-round persistence must not become
+        # quarter-hour sleeps
+        r9 = busy_retry_backoff(4, 4, round_idx=9, rand=lambda: 0.5)
+        assert r9 == pytest.approx(
+            busy_retry_backoff(4, 4, round_idx=4, rand=lambda: 0.5))
+        assert r9 <= 32.0
+
+    def test_retry_after_hint_is_a_hard_floor(self):
+        # The hint is ADDED under the jittered wait — even minimal
+        # jitter can never schedule the retry before the provider's own
+        # respawn ETA (that retry would be shed with certainty).
+        v = busy_retry_backoff(0, 4, retry_after_s=3.0, rand=lambda: 0.0)
+        assert v >= 3.0
+        assert v == pytest.approx(3.125)  # 3.0 + 0.5 × base 0.25
+
+    def test_depth_scales_and_caps(self):
+        shallow = busy_retry_backoff(0, 8, rand=lambda: 0.5)
+        deep = busy_retry_backoff(800, 8, rand=lambda: 0.5)
+        assert shallow < deep <= 2.0  # capped base, never a self-stall

@@ -1,0 +1,124 @@
+"""CI chaos smoke: a mid-stream injected crash must complete via failover.
+
+Runs the full client → server → two-echo-provider path on the in-memory
+transport, arms ONE fault — `provider.relay=error@nth=3`, which kills the
+serving provider's third chunk relay and drops the client cold (the
+injected stand-in for a provider process dying mid-stream) — and asserts:
+
+  - the first provider actually streamed before dying (the fault landed
+    MID-stream, not at admission);
+  - chat_failover recovers on the second provider with exactly one
+    ChatRestart sentinel and byte-identical final text;
+  - the fault accounting (provider stats `faults` block) confirms the
+    seam fired exactly once.
+
+Then the no-op contract: with no faults configured, an instrumented seam
+must cost one attribute read — 200k guarded hits in well under half a
+second (order-of-magnitude headroom on CI machines) and zero behavior.
+
+Exit 0 on success; exit 1 with a reason otherwise.
+
+Run: python tools/chaos_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import time
+
+
+async def run() -> int:
+    from symmetry_tpu.client.client import ChatRestart, SymmetryClient
+    from symmetry_tpu.identity import Identity
+    from symmetry_tpu.provider.config import ConfigManager
+    from symmetry_tpu.provider.provider import SymmetryProvider
+    from symmetry_tpu.server.broker import SymmetryServer
+    from symmetry_tpu.utils.faults import FAULTS
+    from symmetry_tpu.transport.memory import MemoryTransport
+
+    hub = MemoryTransport()
+    server_ident = Identity.from_name("chaos-smoke-server")
+    server = SymmetryServer(server_ident, hub, ping_interval_s=30.0)
+    await server.start("mem://server")
+
+    def provider_cfg(name):
+        return ConfigManager(config={
+            "name": name, "public": True,
+            "serverKey": server_ident.public_hex,
+            "modelName": "echo:chaos", "apiProvider": "echo",
+            "dataCollectionEnabled": False,
+            "flightRecorder": {"enabled": False},
+        })
+
+    providers = []
+    for name in ("chaos-smoke-p1", "chaos-smoke-p2"):
+        prov = SymmetryProvider(
+            provider_cfg(name), transport=hub,
+            identity=Identity.from_name(name),
+            server_address="mem://server")
+        await prov.start(f"mem://{name}")
+        await prov.wait_registered()
+        providers.append(prov)
+    p1, p2 = providers
+    # Steer the first assignment to p1 deterministically.
+    server.registry.set_connections(p2.identity.public_hex, 5)
+
+    # The injected mid-stream crash: the serving provider's 3rd chunk
+    # relay raises InjectedFault, which the provider treats as its own
+    # death for that client — connection dropped, no error frame. nth
+    # counts GLOBAL seam hits in this process, so after it fires on p1
+    # the survivor streams clean.
+    FAULTS.load("provider.relay=error(injected mid-stream crash)@nth=3")
+
+    prompt = "the quick brown fox jumps over the lazy dog"
+    client = SymmetryClient(Identity.from_name("chaos-smoke-cli"), hub)
+    events = []
+    async for item in client.chat_failover(
+            "mem://server", server_ident.public_key, "echo:chaos",
+            [{"role": "user", "content": prompt}]):
+        events.append(item)
+
+    restarts = [e for e in events if isinstance(e, ChatRestart)]
+    assert len(restarts) == 1, f"expected 1 failover restart, got {restarts}"
+    assert restarts[0].provider_key == p2.identity.public_hex, \
+        "failover did not land on the survivor"
+    cut = events.index(restarts[0])
+    pre = [e for e in events[:cut] if isinstance(e, str)]
+    assert pre, "fault fired before ANY chunk streamed — not mid-stream"
+    final = "".join(e for e in events[cut + 1:] if isinstance(e, str))
+    assert final == prompt, f"completion mismatch after failover: {final!r}"
+    fired = p1.stats().get("faults", {}).get("provider.relay", {})
+    assert fired.get("fired") == 1, f"relay seam accounting wrong: {fired}"
+    print(f"chaos smoke: crash after {len(pre)} chunk(s) on p1; "
+          f"failover completed {len(final)} chars on p2")
+
+    FAULTS.clear()
+    for prov in providers:
+        await prov.stop(drain_timeout_s=1)
+    await server.stop()
+
+    # ---- no-op overhead contract --------------------------------------
+    assert FAULTS.enabled is False
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        if FAULTS.enabled and FAULTS.point("provider.relay"):
+            pass
+    dt = time.perf_counter() - t0
+    assert dt < 0.5, f"unconfigured seam overhead too high: {dt:.3f}s/200k"
+    print(f"chaos smoke: unconfigured seam = {dt / 200_000 * 1e9:.1f}ns/hit "
+          f"(200k guarded hits in {dt * 1e3:.1f}ms)")
+    return 0
+
+
+def main() -> int:
+    try:
+        return asyncio.new_event_loop().run_until_complete(
+            asyncio.wait_for(run(), 120))
+    except AssertionError as exc:
+        print(f"chaos smoke FAILED: {exc}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
