@@ -289,7 +289,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             prefix_cache_mb: float | None = None,
             speculative: bool = False, draft_k: int = 8,
             fused_dequant: bool = False, trace_out: str | None = None,
-            tracing: bool = True) -> dict:
+            tracing: bool = True, disagg: bool = False,
+            multi_turn: int = 1) -> dict:
     """The NORTH-STAR measurement (BASELINE.json metric): aggregate WIRE
     tok/s and p50/p99 TTFT through the full serving path — server +
     tpu_native provider + N concurrent streaming clients over TCP
@@ -355,6 +356,11 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 **({"speculative": {"k_draft": draft_k}}
                    if speculative else {}),
                 **({"fused_dequant": True} if fused_dequant else {}),
+                # Disaggregated prefill/decode: the provider runs a
+                # prefill host + decode host pair with KV handoff
+                # (engine/disagg/); handoff counters land in the JSON's
+                # engine.disagg block.
+                **({"role": "disagg"} if disagg else {}),
                 # tracing=False empties the engine-side span rings — the
                 # A/B knob for proving the recorder's overhead stays
                 # under 1% of greedy decode tok/s (--no-trace vs default
@@ -504,9 +510,32 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             elapsed = (max(done_ts) - t0) if done_ts else 0.0
             return results, t0, elapsed
 
+        # Multi-turn conversation workload (ROADMAP item 5): each client
+        # holds ONE session of `multi_turn` turns, re-submitting the full
+        # history every turn — the traffic shape where the prefix cache
+        # acts as a session cache (turn N's prompt extends turn N-1's
+        # prompt + reply, so its aligned prefix is already cached) and
+        # where disaggregation + prefix handoff should shine: turn-2+
+        # admissions pay only the new tokens. Greedy, so history growth
+        # is deterministic per client. Per-turn content is sized so every
+        # turn's full prompt still fits the bucket: budget the bucket
+        # over the turns, minus the reply and template overhead.
+        turn_room = (bucket // multi_turn - max_new - 24
+                     if multi_turn > 1 else 0)
+        if multi_turn > 1 and turn_room < 8:
+            raise RuntimeError(
+                f"--multi-turn {multi_turn} does not fit --prompt-len "
+                f"{bucket} with --max-new {max_new}: each turn needs "
+                f">= 8 chars of user content after the reply and chat "
+                f"template (have {turn_room})")
+
         async def one_client(i: int) -> dict:
             # stagger_s > 0 = steady-operation arrival pattern (one client
-            # every stagger_s); 0 = thundering herd (worst-case TTFT)
+            # every stagger_s); 0 = thundering herd (worst-case TTFT).
+            # One code path serves both workload shapes: the default is a
+            # single turn of prompts[i] (sampled, seeded); multi_turn > 1
+            # runs a whole conversation on the session, greedy, growing
+            # the history each turn and recording per-turn TTFT.
             nonlocal connected
             client = SymmetryClient(Identity.from_name(f"bench-cli-{i}"),
                                     TcpTransport())
@@ -518,34 +547,55 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 all_connected.set()
             await ready.wait()
             await asyncio.sleep(i * stagger_s)
-            t_send = _time.perf_counter()
-            t_first = None
-            chars = 0
+            history: list[dict] = []
+            turn_ttfts: list[float] = []
             stamps: list[tuple[float, int]] = []  # (arrival, chars)
+            tokens = 0
+            t_first_any = None
+            t_begin = _time.perf_counter()
             try:
-                async for delta in session.chat(
-                        [{"role": "user", "content": prompts[i]}],
-                        max_tokens=max_new, temperature=0.7, seed=i):
-                    now = _time.perf_counter()
-                    if t_first is None and delta:
-                        t_first = now
-                    chars += len(delta)
-                    stamps.append((now, len(delta)))
-                tokens = int((session.last_usage or {}).get("tokens", 0))
-            except ProviderBusyError as exc:
-                # Overload shedding: an explicit, immediate rejection —
-                # the bounded-latency alternative to unbounded queueing.
-                # Counted separately; never mixed into serving latency.
-                return {"rejected": True,
-                        "reject_s": _time.perf_counter() - t_send,
-                        "queue_depth": exc.queue_depth}
+                for turn in range(max(multi_turn, 1)):
+                    history.append({
+                        "role": "user",
+                        "content": (prompts[i] if multi_turn <= 1 else
+                                    f"turn {turn}: client {i:04d} asks "
+                                    + "m" * max(1, turn_room - 30))})
+                    t_send = _time.perf_counter()
+                    t_first = None
+                    reply: list[str] = []
+                    try:
+                        async for delta in session.chat(
+                                history, max_tokens=max_new,
+                                temperature=(0.0 if multi_turn > 1
+                                             else 0.7), seed=i):
+                            now = _time.perf_counter()
+                            if t_first is None and delta:
+                                t_first = now
+                                if t_first_any is None:
+                                    t_first_any = now
+                            reply.append(delta)
+                            stamps.append((now, len(delta)))
+                        tokens += int(
+                            (session.last_usage or {}).get("tokens", 0))
+                    except ProviderBusyError as exc:
+                        # Overload shedding: an explicit, immediate
+                        # rejection — the bounded-latency alternative to
+                        # unbounded queueing. Counted separately; never
+                        # mixed into serving latency.
+                        return {"rejected": True,
+                                "reject_s": _time.perf_counter() - t_send,
+                                "queue_depth": exc.queue_depth}
+                    turn_ttfts.append(
+                        (t_first or _time.perf_counter()) - t_send)
+                    history.append({"role": "assistant",
+                                    "content": "".join(reply)})
             finally:
                 await session.close()
             t_done = _time.perf_counter()
-            return {"ttft": (t_first or t_done) - t_send,
-                    "e2e": t_done - t_send, "chars": chars,
-                    "tokens": tokens, "t_first": t_first or t_done,
-                    "t_done": t_done, "stamps": stamps}
+            return {"ttft": turn_ttfts[0], "e2e": t_done - t_begin,
+                    "chars": sum(c for _, c in stamps), "tokens": tokens,
+                    "t_first": t_first_any or t_done, "t_done": t_done,
+                    "stamps": stamps, "turn_ttfts": turn_ttfts}
 
         engine_stats: dict | None = None
         provider_stats: dict | None = None
@@ -626,7 +676,7 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                           "preamble)", file=sys.stderr)
                     results, t0, elapsed = await run_sharded_fleet(
                         wave_b_prompts)
-                elif client_procs > 1:
+                elif client_procs > 1 and multi_turn <= 1:
                     results, t0, elapsed = await run_sharded_fleet(prompts)
                 else:
                     tasks = [asyncio.ensure_future(one_client(i))
@@ -924,6 +974,28 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                       f"tokens/dispatch p50/p99 "
                       f"{_rnd(tpd.get('p50'))}/{_rnd(tpd.get('p99'))}",
                       file=sys.stderr)
+            # Disaggregation ledger (broker counters + the prefill
+            # host's own stats, nested under engine.disagg): handoff
+            # frames/bytes, prefill-tier residency percentiles, and the
+            # per-tier serialize/adopt walls — the acceptance contract
+            # is that these flow host stats → provider stats → HERE.
+            dg = engine_stats.get("disagg")
+            if dg:
+                diag["disagg"] = dg
+                pt = dg.get("prefill_tier_s") or {}
+                ph = dg.get("prefill_host") or {}
+                ho = ph.get("handoff") or {}
+                ad = engine_stats.get("adopt") or {}
+                print(f"[bench] disagg: {dg.get('handoff_frames')} "
+                      f"handoffs / {dg.get('handoff_bytes')} bytes "
+                      f"({dg.get('prefix_tokens')} prefix tokens, "
+                      f"{dg.get('routing_only')} routing-only) | "
+                      f"prefill tier p50/p99 {_rnd(pt.get('p50'))}/"
+                      f"{_rnd(pt.get('p99'))}s | serialize "
+                      f"{ho.get('serialize_s')}s | adopt "
+                      f"{ad.get('deserialize_s')}s host-side, "
+                      f"{_rnd(engine_stats.get('adopt_s'))}s dispatch",
+                      file=sys.stderr)
             # The attribution that mattered in round 3: wire TTFT far above
             # engine TTFT means the stall is relay/wire/client-loop, not
             # admission.
@@ -1015,6 +1087,38 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                       f"{shared_block['ttft_p99_cached_s']})",
                       file=sys.stderr)
 
+        multi_turn_block = None
+        if multi_turn > 1:
+            first = sorted(r["turn_ttfts"][0] for r in results
+                           if r.get("turn_ttfts"))
+            later = sorted(t for r in results
+                           for t in r.get("turn_ttfts", [])[1:])
+            if first and later:
+                multi_turn_block = {
+                    "turns": multi_turn,
+                    "sessions": len(results),
+                    "ttft_turn1_p50_s": round(pct(first, 0.50), 3),
+                    "ttft_turn1_p99_s": round(pct(first, 0.99), 3),
+                    "ttft_turn2plus_p50_s": round(pct(later, 0.50), 3),
+                    "ttft_turn2plus_p99_s": round(pct(later, 0.99), 3),
+                    # > 1 means later turns admit faster than turn 1
+                    # even though their prompts are LONGER — the session
+                    # cache (and, disaggregated, the prefix handoff)
+                    # paying for itself.
+                    "turn2plus_speedup": (
+                        round(pct(first, 0.50) / pct(later, 0.50), 3)
+                        if pct(later, 0.50) else None),
+                }
+                print(f"[bench] multi-turn: TTFT p50 turn-1 "
+                      f"{multi_turn_block['ttft_turn1_p50_s']}s → "
+                      f"turn-2+ "
+                      f"{multi_turn_block['ttft_turn2plus_p50_s']}s "
+                      f"(x{multi_turn_block['turn2plus_speedup']} though "
+                      f"later prompts are longer; p99 "
+                      f"{multi_turn_block['ttft_turn1_p99_s']} → "
+                      f"{multi_turn_block['ttft_turn2plus_p99_s']})",
+                      file=sys.stderr)
+
         return {
             "metric": f"e2e serving tok/s ({preset_name} {dtype_label}, "
                       f"{clients} streaming clients over TCP"
@@ -1023,6 +1127,9 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                       + (", shared-prefix cached wave" if shared_prefix
                          else "")
                       + (f", speculative wave (k={draft_k})" if speculative
+                         else "")
+                      + (", disagg prefill/decode tiers" if disagg else "")
+                      + (f", {multi_turn}-turn sessions" if multi_turn > 1
                          else "")
                       + f", {max_new} tok/req, {slots} slots, block {block}, "
                         f"provider subprocess, 1 tpu dev)",
@@ -1048,6 +1155,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             **({"shared_prefix": shared_block} if shared_block else {}),
             **({"speculative": speculative_block}
                if speculative_block else {}),
+            **({"multi_turn": multi_turn_block} if multi_turn_block
+               else {}),
             # Satellite of the speculative PR: the per-stage TTFT
             # breakdown lands in the JSON capture, not just stderr text.
             **({"ttft_stages": ttft_stages} if ttft_stages else {}),
@@ -1207,6 +1316,24 @@ def main() -> None:
     ap.add_argument("--draft-k", type=int, default=8,
                     help="draft tokens per slot per verify dispatch "
                          "(tpu.speculative k_draft; --speculative only)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated prefill/decode (--e2e): the "
+                         "provider runs a prefill host + decode host "
+                         "pair (tpu.role: disagg) with versioned KV "
+                         "handoff frames between them; handoff "
+                         "frames/bytes and prefill-tier latency land in "
+                         "the JSON's engine.disagg block. The disagg "
+                         "A/B is this flag on vs off at otherwise "
+                         "identical settings")
+    ap.add_argument("--multi-turn", type=int, default=1, metavar="N",
+                    help="conversation workload (--e2e): every client "
+                         "runs one N-turn session, re-submitting the "
+                         "full history each turn, greedy. Reports "
+                         "turn-1 vs turn-2+ TTFT — the session-cache "
+                         "workload where the prefix cache (enabled by "
+                         "default here) and --disagg prefix handoff "
+                         "should shine. Runs the inline client fleet "
+                         "(client-procs forced to 1)")
     ap.add_argument("--preset", default="llama3-8b")
     ap.add_argument("--slots", type=int, default=None,
                     help="decode slots (default 128; 96 in shared-prefix "
@@ -1301,17 +1428,34 @@ def main() -> None:
     if args.speculative and args.shared_prefix:
         ap.error("--speculative and --shared-prefix are separate "
                  "two-wave workloads; pick one")
+    if args.multi_turn < 1:
+        ap.error("--multi-turn must be >= 1")
+    if args.multi_turn > 1 and (args.shared_prefix or args.speculative):
+        ap.error("--multi-turn is its own workload; drop "
+                 "--shared-prefix/--speculative")
     if args.clients is None:
-        args.clients = 96 if (args.shared_prefix or args.speculative) \
-            else 128
+        args.clients = (32 if args.multi_turn > 1
+                        else 96 if (args.shared_prefix or args.speculative)
+                        else 128)
     if args.slots is None:
-        args.slots = 96 if (args.shared_prefix or args.speculative) \
-            else 128
+        args.slots = (32 if args.multi_turn > 1
+                      else 96 if (args.shared_prefix or args.speculative)
+                      else 128)
     user_prompt_len = args.prompt_len
     if args.prompt_len is None:
-        args.prompt_len = 384 if args.shared_prefix else 128
-    if args.shared_prefix and args.prefix_cache_mb is None:
+        # Multi-turn: the LAST turn's full history must fit the bucket,
+        # and turn-2+ hits need each turn to cross a 256-token alignment
+        # boundary — 2048 leaves ~512 tokens of budget per turn at the
+        # default 4 turns.
+        args.prompt_len = (2048 if args.multi_turn > 1
+                           else 384 if args.shared_prefix else 128)
+    if ((args.shared_prefix or args.multi_turn > 1)
+            and args.prefix_cache_mb is None):
         args.prefix_cache_mb = 128.0
+    if args.multi_turn > 1:
+        # Per-turn TTFT stamps come from the inline fleet; the sharded
+        # worker protocol only carries whole-request results.
+        args.client_procs = 1
     if args.client_procs is None:
         args.client_procs = 8 if args.clients >= 64 else 1
     user_block = args.block
@@ -1325,14 +1469,24 @@ def main() -> None:
     # not fit the preamble).
     user_sized = (args.max_seq is not None or args.max_new is not None
                   or user_prompt_len is not None or user_block is not None
-                  or args.shared_prefix or args.speculative)
-    if args.max_seq is None:
-        args.max_seq = 640
+                  or args.shared_prefix or args.speculative
+                  or args.multi_turn > 1)
     if args.max_new is None:
         # Speculative mode trims the per-request budget like shared-prefix:
         # two waves on one provider must fit the same wall budget.
-        args.max_new = (192 if (args.shared_prefix or args.speculative)
+        # Multi-turn trims further: every turn's reply re-enters the
+        # next turn's prompt, so the reply budget trades against turns.
+        args.max_new = (96 if args.multi_turn > 1
+                        else 192 if (args.shared_prefix or args.speculative)
                         else 480)
+    if args.max_seq is None:
+        if args.multi_turn > 1:
+            # Bucket + one reply + lookahead, rounded up to 128 (the
+            # measured XLA-attention alignment sweet spot).
+            need = args.prompt_len + args.max_new + 2 * args.block
+            args.max_seq = -(-need // 128) * 128
+        else:
+            args.max_seq = 640
 
     def engine_bench() -> dict:
         # engine numbers are recorded at block 64; when the user didn't
@@ -1388,7 +1542,8 @@ def main() -> None:
                 prefix_cache_mb=args.prefix_cache_mb,
                 speculative=args.speculative, draft_k=args.draft_k,
                 fused_dequant=args.fused_dequant,
-                trace_out=args.trace_out, tracing=not args.no_trace)
+                trace_out=args.trace_out, tracing=not args.no_trace,
+                disagg=args.disagg, multi_turn=args.multi_turn)
 
         try:
             result = e2e_attempt(args.max_seq, args.max_new)

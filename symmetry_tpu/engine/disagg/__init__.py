@@ -1,0 +1,35 @@
+"""Disaggregated prefill/decode: KV-handoff frames + the tier broker.
+
+The production-serving split (DistServe OSDI'24, Splitwise ISCA'24, see
+PAPERS.md): admissions/chunked prefill on one engine host, generation on
+another, with each finished prompt's KV crossing the boundary as a
+versioned binary frame the decode tier adopts through its prefix store.
+`tpu.role` selects a host's tier; `tpu.role: disagg` makes the
+tpu_native backend run the pair under one supervisor.
+"""
+
+from symmetry_tpu.engine.disagg.broker import (
+    DEFAULT_DECODE_PREFIX_MB,
+    HandoffBroker,
+    derive_role_config,
+)
+from symmetry_tpu.engine.disagg.frames import (
+    FrameError,
+    KVHandoff,
+    decode_frame,
+    decode_kv_handoff,
+    encode_frame,
+    encode_kv_handoff,
+)
+
+__all__ = [
+    "DEFAULT_DECODE_PREFIX_MB",
+    "FrameError",
+    "HandoffBroker",
+    "KVHandoff",
+    "decode_frame",
+    "decode_kv_handoff",
+    "derive_role_config",
+    "encode_frame",
+    "encode_kv_handoff",
+]

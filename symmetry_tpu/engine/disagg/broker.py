@@ -1,0 +1,155 @@
+"""Handoff broker: request-state migration between the two engine tiers.
+
+The `tpu_native` backend in `tpu.role: disagg` mode runs TWO engine
+hosts — a prefill host (admissions + chunked prefill only) and a decode
+host (generation only). A request's life then spans three owners:
+
+    provider submit ──▶ prefill host          (tokenize, build prefix KV)
+                          │  {"op":"handoff"} (versioned frame, frames.py)
+    broker ◀──────────────┘
+      │  {"op":"adopt"}  (frame + the request state the decode
+      ▼                   host needs: max_new, sampling, deadline …)
+    decode host ──▶ token events ──▶ provider queues (unchanged path)
+
+This module is the process-free middle: it remembers what was submitted
+(so the adopt op can re-attach sampling/max_new/deadline to the frame
+without the prefill host round-tripping them), rebases deadlines onto
+the time already spent in the prefill tier, and accounts the handoff
+itself (frames, bytes, prefix tokens shipped, per-request prefill-tier
+latency) for the stats → provider stats → bench chain. The asyncio
+plumbing — spawning the two hosts, pumping their pipes, supervising
+their deaths — stays in the backend, which makes this class unit-
+testable without a single subprocess.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any
+
+from symmetry_tpu.utils.trace import Histogram
+
+# The decode tier adopts handoff frames through its prefix store; a
+# decode host configured without one could only ever full-prefill, which
+# silently re-does the prefill tier's work. When the operator set no
+# budget, the broker gives the decode tier this much (the working set is
+# transient — entries churn through LRU the moment their request admits).
+# This is only the config-level seed: the decode-role ENGINE raises its
+# store budget to a geometry-derived floor (2 × largest-bucket entry
+# bytes) at construction, so adoption of big-bucket prompts is never
+# budget-rejected by a default too small for the model at hand.
+DEFAULT_DECODE_PREFIX_MB = 64.0
+
+
+def derive_role_config(base: dict[str, Any], role: str) -> dict[str, Any]:
+    """The per-tier host config: the provider's config with `tpu.role`
+    pinned to the tier and any `tpu.disagg.<role>` overrides applied.
+    Override mapping keys land in the tpu section, except `faults`,
+    which lands top-level (the host loads faults from there) — this is
+    how a chaos test arms a seam in ONE tier of the pair."""
+    if role not in ("prefill", "decode"):
+        raise ValueError(f"derive_role_config: bad role {role!r}")
+    cfg = copy.deepcopy(base)
+    tpu = dict(cfg.get("tpu") or {})
+    disagg = tpu.pop("disagg", None) or {}
+    overrides = dict(disagg.get(role) or {})
+    faults = overrides.pop("faults", None)
+    tpu.update(overrides)
+    tpu["role"] = role
+    if role == "decode" and not tpu.get("prefix_cache_mb"):
+        tpu["prefix_cache_mb"] = DEFAULT_DECODE_PREFIX_MB
+    cfg["tpu"] = tpu
+    if faults:
+        merged = dict(cfg.get("faults") or {})
+        merged.update(faults)
+        cfg["faults"] = merged
+    return cfg
+
+
+class HandoffBroker:
+    """Pending-request state + handoff accounting for one host pair.
+
+    Thread contract: all calls happen on the backend's event loop (the
+    two pipe readers and stream() all live there), so no locking."""
+
+    def __init__(self) -> None:
+        # request id -> (submit fields the decode host will need,
+        #               submit monotonic stamp)
+        self._pending: dict[str, tuple[dict[str, Any], float]] = {}
+        self.counters = {"submitted": 0, "handoff_frames": 0,
+                         "handoff_bytes": 0, "prefix_tokens": 0,
+                         "routing_only": 0, "dropped": 0}
+        # Prefill-tier residence per request: provider submit → handoff
+        # frame back at the broker. THE disagg latency number — what the
+        # decode tier's TTFT no longer has to contain.
+        self.prefill_tier_hist = Histogram()
+
+    # ------------------------------------------------------------- state
+
+    def note_submit(self, request_id: str, submit: dict[str, Any]) -> None:
+        """Remember the request state that must survive the migration.
+        `submit` is the host-pipe submit op; only the decode-relevant
+        fields are kept (messages stay behind — tokens ride the frame)."""
+        keep = {k: submit[k] for k in
+                ("max_new", "sampling", "speculative", "trace", "deadline_s")
+                if k in submit}
+        self._pending[request_id] = (keep, time.monotonic())
+        self.counters["submitted"] += 1
+
+    def forget(self, request_id: str) -> None:
+        """The request ended on the prefill tier (tokenization error,
+        admission error, deadline shed, cancel) — nothing to migrate."""
+        if self._pending.pop(request_id, None) is not None:
+            self.counters["dropped"] += 1
+
+    def fail_all(self) -> None:
+        """Host pair is going down: every pending migration is dead (the
+        streams are failed by the backend's shed path)."""
+        self.counters["dropped"] += len(self._pending)
+        self._pending.clear()
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    # ------------------------------------------------------------ handoff
+
+    def adopt_op(self, handoff: dict[str, Any]) -> dict[str, Any] | None:
+        """One prefill-host `handoff` op → the decode-host `adopt` op,
+        with the remembered request state re-attached and the deadline
+        rebased by the prefill-tier time already spent. None when the
+        request is unknown (already cancelled/failed — drop the frame,
+        nobody is waiting)."""
+        req_id = str(handoff.get("id", ""))
+        entry = self._pending.pop(req_id, None)
+        if entry is None:
+            return None
+        keep, t_submit = entry
+        now = time.monotonic()
+        self.prefill_tier_hist.observe(now - t_submit)
+        self.counters["handoff_frames"] += 1
+        self.counters["handoff_bytes"] += int(handoff.get("nbytes", 0))
+        p = int(handoff.get("p", 0))
+        self.counters["prefix_tokens"] += p
+        if p == 0:
+            self.counters["routing_only"] += 1
+        op: dict[str, Any] = {"op": "adopt", "id": req_id,
+                              "frame": handoff.get("frame")}
+        for k in ("max_new", "sampling", "speculative", "trace"):
+            if k in keep:
+                op[k] = keep[k]
+        if "deadline_s" in keep:
+            # The deadline was RELATIVE at provider submit; the prefill
+            # tier consumed part of it. Rebase so the decode host's
+            # admission shed still fires at the original wall deadline.
+            op["deadline_s"] = float(keep["deadline_s"]) - (now - t_submit)
+        return op
+
+    # -------------------------------------------------------------- stats
+
+    def stats(self) -> dict[str, Any]:
+        out: dict[str, Any] = dict(self.counters)
+        out["pending"] = len(self._pending)
+        out["prefill_tier_s"] = self.prefill_tier_hist.to_dict()
+        return out

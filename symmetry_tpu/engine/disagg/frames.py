@@ -1,0 +1,255 @@
+"""Versioned, length-prefixed KV-handoff frames.
+
+The wire unit of disaggregated prefill/decode: the prefill tier
+serializes a finished request's prefix KV (and, same format, a
+prefix-cache entry's KV) into ONE self-describing binary frame; the
+decode tier deserializes it and adopts the buffers through the
+`PrefixStore.insert` seed-copy path. The format is deliberately dumb and
+explicit — a handoff crosses process (and eventually chip/host)
+boundaries, so every field that could silently corrupt a decode stream
+is checked at parse time instead of trusted:
+
+    magic   b"SYKV"                      wrong stream → FrameError
+    u16     version (=1)                 unknown layout → FrameError
+    u16     flags (bit 0: int8 KV)       quantization mismatch is loud
+    u64     body length                  truncation → FrameError
+    body    u32 header-JSON length, header JSON (meta: request id,
+            prompt tokens, prefix length p, dtype names …), u16 array
+            count, then per array: name, dtype name, shape, u64 payload
+            length, raw row-major bytes
+    u32     crc32(body)                  bit rot / torn write → FrameError
+
+Arrays are GQA-shaped as stored ([layers, 1, p, kv_heads, head_dim]
+payloads; [layers, 1, kv_heads, p] scale planes when the KV cache is
+int8-quantized) but the codec itself is shape-agnostic — it round-trips
+whatever named arrays it is given, so the same frames carry bf16/f32
+caches, quantized caches, and future layouts without a version bump as
+long as the meta describes them.
+
+Host byte order is little-endian on every platform this runs on (x86,
+TPU hosts, arm64); the format pins little-endian explicitly so a frame
+written on one host parses on any other.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+MAGIC = b"SYKV"
+VERSION = 1
+FLAG_KV_INT8 = 1 << 0
+
+# A frame is one request's prefix KV: even a 70B-scale cache slice is
+# hundreds of MB, not GB. The bound exists so a corrupt length prefix
+# fails parsing instead of driving a multi-GB allocation.
+MAX_FRAME_BYTES = 4 << 30
+
+
+class FrameError(ValueError):
+    """Rejected handoff frame: truncated, corrupt, or wrong version."""
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Dtype from its serialized name, including the ml_dtypes extras
+    (bfloat16 …) numpy cannot resolve by string."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    import ml_dtypes
+
+    dt = getattr(ml_dtypes, name, None)
+    if dt is None:
+        raise FrameError(f"unknown array dtype {name!r} in handoff frame")
+    return np.dtype(dt)
+
+
+def encode_frame(meta: dict, arrays: dict[str, np.ndarray],
+                 *, flags: int = 0) -> bytes:
+    """One meta dict + named arrays → a self-contained frame. `meta`
+    must be JSON-serializable; arrays are written C-contiguous."""
+    header = json.dumps(meta, separators=(",", ":")).encode()
+    parts = [struct.pack("<I", len(header)), header,
+             struct.pack("<H", len(arrays))]
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        name_b = name.encode()
+        dtype_b = arr.dtype.name.encode()
+        parts.append(struct.pack("<H", len(name_b)))
+        parts.append(name_b)
+        parts.append(struct.pack("<H", len(dtype_b)))
+        parts.append(dtype_b)
+        parts.append(struct.pack("<H", arr.ndim))
+        parts.append(struct.pack(f"<{arr.ndim}I", *arr.shape))
+        data = arr.tobytes()
+        parts.append(struct.pack("<Q", len(data)))
+        parts.append(data)
+    body = b"".join(parts)
+    return b"".join([MAGIC, struct.pack("<HH", VERSION, flags),
+                     struct.pack("<Q", len(body)), body,
+                     struct.pack("<I", zlib.crc32(body))])
+
+
+def decode_frame(buf: bytes) -> tuple[dict, dict[str, np.ndarray], int]:
+    """Parse one frame → (meta, arrays, flags). Every structural check
+    raises FrameError — a rejected frame must fail THIS request loudly,
+    never adopt garbage KV into a live decode host."""
+    if len(buf) < 16:
+        raise FrameError(f"frame truncated: {len(buf)} bytes < 16-byte "
+                         f"fixed header")
+    if buf[:4] != MAGIC:
+        raise FrameError(f"bad frame magic {buf[:4]!r}")
+    version, flags = struct.unpack_from("<HH", buf, 4)
+    if version != VERSION:
+        raise FrameError(f"unsupported handoff frame version {version} "
+                         f"(this build speaks {VERSION})")
+    (body_len,) = struct.unpack_from("<Q", buf, 8)
+    if body_len > MAX_FRAME_BYTES:
+        raise FrameError(f"frame body length {body_len} exceeds the "
+                         f"{MAX_FRAME_BYTES}-byte bound")
+    if len(buf) != 16 + body_len + 4:
+        raise FrameError(f"frame truncated: have {len(buf)} bytes, "
+                         f"header promises {16 + body_len + 4}")
+    body = buf[16:16 + body_len]
+    (crc,) = struct.unpack_from("<I", buf, 16 + body_len)
+    if zlib.crc32(body) != crc:
+        raise FrameError("frame checksum mismatch (corrupt payload)")
+
+    off = 0
+
+    def take(n: int, what: str) -> bytes:
+        nonlocal off
+        if off + n > len(body):
+            raise FrameError(f"frame body truncated reading {what}")
+        out = body[off:off + n]
+        off += n
+        return out
+
+    (header_len,) = struct.unpack("<I", take(4, "header length"))
+    try:
+        meta = json.loads(take(header_len, "header"))
+    except ValueError as exc:
+        raise FrameError(f"frame header is not valid JSON: {exc}") from exc
+    if not isinstance(meta, dict):
+        raise FrameError("frame header must be a JSON object")
+    (n_arrays,) = struct.unpack("<H", take(2, "array count"))
+    arrays: dict[str, np.ndarray] = {}
+    for _ in range(n_arrays):
+        (name_len,) = struct.unpack("<H", take(2, "array name length"))
+        name = take(name_len, "array name").decode()
+        (dtype_len,) = struct.unpack("<H", take(2, "dtype length"))
+        dtype = _np_dtype(take(dtype_len, "dtype name").decode())
+        (ndim,) = struct.unpack("<H", take(2, "rank"))
+        shape = struct.unpack(f"<{ndim}I", take(4 * ndim, "shape"))
+        (data_len,) = struct.unpack("<Q", take(8, "payload length"))
+        want = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if data_len != want:
+            raise FrameError(
+                f"array {name!r} payload is {data_len} bytes but shape "
+                f"{shape} × {dtype.name} needs {want}")
+        data = take(data_len, f"array {name!r} payload")
+        arrays[name] = np.frombuffer(data, dtype=dtype).reshape(shape)
+    if off != len(body):
+        raise FrameError(f"{len(body) - off} trailing bytes after the "
+                         f"last array")
+    return meta, arrays, flags
+
+
+# ---------------------------------------------------------------------
+# The KV-handoff frame: the per-request (or prefix-cache-entry) payload
+# the prefill tier ships to the decode tier.
+
+@dataclass
+class KVHandoff:
+    """One decoded handoff: the full prompt's token ids, the aligned
+    prefix length `p` whose KV the arrays carry, and the GQA-shaped
+    buffers themselves (empty when p == 0 — a prompt too short for an
+    aligned prefix hands off routing-only and the decode tier prefills
+    it whole)."""
+
+    request_id: str
+    tokens: tuple[int, ...]        # FULL prompt (frame covers [:p])
+    p: int                         # aligned prefix length serialized
+    kv_quant: bool = False
+    arrays: dict[str, np.ndarray] = field(default_factory=dict)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes for a in self.arrays.values())
+
+
+def encode_kv_handoff(request_id: str, tokens, p: int,
+                      arrays: dict[str, np.ndarray] | None,
+                      *, kv_quant: bool = False) -> bytes:
+    """Serialize one request's prefix KV slice. `arrays` holds the
+    batch-1 cache planes sliced to `p` positions (k/v payloads, plus
+    k_scale/v_scale when int8-quantized); None/{} with p == 0 is the
+    routing-only frame for prompts with no aligned prefix."""
+    arrays = arrays or {}
+    if p < 0 or p > len(tokens):
+        raise ValueError(f"prefix length {p} outside prompt of "
+                         f"{len(tokens)} tokens")
+    if p == 0 and arrays:
+        raise ValueError("p == 0 handoff must carry no KV arrays")
+    if p > 0:
+        missing = {"k", "v"} - set(arrays)
+        if kv_quant:
+            missing |= {"k_scale", "v_scale"} - set(arrays)
+        if missing:
+            raise ValueError(f"handoff missing KV planes: {sorted(missing)}")
+    meta = {"id": str(request_id), "tokens": list(map(int, tokens)),
+            "p": int(p), "kv_quant": bool(kv_quant)}
+    return encode_frame(meta, arrays,
+                        flags=FLAG_KV_INT8 if kv_quant else 0)
+
+
+def decode_kv_handoff(buf: bytes) -> KVHandoff:
+    """Parse + validate one handoff frame. Structural KV checks (shapes
+    against the decode engine's model config, alignment against its
+    prefix store) belong to the adopting engine — this layer only
+    guarantees the frame is internally consistent."""
+    meta, arrays, flags = decode_frame(buf)
+    try:
+        tokens = tuple(int(t) for t in meta["tokens"])
+        p = int(meta["p"])
+        req_id = str(meta["id"])
+    except (KeyError, TypeError, ValueError) as exc:
+        raise FrameError(f"handoff meta malformed: {exc!r}") from exc
+    kv_quant = bool(meta.get("kv_quant", False))
+    if kv_quant != bool(flags & FLAG_KV_INT8):
+        raise FrameError("handoff flags disagree with meta on KV "
+                         "quantization")
+    if not 0 <= p <= len(tokens):
+        raise FrameError(f"handoff prefix length {p} outside prompt of "
+                         f"{len(tokens)} tokens")
+    if p == 0:
+        if arrays:
+            raise FrameError("p == 0 handoff carries KV arrays")
+    else:
+        want = {"k", "v"} | ({"k_scale", "v_scale"} if kv_quant else set())
+        if set(arrays) != want:
+            raise FrameError(
+                f"handoff arrays {sorted(arrays)} != expected "
+                f"{sorted(want)}")
+        for name in ("k", "v"):
+            a = arrays[name]
+            if a.ndim != 5 or a.shape[1] != 1 or a.shape[2] != p:
+                raise FrameError(
+                    f"handoff {name} shape {a.shape} is not "
+                    f"[layers, 1, p={p}, kv_heads, head_dim]")
+        if arrays["k"].shape != arrays["v"].shape:
+            raise FrameError("handoff k/v shapes disagree")
+        if kv_quant:
+            for name in ("k_scale", "v_scale"):
+                a = arrays[name]
+                if a.ndim != 4 or a.shape[1] != 1 or a.shape[3] != p:
+                    raise FrameError(
+                        f"handoff {name} shape {a.shape} is not "
+                        f"[layers, 1, kv_heads, p={p}]")
+    return KVHandoff(request_id=req_id, tokens=tokens, p=p,
+                     kv_quant=kv_quant, arrays=arrays)

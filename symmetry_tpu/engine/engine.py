@@ -156,11 +156,33 @@ class InferenceEngine:
         prefix_cache_bytes: int = 0,
         speculative: SpecConfig | None = None,
         fused_dequant: bool = False,
+        role: str = "unified",
     ) -> None:
         self.config = config
         self.params = params
         self.tokenizer = tokenizer
         self.mesh = mesh
+        # Disaggregated prefill/decode (engine/disagg/): "unified" is
+        # today's engine — prefill AND decode on this chip. "prefill"
+        # builds prompt KV and hands it off (never decodes; warmup skips
+        # every decode-side compile); "decode" adopts handed-off KV
+        # through the prefix store and generates. Role selection changes
+        # NO compiled program — it only gates which of the existing
+        # programs warmup builds and which scheduler paths run.
+        if role not in ("unified", "prefill", "decode"):
+            raise EngineError(
+                f"unknown engine role {role!r}; expected unified, "
+                f"prefill, or decode (disagg is a backend-level role — "
+                f"the broker assigns prefill/decode to its two hosts)")
+        if role != "unified" and mesh is not None:
+            # Handoff frames are host-side numpy snapshots; a sharded
+            # cache on a multi-process mesh is not host-addressable.
+            # Loud, not silently wrong — same contract as fused_dequant.
+            raise EngineError(
+                f"tpu.role {role!r} supports single-device engines only "
+                f"(KV handoff snapshots the cache host-side); drop the "
+                f"role or the mesh")
+        self.role = role
         # W8A16 fused-dequant routing (tpu.fused_dequant): pack the int8
         # weight leaves into the Pallas kernel's tile layout ONCE, here —
         # the layout is the routing (qmatmul dispatches on the leaf
@@ -296,6 +318,36 @@ class InferenceEngine:
                 budget_bytes=prefix_cache_bytes, align=self.prefix_align)
         else:
             self.prefix_store = None
+        if self.role == "decode" and self.prefix_store is None:
+            # Adoption lands handed-off KV through PrefixStore.insert;
+            # without a store every migrated request would silently
+            # re-prefill from scratch — the exact work the prefill tier
+            # already did.
+            raise EngineError(
+                "role: decode requires the prefix cache "
+                "(tpu.prefix_cache_mb > 0 and a prefill_chunk) — "
+                "handoff frames are adopted through it")
+        if self.role == "decode":
+            # Budget floor derived from THIS engine's geometry, not a
+            # fixed MB knob: adopted entries are padded to bucket
+            # capacity, so a budget smaller than one largest-bucket
+            # entry would reject EVERY adoption of a big prompt — the
+            # prefill tier's work shipped across the pipe and thrown
+            # away, strictly worse than unified mode. Two entries'
+            # worth keeps one pinned mid-copy while the next adopts.
+            # +1 KiB/entry slack: a store entry's nbytes includes small
+            # metadata leaves (the lengths array) beyond the KV planes,
+            # and the floor must hold with one entry PINNED mid-copy —
+            # exactly two largest entries must genuinely fit.
+            floor = 2 * (self.prefill_buckets[-1]
+                         * self.kv_bytes_per_token() + 1024)
+            if self.prefix_store.budget_bytes < floor:
+                self.prefix_store.budget_bytes = floor
+        if self.role == "prefill" and not self.prefix_align:
+            raise EngineError(
+                "role: prefill requires tpu.prefill_chunk — handoff "
+                "prefixes align to it (the decode tier's suffix "
+                "dispatch needs a compiled shape)")
 
         # Speculative decoding (engine/spec/): None keeps the serving path
         # byte-identical — no verify jit is ever built or compiled, the
@@ -872,6 +924,122 @@ class InferenceEngine:
                 if self.prefix_store is not None else None)
 
     # ------------------------------------------------------------------
+    # Disaggregated prefill/decode (engine side; wire format and broker
+    # in engine/disagg/)
+
+    def kv_bytes_per_token(self) -> int:
+        """Bytes of KV cache one token position occupies (k + v payloads
+        plus scale planes when int8-quantized) — sizes handoff frames
+        and the decode tier's adoption-budget floor."""
+        c = self.config
+        per_plane = c.num_layers * c.num_kv_heads
+        if self.kv_quant:
+            # int8 payload + one f32 scale per (layer, head, position)
+            return 2 * per_plane * (c.dim_per_head + 4)
+        return 2 * per_plane * c.dim_per_head * jnp.dtype(
+            self.cache_dtype).itemsize
+
+    def extract_slot_kv(self, slot: int, p: int):
+        """Batch-1 snapshot of decode-lane `slot`'s KV, lengths pinned to
+        `p` — the device half of a prefill-tier handoff. Every admission
+        path (full prefill, chunked, prefix-cache hit) ends by inserting
+        the prompt's KV into the slot lane, so extracting FROM the lane
+        is uniform across all of them. Reuses the prefix-cache row
+        extract (the decode state's cache is a KVCache with batch on dim
+        1), then trims the position axis to the smallest prefill bucket
+        holding `p` — the host→device→host transfer the caller pays must
+        scale with the prompt, not max_seq_len (the trim is an eager
+        slice, one cached variant per bucket; prefill-role warmup covers
+        them). The caller np.asarray-syncs the result before the lane
+        can be reused (the handoff sink runs on the engine thread, ahead
+        of any next admission)."""
+        if not 0 <= slot < self.max_slots:
+            raise EngineError(f"extract_slot_kv: slot {slot} out of range")
+        row = self._extract_prefix_row(self.state.cache, jnp.int32(slot),
+                                       jnp.int32(p))
+        cap = self.bucket_for(max(int(p), 1))
+        if cap >= self.max_seq_len:
+            return row
+
+        def cut(arr, axis):
+            return (jax.lax.slice_in_dim(arr, 0, cap, axis=axis)
+                    if arr is not None else None)
+
+        return row._replace(k=cut(row.k, 2), v=cut(row.v, 2),
+                            k_scale=cut(row.k_scale, 3),
+                            v_scale=cut(row.v_scale, 3))
+
+    def adopt_prefix(self, handoff) -> bool:
+        """Decode-tier adoption: a deserialized KV handoff (engine/
+        disagg/frames.py KVHandoff) becomes a prefix-store entry, so the
+        migrated request admits through the ordinary cached path — ONE
+        seed copy + ONE suffix dispatch, the same programs a local
+        prefix hit uses (zero-copy where layouts match: the frame's
+        buffers go to the device once and become the entry directly).
+
+        Returns True when the entry landed (or an identical one already
+        covers it), False when the store rejected it (budget) — the
+        request then admits through a full prefill, which is slower but
+        still token-identical for greedy. Structural mismatches between
+        the frame and THIS engine's model/cache geometry raise: adopting
+        wrong-shaped or wrong-dtype KV would stream garbage."""
+        if self.prefix_store is None:
+            raise EngineError("adopt_prefix requires the prefix cache "
+                              "(role: decode builds it by contract)")
+        p = int(handoff.p)
+        if p <= 0:
+            return False  # routing-only handoff: nothing to adopt
+        A = self.prefix_align
+        if p % A:
+            raise EngineError(f"handoff prefix length {p} is not aligned "
+                              f"to {A}")
+        if bool(handoff.kv_quant) != bool(self.kv_quant):
+            raise EngineError(
+                f"handoff KV quantization ({handoff.kv_quant}) disagrees "
+                f"with this engine ({self.kv_quant}) — tiers must share "
+                f"the cache layout")
+        c = self.config
+        k = handoff.arrays["k"]
+        v = handoff.arrays["v"]
+        want = (c.num_layers, 1, p, c.num_kv_heads, c.dim_per_head)
+        if k.shape != want or v.shape != want:
+            raise EngineError(
+                f"handoff KV shape {k.shape} does not match this model "
+                f"({want})")
+        want_dtype = np.dtype(np.int8 if self.kv_quant
+                              else self.cache_dtype)
+        if k.dtype != want_dtype or v.dtype != want_dtype:
+            raise EngineError(
+                f"handoff KV dtype {k.dtype} does not match this "
+                f"engine's cache dtype {want_dtype}")
+        tokens = tuple(int(t) for t in handoff.tokens[:p])
+        if self.prefix_store.has(tokens):
+            return True  # e.g. a later turn of the same session
+        # Pad to the smallest prefill bucket that holds p: entries at
+        # bucket capacities are exactly the shapes the prefix-cache
+        # warmup compiled seed copies for — an adopted entry must never
+        # trigger a mid-traffic XLA compile.
+        capacity = self.bucket_for(p)
+
+        def pad_to(arr: np.ndarray, axis: int) -> jnp.ndarray:
+            if arr.shape[axis] < capacity:
+                widths = [(0, 0)] * arr.ndim
+                widths[axis] = (0, capacity - arr.shape[axis])
+                arr = np.pad(arr, widths)
+            return jnp.asarray(arr)
+
+        cache = KVCache(
+            k=pad_to(k, 2), v=pad_to(v, 2),
+            lengths=jnp.full((1,), p, jnp.int32),
+            k_scale=(pad_to(handoff.arrays["k_scale"], 3)
+                     if self.kv_quant else None),
+            v_scale=(pad_to(handoff.arrays["v_scale"], 3)
+                     if self.kv_quant else None),
+        )
+        nbytes = sum(x.nbytes for x in jax.tree.leaves(cache))
+        return self.prefix_store.insert(tokens, cache, nbytes)
+
+    # ------------------------------------------------------------------
     # Chunked prefill (long prompts, interleaved with decode blocks)
 
     def wants_chunked(self, prompt_len: int) -> bool:
@@ -1027,8 +1195,19 @@ class InferenceEngine:
         fresh XLA compile mid-traffic (~30 s on a real chip) would stall
         every active stream — the first coalesced burst must not pay it.
         Call before the first insert — warmup advances device state with
-        garbage that is only harmless on an empty cache."""
-        self.state, _ = self._decode(self.params, self.state)
+        garbage that is only harmless on an empty cache.
+
+        Role gating (two-tier warmup is the structural win of disagg): a
+        "prefill" engine never decodes, so the decode block, the
+        concurrent decode+prefill peak probe, and the speculative verify
+        program are all skipped — its compile set is the prefill grid,
+        the chunk programs, the prefix-cache paths, and ONE extract
+        variant for the handoff snapshot. "decode"/"unified" compile the
+        full set ("decode" has the prefix store on by contract, so the
+        adoption seed-copy shapes are always covered)."""
+        decode_side = self.role != "prefill"
+        if decode_side:
+            self.state, _ = self._decode(self.params, self.state)
         for bucket in self.prefill_buckets:
             for batch in self.prefill_batches_for(bucket):
                 if batch > self.max_slots:
@@ -1064,7 +1243,7 @@ class InferenceEngine:
         # overlapped-execution path is warmed, so in-serving admission
         # dispatches stop paying a first-overlap cost (admit p99 2.5 s →
         # 0.4 s, burst ramp 5.9 s → 4.3 s).
-        for bucket in self.prefill_buckets:
+        for bucket in (self.prefill_buckets if decode_side else ()):
             widest = max(b for b in self.prefill_batches_for(bucket)
                          if b <= self.max_slots)
             pending = self._decode(self.params, self.state)
@@ -1112,10 +1291,21 @@ class InferenceEngine:
         # lane one garbage token — harmless on the pre-insert empty cache,
         # same contract as the decode warmup above. The sync inside
         # verify_step surfaces a marginal-HBM failure at startup.
-        if self.spec is not None:
+        if self.spec is not None and decode_side:
             self.verify_step(
                 np.zeros((self.max_slots, self.spec.k_draft), np.int32),
                 np.zeros((self.max_slots,), np.int32))
+
+        if self.role == "prefill":
+            # The handoff snapshot programs: the decode-state cache IS a
+            # KVCache (batch on dim 1), so the prefix-cache row extract
+            # serves as the slot-lane extract — one compiled variant —
+            # plus one eager bucket-trim slice per prefill bucket. The
+            # final sync doubles as the prefill-role startup-OOM probe
+            # (the grid loop above dispatches without syncing).
+            for bucket in self.prefill_buckets:
+                np.asarray(self.extract_slot_kv(0, min(
+                    bucket, self.max_seq_len)).lengths)
 
         # Prefix-cache hit-path programs (only when the cache is on —
         # budget 0 keeps warmup exactly as before): per (batch, bucket),
@@ -1356,4 +1546,8 @@ class InferenceEngine:
             speculative=SpecConfig.from_knob(
                 getattr(tpu_cfg, "speculative", None)),
             fused_dequant=bool(getattr(tpu_cfg, "fused_dequant", False)),
+            # "disagg" is the BACKEND's role (it spawns a prefill and a
+            # decode host, each of which sees its own tier role here);
+            # an engine can only be one tier or unified.
+            role=getattr(tpu_cfg, "role", "unified") or "unified",
         )

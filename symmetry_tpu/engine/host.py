@@ -9,6 +9,13 @@ never hits this because its "engine" is an external HTTP server
 (reference: src/provider.ts:210-214); this host process is our native
 equivalent of that isolation, with a pipe instead of HTTP.
 
+Roles (tpu.role, engine/disagg/): "unified" (default) serves the full
+request; "prefill" builds each prompt's KV and emits it as a versioned
+handoff frame instead of decoding; "decode" accepts `adopt` commands
+carrying those frames, seeds its prefix store from them, and generates.
+The disagg broker in the tpu_native backend runs a prefill+decode host
+pair and pipes handoff → adopt between them.
+
 Protocol: JSON lines.
   stdin  ← {"op": "submit", "id", "messages", "max_new", "sampling": {…},
             "speculative": bool?,   (optional per-request opt-out of
@@ -19,6 +26,11 @@ Protocol: JSON lines.
             at submit; the scheduler sheds the request at admission with
             finish_reason "expired" if it has already passed)
            {"op": "cancel", "id"}
+           {"op": "adopt", "id", "frame": base64 handoff frame,
+            "max_new", "sampling", "speculative"?, "trace"?,
+            "deadline_s"?}   (decode role only: adopt a handed-off KV
+            prefix and resume the request; prompt tokens ride the frame,
+            so no re-tokenization happens here)
            {"op": "clock", "t0": float}   (clock-offset handshake: the
             provider brackets our CLOCK_MONOTONIC read with its own —
             the NTP midpoint replaces the old assume-zero-offset policy)
@@ -36,6 +48,11 @@ Protocol: JSON lines.
             stamps — host recv, placement pick, first sampled token,
             pipe write — so the provider can attribute its TTFT)
            {"op": "events", "events": [{…event fields, no "op"…}, …]}
+           {"op": "handoff", "id", "p", "prompt_len", "nbytes",
+            "frame": base64}   (prefill role only: the finished prompt's
+            aligned KV prefix, serialized; p == 0 is routing-only — the
+            prompt was too short for an aligned prefix and the decode
+            tier prefills it whole)
            {"op": "stats", …}   (scheduler counters incl. deferred_depth,
             prefill_jobs_active, the prefix_cache hit/miss/evict/bytes
             block when the shared-prefix KV cache is enabled, and the
@@ -82,6 +99,17 @@ if TYPE_CHECKING:
     from symmetry_tpu.engine.scheduler import TokenEvent
 
 
+# Raw-KV byte bound for one handoff frame. The frame travels the broker
+# pipes as ONE base64 JSON line (~4/3 × raw), and the backend's
+# StreamReader line limit in disagg mode is 1 GiB — a frame that
+# overflows it kills the reader and crash-loops the supervised pair, so
+# the prefill host must never emit one. Oversized prefixes are capped to
+# the largest ALIGNED length that fits (KV at position i depends only on
+# tokens <= i, so a shorter prefix is always sound — the decode tier
+# just re-prefills a longer suffix).
+HANDOFF_MAX_KV_BYTES = 384 * 1024 * 1024
+
+
 class EngineHost:
     def __init__(self, config: ConfigManager) -> None:
         self._config = config
@@ -111,6 +139,17 @@ class EngineHost:
         self.emit_stats = {"pipe_writes": 0, "pipe_event_writes": 0,
                            "pipe_events": 0, "pipe_batched_frames": 0,
                            "pipe_bytes": 0}
+        # Disaggregation (engine/disagg/): the host's tier role and its
+        # side of the handoff accounting — serialize wall + frame bytes
+        # on the prefill tier, deserialize/adoption outcomes on the
+        # decode tier. Both ride the stats op (→ provider → bench).
+        self._role = (getattr(config.tpu, "role", "unified") or "unified"
+                      if config is not None else "unified")
+        self.handoff_stats = {"frames": 0, "bytes": 0, "prefix_tokens": 0,
+                              "routing_only": 0, "serialize_s": 0.0}
+        self.adopt_stats = {"frames": 0, "bytes": 0, "adopted": 0,
+                            "rejected": 0, "errors": 0,
+                            "deserialize_s": 0.0}
 
     # ---------------------------------------------------------------- wire
 
@@ -211,8 +250,10 @@ class EngineHost:
         t1 = time.perf_counter()
         sched_engine.warmup()
         t_warmup = time.perf_counter() - t1
-        self._scheduler = Scheduler(sched_engine,
-                                    emit_batch=self._emit_batch)
+        self._scheduler = Scheduler(
+            sched_engine, emit_batch=self._emit_batch,
+            handoff=(self._handoff_sink if self._role == "prefill"
+                     else None))
         # tpu.tracing=False empties every ring (the bench A/B knob); the
         # default leaves the bounded always-on recorder running.
         tracing = bool(getattr(self._config.tpu, "tracing", True))
@@ -221,6 +262,7 @@ class EngineHost:
         self._scheduler.start()
         self._write({"op": "ready",
                      "model": self._config.model_name,
+                     "role": self._role,
                      "slots": self._engine.max_slots,
                      "max_seq_len": self._engine.max_seq_len,
                      "build_s": round(t_build, 1),
@@ -228,7 +270,7 @@ class EngineHost:
         # Startup breakdown to stderr: a slow start must carry its own
         # explanation in the provider log (round-3 verdict #1).
         logger.info(f"engine host ready: model={self._config.model_name} "
-                    f"slots={self._engine.max_slots} "
+                    f"role={self._role} slots={self._engine.max_slots} "
                     f"build={t_build:.1f}s warmup={t_warmup:.1f}s "
                     f"compile_cache={cache_dir or 'off'}")
 
@@ -248,6 +290,8 @@ class EngineHost:
             op = msg.get("op")
             if op == "submit":
                 self._submit(msg)
+            elif op == "adopt":
+                self._handle_adopt(msg)
             elif op == "cancel":
                 req_id = str(msg.get("id", ""))
                 if req_id in self._reported:  # only live requests; a late
@@ -270,6 +314,17 @@ class EngineHost:
                 # reentrant), and a dict-of-ints copy is GIL-atomic enough
                 # for a stats read.
                 m["emit"] = dict(self.emit_stats)
+                m["role"] = self._role
+                if self._role == "prefill":
+                    m["handoff"] = {**self.handoff_stats,
+                                    "serialize_s": round(
+                                        self.handoff_stats["serialize_s"],
+                                        4)}
+                elif self._role == "decode":
+                    m["adopt"] = {**self.adopt_stats,
+                                  "deserialize_s": round(
+                                      self.adopt_stats["deserialize_s"],
+                                      4)}
                 if FAULTS.enabled:
                     # Armed-fault accounting: a chaos run's stats carry
                     # which seams fired, so the test/bench can assert the
@@ -324,6 +379,16 @@ class EngineHost:
                          "done": True, "finish_reason": "error",
                          "error": f"tokenization failed: {exc}"}, events=1)
             return
+        if self._role == "prefill":
+            align = self._engine.prefix_align or 0
+            if align and (len(prompt_ids) - 1) // align == 0:
+                # Short-prompt fast path: no aligned prefix can be
+                # handed off, so running the prefill HERE would only
+                # duplicate the decode tier's suffix dispatch. Route the
+                # tokens straight through as a routing-only frame — the
+                # decode host prefills the whole (tiny) prompt itself.
+                self._emit_handoff(req_id, prompt_ids, 0, None)
+                return
         self._reported[req_id] = 0
 
         def emit(ev, req_id=req_id) -> None:
@@ -352,6 +417,169 @@ class EngineHost:
                            time.monotonic() - t_recv,
                            request_id=req_id, trace_id=trace_id,
                            prompt_len=len(prompt_ids))
+
+    # -------------------------------------------------------------- disagg
+
+    def _handoff_sink(self, slot: int, req: Any, first: int) -> None:
+        """Prefill-role scheduler terminal (runs on the engine thread):
+        snapshot the slot lane's KV through the aligned prefix length,
+        serialize, and emit the handoff frame. By return the lane is
+        free — the np.asarray below syncs the extract before the
+        scheduler can reuse the slot."""
+        import numpy as np
+
+        t0 = time.monotonic()
+        n = len(req.prompt_ids)
+        align = self._engine.prefix_align or 0
+        p = align * ((n - 1) // align) if align else 0
+        if p > 0:
+            # Pipe-transport bound: cap to the largest aligned prefix
+            # whose frame fits the broker's line limit (see
+            # HANDOFF_MAX_KV_BYTES). Shorter-than-built prefixes are
+            # causally sound; the decode tier pays a longer suffix.
+            max_p = align * (HANDOFF_MAX_KV_BYTES
+                             // self._engine.kv_bytes_per_token() // align)
+            p = min(p, max_p)
+        arrays = None
+        if p > 0:
+            cache = self._engine.extract_slot_kv(slot, p)
+            # Slice to p positions host-side: the frame ships only the
+            # prefix the decode tier will adopt, not the lane's full
+            # capacity — handoff bytes scale with the prompt, not the
+            # engine's max_seq_len.
+            arrays = {"k": np.asarray(cache.k)[:, :, :p],
+                      "v": np.asarray(cache.v)[:, :, :p]}
+            if self._engine.kv_quant:
+                arrays["k_scale"] = np.asarray(cache.k_scale)[:, :, :, :p]
+                arrays["v_scale"] = np.asarray(cache.v_scale)[:, :, :, :p]
+        self._emit_handoff(req.id, req.prompt_ids, p, arrays, t0=t0)
+
+    def _emit_handoff(self, req_id: str, prompt_ids: list[int], p: int,
+                      arrays: Any, t0: float | None = None) -> None:
+        from symmetry_tpu.engine.disagg import encode_kv_handoff
+
+        if t0 is None:
+            t0 = time.monotonic()
+        # disagg.handoff seam: crash = the prefill host dies with the
+        # request's KV built but unshipped (the smoke's mid-request
+        # failure); drop_frame = the frame is lost and the request
+        # silently vanishes (watchdog territory).
+        if FAULTS.enabled and FAULTS.point("disagg.handoff"):
+            return
+        frame = encode_kv_handoff(req_id, prompt_ids, p, arrays,
+                                  kv_quant=self._engine.kv_quant)
+        import base64
+
+        b64 = base64.b64encode(frame).decode("ascii")
+        dt = time.monotonic() - t0
+        self.handoff_stats["frames"] += 1
+        self.handoff_stats["bytes"] += len(frame)
+        self.handoff_stats["prefix_tokens"] += p
+        if p == 0:
+            self.handoff_stats["routing_only"] += 1
+        self.handoff_stats["serialize_s"] += dt
+        # This host's bookkeeping for the request ends here: token
+        # events (and any cancel) now belong to the decode tier.
+        self._reported.pop(req_id, None)
+        self._cancelled.discard(req_id)
+        self.tracer.record("handoff_emit", t0, dt, request_id=req_id,
+                           p=p, bytes=len(frame))
+        self._write({"op": "handoff", "id": req_id, "p": p,
+                     "prompt_len": len(prompt_ids),
+                     "nbytes": len(frame), "frame": b64})
+
+    def _handle_adopt(self, msg: dict) -> None:
+        """Decode-role command: submit the migrated request with an
+        adoption thunk the SCHEDULER runs at admission pick. EVERYTHING
+        frame-heavy — base64 decode, crc, structural validation, bucket
+        padding, the host→device transfer, the store insert — lives in
+        the thunk, on the engine thread: the prefix store's mutation
+        contract is engine-thread-only, and a burst of multi-hundred-MB
+        frames processed on THIS serial command loop would starve stats
+        replies past the supervisor's wedge deadline and delay every
+        queued cancel/submit behind them. The request is submitted with
+        an EMPTY prompt; the thunk fills prompt_ids from the frame's
+        tokens before the scheduler's lookup. A frame that fails ANY
+        check (truncated, corrupt, wrong version, wrong geometry) fails
+        this one request with an error event through the scheduler's
+        admission error path — never adopts questionable KV, never
+        kills the loop."""
+        t_recv = time.monotonic()
+        req_id = str(msg.get("id", ""))
+        frame_b64 = msg.get("frame")
+        if not isinstance(frame_b64, str) or not frame_b64:
+            self.adopt_stats["errors"] += 1
+            self._write({"op": "event", "id": req_id, "text": "",
+                         "done": True, "finish_reason": "error",
+                         "error": "handoff adoption failed: adopt op "
+                                  "carries no frame"}, events=1)
+            return
+
+        def adopt(req, frame_b64=frame_b64, req_id=req_id) -> None:
+            from symmetry_tpu.engine.disagg import decode_kv_handoff
+
+            t0 = time.monotonic()
+            try:
+                import base64
+
+                raw = base64.b64decode(frame_b64, validate=True)
+                handoff = decode_kv_handoff(raw)
+                if handoff.request_id != req_id:
+                    raise ValueError(
+                        f"frame carries id {handoff.request_id!r}, "
+                        f"command says {req_id!r}")
+                req.prompt_ids = list(handoff.tokens)
+                ok = (self._engine.adopt_prefix(handoff)
+                      if handoff.p else False)
+            except Exception as exc:  # noqa: BLE001 — fail one request
+                self.adopt_stats["errors"] += 1
+                raise RuntimeError(
+                    f"handoff adoption failed: {exc}") from exc
+            self.adopt_stats["frames"] += 1
+            self.adopt_stats["bytes"] += len(raw)
+            self.adopt_stats["deserialize_s"] += time.monotonic() - t0
+            if handoff.p:
+                if ok:
+                    self.adopt_stats["adopted"] += 1
+                else:
+                    # Store rejected (budget): full prefill fallback —
+                    # slower but still token-identical for greedy.
+                    self.adopt_stats["rejected"] += 1
+
+        s = msg.get("sampling") or {}
+        sampling = SamplingParams(
+            temperature=float(s.get("temperature", 0.0)),
+            top_p=float(s.get("top_p", 1.0)),
+            top_k=int(s.get("top_k", 0)),
+            seed=s.get("seed"),
+        )
+        self._reported[req_id] = 0
+
+        def emit(ev, req_id=req_id) -> None:
+            self._write({"op": "event", **self._event_dict(req_id, ev)},
+                        events=1)
+
+        spec = msg.get("speculative")
+        deadline = msg.get("deadline_s")
+        trace_id = str(msg.get("trace") or "")
+        self._scheduler.submit(GenRequest(
+            # Filled by the adopt thunk from the frame's tokens at
+            # admission pick (the whole frame parse runs there).
+            prompt_ids=[], sampling=sampling,
+            max_new_tokens=int(msg.get("max_new", 512)),
+            emit=emit,
+            cancelled=lambda: req_id in self._cancelled,
+            id=req_id,
+            speculative=spec if isinstance(spec, bool) else None,
+            trace_id=trace_id,
+            adopt=adopt,
+            # Rebased by the broker for prefill-tier time already spent;
+            # may arrive negative — the scheduler then sheds "expired".
+            deadline_at=(t_recv + float(deadline)
+                         if deadline is not None else None)))
+        self.tracer.record("host_adopt", t_recv,
+                           time.monotonic() - t_recv, request_id=req_id,
+                           trace_id=trace_id, frame_b64_len=len(frame_b64))
 
 
 def main() -> int:

@@ -56,6 +56,16 @@ class GenRequest:
     # provider → host pipe → here, so scheduler spans for this request
     # land on the same Perfetto timeline as everyone else's.
     trace_id: str = ""
+    # Decode-tier handoff adoption (engine/disagg/): called ONCE with
+    # this request on the engine thread when admission first picks it,
+    # BEFORE the prefix lookup — the PrefixStore's mutation contract is
+    # engine-thread-only, and the adoption's heavy work (frame decode,
+    # device transfer) belongs next to the other admission device work,
+    # not on the host's serial wire thread. The thunk fills
+    # `prompt_ids` from the frame's tokens (the request is submitted
+    # with an empty prompt) and seeds the store. Raising fails this
+    # request with an error event (never the loop).
+    adopt: Callable[["GenRequest"], None] | None = None
     # Absolute CLOCK_MONOTONIC deadline (client deadline_s mapped through
     # provider → host receipt). A request whose deadline has already
     # passed when admission picks it is shed with finish_reason
@@ -121,8 +131,24 @@ class Scheduler:
                  admit_seconds_per_block: float = 0.65,
                  emit_batch: Callable[
                      [list[tuple[GenRequest, TokenEvent]]], None]
+                 | None = None,
+                 handoff: Callable[[int, GenRequest, int], None]
                  | None = None) -> None:
         self.engine = engine
+        # Disaggregated tier role (engine/disagg/): mirrors the engine's.
+        # "prefill" replaces slot activation with the handoff sink — a
+        # request that would have started decoding is instead serialized
+        # and shipped (the sink extracts + writes the frame, called on
+        # the engine thread), its slot freed immediately. "decode" books
+        # adopted-prefix suffix dispatches under adopt_* instead of
+        # admit_* (a decode-tier host must report ZERO admission-prefill
+        # wall — the prefill tier owns that work now). "unified" is
+        # byte-identical to the pre-disagg scheduler.
+        self._role = getattr(engine, "role", "unified")
+        self._handoff = handoff
+        if self._role == "prefill" and handoff is None:
+            raise ValueError("role: prefill scheduler requires a handoff "
+                             "sink — prefilled requests have nowhere to go")
         self._inbox: queue.Queue[GenRequest | None] = queue.Queue()
         # Budget-deferred admissions wait HERE, not at the inbox tail:
         # re-queuing a deferred subgroup behind later arrivals inverted
@@ -203,6 +229,13 @@ class Scheduler:
                         # the coalescing ratio the batched host frame
                         # exists to raise.
                         "emit_flushes": 0, "emit_events": 0,
+                        # Disaggregation (all 0 outside the tier roles):
+                        # prefill tier — requests handed off + serialize/
+                        # extract wall; decode tier — adopted-prefix
+                        # suffix dispatches, booked HERE so admit_* stays
+                        # zero on a host that does no admission prefill.
+                        "handoffs": 0, "handoff_s": 0.0,
+                        "adopt_dispatches": 0, "adopt_s": 0.0,
                         # Speculative decoding (all 0 with the knob off):
                         # verify dispatches, tokens the drafter proposed,
                         # tokens the target accepted, and tokens rolled
@@ -230,6 +263,7 @@ class Scheduler:
         # stall is in the relay/wire, not the engine).
         self._ttft_hist = Histogram()
         self._admit_hist = Histogram()
+        self._adopt_hist = Histogram()
         self._interval_hist = Histogram()
         # Per-slot tokens emitted by each verify dispatch (1 = nothing
         # accepted, 1 + k_draft = the whole proposal) — the distribution
@@ -268,7 +302,10 @@ class Scheduler:
     def stats(self) -> dict[str, Any]:
         """Counters + engine-side latency percentiles (host stats op)."""
         out: dict[str, Any] = dict(self.metrics)
+        out["role"] = self._role
         out["occupancy"] = len(self._slots)
+        if self._adopt_hist.count:
+            out["adopt_dispatch_s"] = self._adopt_hist.to_dict()
         # Gauges for the two admission backlogs that were invisible in
         # host→provider stats: the budget-deferred deque and the
         # chunked-prefill jobs still building their prefixes.
@@ -376,6 +413,35 @@ class Scheduler:
         pending: tuple[Any, dict[int, _ActiveSlot], float] | None = None
         while True:
             self._spent_this_block = 0.0
+            # Dispatch block N+1 BEFORE this iteration's admission work:
+            # the decode block then sits at the FRONT of the device queue
+            # and admission prefills enqueue behind it, so a burst of
+            # arrivals never delays the block active streams are waiting
+            # on — the prefill lane is fully asynchronous to decode.
+            # (Measured motivation: steady wire throughput stuck at ~70%
+            # of engine-only because prefill dispatches issued ahead of
+            # the block stretched every block interval under continuous
+            # admission — BASELINE.md rounds 3-4.) A slot admitted this
+            # iteration joins the NEXT block — its first token was
+            # already sampled by its prefill dispatch, so TTFT is
+            # untouched; only its second token waits the extra block.
+            #
+            # Speculative mode still syncs/verifies first: the drafter
+            # needs the freshest context, and a verify dispatch IS this
+            # iteration's block (see the spec notes below).
+            did_verify = False
+            if self._slots and self._drafter is not None:
+                if pending is not None and self._spec_peek():
+                    self._process_block(pending[0], pending[1],
+                                        dispatched_at=pending[2])
+                    pending = None
+                if self._slots and pending is None:
+                    did_verify = self._maybe_verify_block()
+            nxt = None
+            if self._slots and not did_verify:
+                nxt = (self.engine.decode_steps_dispatch(),
+                       dict(self._slots), time.monotonic())
+                self.metrics["steps"] += self.engine.decode_block
             drained = self._admit_new()
             if not self._slots and pending is None and not self._prefill_jobs:
                 # Terminal/error events from the admission pass must reach
@@ -408,36 +474,24 @@ class Scheduler:
                 self._flush_events()
                 continue
 
-            # Dispatch block N+1 BEFORE syncing block N: np.asarray on
-            # block N then overlaps block N+1's device execution, hiding
-            # the host↔device transfer and all host-side bookkeeping
-            # behind compute.
+            # (Block N+1 was dispatched above, before admission; syncing
+            # block N below then overlaps N+1's device execution — the
+            # double buffer — while the admission dispatches that just
+            # enqueued run after N+1, never ahead of it.)
             #
-            # Speculative mode interleaves verify dispatches with those
-            # plain blocks: the drafter proposes continuations of the
-            # FRESHEST emitted context, so the in-flight plain block must
-            # sync before drafting, and a verify dispatch is processed in
-            # the same iteration (its output is the next proposals'
-            # context — there is nothing to overlap it with). That early
-            # sync costs the dispatch-before-sync overlap, so it is paid
-            # only when a PEEK at the current (one-block-stale) context
-            # says a proposal is likely — repetition that makes the fresh
-            # context match almost always makes the stale one match too.
-            # Non-repetitive traffic therefore keeps the overlapped plain
-            # path below, in the knob-off dispatch order exactly.
-            did_verify = False
-            if self._slots and self._drafter is not None:
-                if pending is not None and self._spec_peek():
-                    self._process_block(pending[0], pending[1],
-                                        dispatched_at=pending[2])
-                    pending = None
-                if self._slots and pending is None:
-                    did_verify = self._maybe_verify_block()
-            nxt = None
-            if self._slots and not did_verify:
-                nxt = (self.engine.decode_steps_dispatch(),
-                       dict(self._slots), time.monotonic())
-                self.metrics["steps"] += self.engine.decode_block
+            # Speculative-mode note for the early-sync above: the drafter
+            # proposes continuations of the FRESHEST emitted context, so
+            # the in-flight plain block must sync before drafting, and a
+            # verify dispatch is processed in the same iteration (its
+            # output is the next proposals' context — there is nothing to
+            # overlap it with). That early sync costs the dispatch-
+            # before-sync overlap, so it is paid only when a PEEK at the
+            # current (one-block-stale) context says a proposal is likely
+            # — repetition that makes the fresh context match almost
+            # always makes the stale one match too. Non-repetitive
+            # traffic keeps the overlapped plain path, in the knob-off
+            # dispatch order exactly.
+            #
             # Chunked prefills ride between decode dispatches: a bounded
             # number of chunk dispatches per block keeps long-prompt
             # admission from stalling active streams for more than ~a
@@ -756,6 +810,14 @@ class Scheduler:
             req.picked_at = now
             hit = None
             try:
+                if req.adopt is not None:
+                    # Handoff adoption (decode tier): parse the frame,
+                    # fill req.prompt_ids, and seed the prefix store
+                    # now, on THIS thread, so the lookup below hits it.
+                    # Run exactly once — a budget-deferred request
+                    # re-picks next block and must not re-adopt.
+                    adopt, req.adopt = req.adopt, None
+                    adopt(req)
                 if not req.prompt_ids:
                     raise ValueError("empty prompt")
                 n = len(req.prompt_ids)
@@ -889,11 +951,22 @@ class Scheduler:
             dt = time.perf_counter() - t0
             n_dispatches += 1
             self._spent_this_block += dt
-            self.metrics["admit_dispatches"] += 1
-            self.metrics["admit_s"] += dt
-            self._admit_hist.observe(dt)
-            self.tracer.record("prefill_dispatch", t0m, dt, n=len(sub),
-                               cached=hit is not None)
+            if hit is not None and self._role == "decode":
+                # Decode tier: a cached-unit dispatch is handoff ADOPTION
+                # (seed copy + suffix), not admission prefill — book it
+                # apart so this host's admit_* wall reads zero and the
+                # trace row names the work. (A p==0 routing-only handoff
+                # still full-prefills here and rightly counts as admit.)
+                self.metrics["adopt_dispatches"] += 1
+                self.metrics["adopt_s"] += dt
+                self._adopt_hist.observe(dt)
+                self.tracer.record("adopt_dispatch", t0m, dt, n=len(sub))
+            else:
+                self.metrics["admit_dispatches"] += 1
+                self.metrics["admit_s"] += dt
+                self._admit_hist.observe(dt)
+                self.tracer.record("prefill_dispatch", t0m, dt, n=len(sub),
+                                   cached=hit is not None)
             for (slot, req), first in zip(sub, firsts):
                 self._activate(slot, req, first)
         return n_dispatches
@@ -950,6 +1023,15 @@ class Scheduler:
                 self._activate(job.slot, req, first)
 
     def _activate(self, slot: int, req: GenRequest, first: int) -> None:
+        if self._role == "prefill":
+            # Prefill tier: the request's KV is built and installed in
+            # the slot lane — instead of decoding, hand it off and free
+            # the lane. (The sampled `first` token is discarded: the
+            # decode tier's suffix dispatch re-samples it from identical
+            # logits — exact for greedy, seeded lanes re-derive the same
+            # keys from their seed.)
+            self._handoff_request(slot, req, first)
+            return
         active = _ActiveSlot(req=req, decoder=self.engine.tokenizer.stream_decoder(),
                              prompt_len=len(req.prompt_ids))
         active.first_token_at = time.monotonic()
@@ -998,6 +1080,42 @@ class Scheduler:
                 text=text, token_id=first, tokens_generated=1,
                 tokens_emitted=1,
                 ttft_s=active.first_token_at - req.enqueued_at))
+
+    def _handoff_request(self, slot: int, req: GenRequest,
+                         first: int) -> None:
+        """Prefill-tier terminal: serialize + ship the prompt's KV (the
+        installed sink extracts the slot lane and writes the handoff
+        frame synchronously — by return, the lane is re-usable), then
+        free the slot. A sink failure fails THIS request with an error
+        event; it must never kill the admission loop."""
+        t0m = time.monotonic()
+        try:
+            self._handoff(slot, req, first)
+        except Exception as exc:  # noqa: BLE001 — fail one, not all
+            log.error(f"handoff failed for request {req.id}: {exc}")
+            self._emit_cb(req, TokenEvent(
+                text="", token_id=None, done=True, finish_reason="error",
+                error=f"handoff failed: {exc}"))
+        else:
+            dt = time.monotonic() - t0m
+            self.metrics["handoffs"] += 1
+            self.metrics["handoff_s"] += dt
+            if self.tracer.enabled:
+                # Same per-request spans a unified host records (queue,
+                # prefill), plus the handoff leg — the request's prefill-
+                # tier residency reads off the merged timeline directly.
+                picked = req.picked_at or t0m
+                self.tracer.record("queue", req.enqueued_at,
+                                   picked - req.enqueued_at,
+                                   request_id=req.id, trace_id=req.trace_id)
+                self.tracer.record("prefill", picked, t0m - picked,
+                                   request_id=req.id, trace_id=req.trace_id,
+                                   prompt_len=len(req.prompt_ids))
+                self.tracer.record("handoff", t0m, dt,
+                                   request_id=req.id, trace_id=req.trace_id)
+        finally:
+            self._free.append(slot)
+            self.engine.release_slot(slot)
 
     def _finish(self, slot: int, active: _ActiveSlot, reason: str,
                 tok: int | None, text: str) -> None:

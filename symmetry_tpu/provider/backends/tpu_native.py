@@ -68,6 +68,28 @@ class TpuNativeBackend(InferenceBackend):
         self._cfg_path: str | None = None
         self._queues: dict[str, asyncio.Queue] = {}
         self._reader: asyncio.Task | None = None
+        # --- disaggregated prefill/decode (tpu.role: disagg) ----------
+        # The backend then runs a HOST PAIR: self._proc is the decode
+        # host (primary: stats/trace/liveness target, serves the token
+        # streams), self._prefill_proc the prefill host. Submits route
+        # to the prefill tier; its `handoff` frames are forwarded to the
+        # decode tier as `adopt` ops by the broker, which also carries
+        # the request state across (engine/disagg/broker.py). The pair
+        # is supervised as ONE unit — either process dying runs the
+        # restarting-shed path and the respawn brings BOTH back.
+        self._disagg = (getattr(config.tpu, "role", "unified")
+                        or "unified") == "disagg"
+        self._broker = None
+        self._prefill_proc: asyncio.subprocess.Process | None = None
+        self._prefill_reader: asyncio.Task | None = None
+        self._prefill_cfg_path: str | None = None
+        self._prefill_clock_offset: float = 0.0
+        self._prefill_stats_waiters: list[asyncio.Future] = []
+        self._prefill_trace_waiters: list[asyncio.Future] = []
+        if self._disagg:
+            from symmetry_tpu.engine.disagg import HandoffBroker
+
+            self._broker = HandoffBroker()
         self._started = False
         self._host_dead = False
         self._engine_alive = True  # host-reported scheduler liveness
@@ -148,6 +170,16 @@ class TpuNativeBackend(InferenceBackend):
         if self._started:
             return
         tpu_cfg = self._config.tpu
+        role = getattr(tpu_cfg, "role", "unified") or "unified"
+        if role in ("prefill", "decode"):
+            raise BackendError(
+                f"tpu.role {role!r} is a per-host tier role the disagg "
+                f"broker assigns; a provider backend runs role unified "
+                f"or disagg")
+        if self._disagg and not self._process_mode:
+            raise BackendError(
+                "tpu.role: disagg requires engine_isolation: process "
+                "(the two tiers are separate engine hosts)")
         mh = tpu_cfg.multihost
         if mh and mh.get("num_processes", 1) > 1 and mh.get("process_id", 0) != 0:
             # Refuse BEFORE joining the distributed job / loading weights —
@@ -207,38 +239,55 @@ class TpuNativeBackend(InferenceBackend):
 
         cfg = {k: v for k, v in self._config.get_all().items()
                if k != "apiKey"}
-        with tempfile.NamedTemporaryFile("w", suffix=".yaml",
-                                         delete=False) as fh:
-            yaml.safe_dump(cfg, fh)
-            self._cfg_path = fh.name
+
+        def write_cfg(d: dict) -> str:
+            with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                             delete=False) as fh:
+                yaml.safe_dump(d, fh)
+                return fh.name
+
+        if self._disagg:
+            from symmetry_tpu.engine.disagg import derive_role_config
+
+            # Two derived config files, one per tier (the decode one is
+            # the PRIMARY self._cfg_path — stats/liveness target).
+            self._cfg_path = write_cfg(derive_role_config(cfg, "decode"))
+            self._prefill_cfg_path = write_cfg(
+                derive_role_config(cfg, "prefill"))
+        else:
+            self._cfg_path = write_cfg(cfg)
         self._host_down = asyncio.Event()
         await self._spawn_host()
         if self._sup_enabled:
             self._supervisor = asyncio.get_running_loop().create_task(
                 self._supervise())
 
-    async def _spawn_host(self) -> None:
-        """One host life: spawn, await ready, measure the clock offset,
-        start the reader. Shared by first start and every respawn (the
-        respawn reuses the same config file, so the persistent compile
-        cache makes it a warm start)."""
-        self._host_dead = False
-        self._engine_alive = True
-        self._proc = await asyncio.create_subprocess_exec(
-            *self._host_argv(self._cfg_path),
+    async def _spawn_one(self, cfg_path: str
+                         ) -> asyncio.subprocess.Process:
+        # readline() is bounded by the StreamReader limit (64 KiB
+        # default) and raises past it, killing the reader task — which
+        # the supervisor reads as a host death. 32 MiB fits the largest
+        # non-disagg line (a full-ring {"op":"trace"} reply). A disagg
+        # handoff frame is a single base64 line carrying a KV prefix —
+        # ~128 KiB/token raw on an 8B model, so a 2048-token bucket
+        # prefix is ~350 MB encoded; 1 GiB bounds that with headroom
+        # (the limit is a cap, not an allocation).
+        limit = (1 << 30) if self._disagg else 32 * 1024 * 1024
+        return await asyncio.create_subprocess_exec(
+            *self._host_argv(cfg_path),
             stdin=asyncio.subprocess.PIPE, stdout=asyncio.subprocess.PIPE,
-            # readline() is bounded by the StreamReader limit (64 KiB
-            # default) and raises past it — a full-ring {"op":"trace"}
-            # reply is a single multi-MB line, which would kill the
-            # reader task and wedge every stream. 32 MiB matches the
-            # wire-frame bound.
-            limit=32 * 1024 * 1024)
-        # await the ready line (weight loading + warmup happen in the host)
+            limit=limit)
+
+    @staticmethod
+    async def _await_ready(proc: asyncio.subprocess.Process,
+                           what: str) -> None:
+        """Read frames until the host's ready line (weight loading +
+        warmup happen in the host before it appears)."""
         while True:
-            line = await self._proc.stdout.readline()
+            line = await proc.stdout.readline()
             if not line:
-                rc = await self._proc.wait()
-                raise BackendError(f"engine host died during startup "
+                rc = await proc.wait()
+                raise BackendError(f"{what} died during startup "
                                    f"(rc={rc})")
             try:
                 msg = json.loads(line)
@@ -247,31 +296,60 @@ class TpuNativeBackend(InferenceBackend):
             if not isinstance(msg, dict):
                 continue  # stray scalar on stdout (see _read_events)
             if msg.get("op") == "ready":
-                break
-        await self._clock_handshake()
+                return
+
+    async def _spawn_host(self) -> None:
+        """One host life: spawn, await ready, measure the clock offset,
+        start the reader. Shared by first start and every respawn (the
+        respawn reuses the same config file(s), so the persistent
+        compile cache makes it a warm start). In disagg mode a "life"
+        is the PAIR: both processes are created first so their engine
+        builds overlap, then each is brought to ready."""
+        self._host_dead = False
+        self._engine_alive = True
+        self._proc = await self._spawn_one(self._cfg_path)
+        if self._disagg:
+            self._prefill_proc = await self._spawn_one(
+                self._prefill_cfg_path)
+        await self._await_ready(
+            self._proc, "decode host" if self._disagg else "engine host")
+        self._clock_offset = await self._clock_handshake(self._proc)
         self._reader = asyncio.get_running_loop().create_task(
             self._read_events())
+        if self._disagg:
+            await self._await_ready(self._prefill_proc, "prefill host")
+            self._prefill_clock_offset = await self._clock_handshake(
+                self._prefill_proc)
+            self._prefill_reader = asyncio.get_running_loop().create_task(
+                self._read_prefill_events())
+            log.info(
+                f"tpu_native prefill host up "
+                f"(pid {self._prefill_proc.pid}): clock_offset="
+                f"{self._prefill_clock_offset * 1e6:+.0f}us")
         self._spawned_at = time.monotonic()
-        log.info(f"tpu_native engine host up (pid {self._proc.pid}): "
+        log.info(f"tpu_native engine host up (pid {self._proc.pid}"
+                 f"{', disagg pair' if self._disagg else ''}): "
                  f"model={self._model_name} "
                  f"clock_offset={self._clock_offset * 1e6:+.0f}us")
 
-    async def _clock_handshake(self, rounds: int = 5) -> None:
-        """Measure the host's monotonic-clock offset before any traffic.
+    async def _clock_handshake(self, proc: asyncio.subprocess.Process,
+                               rounds: int = 5) -> float:
+        """Measure one host's monotonic-clock offset before any traffic.
 
         Each round brackets the host's clock read between two local
         stamps; the min-RTT sample's NTP midpoint wins (utils/trace.
-        clock_handshake_offset). Runs before the reader task exists, so
-        replies are read directly off the pipe — nothing else can be
-        writing yet (no requests submitted, stats only on demand)."""
+        clock_handshake_offset). Runs before that host's reader task
+        exists, so replies are read directly off the pipe — nothing
+        else can be writing yet (no requests submitted, stats only on
+        demand)."""
         from symmetry_tpu.utils.trace import clock_handshake_offset
 
         samples: list[tuple[float, float, float]] = []
         for _ in range(rounds):
             t0 = time.monotonic()
-            await self._host_send({"op": "clock", "t0": t0})
+            await self._host_send({"op": "clock", "t0": t0}, proc=proc)
             while True:
-                line = await self._proc.stdout.readline()
+                line = await proc.stdout.readline()
                 if not line:
                     raise BackendError(
                         "engine host died during clock handshake")
@@ -284,7 +362,7 @@ class TpuNativeBackend(InferenceBackend):
                 if msg.get("op") == "clock" and msg.get("t0") == t0:
                     samples.append((t0, float(msg["t"]), time.monotonic()))
                     break
-        self._clock_offset = clock_handshake_offset(samples)
+        return clock_handshake_offset(samples)
 
     async def _read_events(self) -> None:
         proc = self._proc
@@ -347,19 +425,88 @@ class TpuNativeBackend(InferenceBackend):
         # Natural EOF only (a cancelled reader must NOT run this: during
         # a respawn the old task is cancelled, and firing the death path
         # then would fail streams served by the NEW host and re-trip the
-        # supervisor against a healthy process). Idempotent per life: if
-        # the supervisor's heartbeat already handled this death (its
-        # returncode/dead-reader backstop runs _fail_streams and sets
-        # _host_down itself), a late EOF re-signaling the event would
-        # wake the supervisor a SECOND time after the respawn — counting
-        # a spurious stability failure and killing the healthy new host.
+        # supervisor against a healthy process).
+        self._handle_host_exit("engine host exited")
+
+    async def _read_prefill_events(self) -> None:
+        """Prefill-host pipe pump (disagg only): forward handoff frames
+        to the decode host as adopt ops, relay the prefill tier's OWN
+        events (tokenization/admission errors, deadline sheds — terminal
+        by construction, this tier never streams tokens), and feed its
+        stats/trace probes. EOF runs the SAME death path as the decode
+        host: the pair is one supervised unit."""
+        proc = self._prefill_proc
+        assert proc is not None and proc.stdout is not None
+        while True:
+            line = await proc.stdout.readline()
+            if not line:
+                break  # prefill host exited
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(msg, dict):
+                continue
+            op = msg.get("op")
+            if op == "handoff":
+                adopt = self._broker.adopt_op(msg)
+                if adopt is None:
+                    continue  # request already cancelled/failed
+                try:
+                    await self._host_send(adopt)
+                except (ConnectionError, OSError):
+                    # Decode host dying mid-forward: its death path is
+                    # about to shed every stream, this one included.
+                    pass
+                continue
+            if op == "stats":
+                waiters, self._prefill_stats_waiters = (
+                    self._prefill_stats_waiters, [])
+                for w in waiters:
+                    if not w.done():
+                        w.set_result(msg)
+                continue
+            if op == "trace":
+                waiters, self._prefill_trace_waiters = (
+                    self._prefill_trace_waiters, [])
+                for w in waiters:
+                    if not w.done():
+                        w.set_result(msg)
+                continue
+            if op in ("event", "events"):
+                events = (msg.get("events")
+                          if op == "events" else [msg])
+                if not isinstance(events, list):
+                    continue
+                for ev in events:
+                    if not isinstance(ev, dict):
+                        continue
+                    req_id = str(ev.get("id", ""))
+                    if ev.get("done"):
+                        # Terminal on the prefill tier: the migration
+                        # will never happen — drop the pending state.
+                        self._broker.forget(req_id)
+                    q = self._queues.get(req_id)
+                    if q is not None:
+                        q.put_nowait(ev)
+        self._handle_host_exit("prefill host exited")
+
+    def _handle_host_exit(self, reason: str) -> None:
+        """Shared reader-EOF death path. Idempotent per life: if the
+        supervisor's heartbeat already handled this death (its
+        returncode/dead-reader backstop runs _fail_streams and sets
+        _host_down itself), a late EOF re-signaling the event would
+        wake the supervisor a SECOND time after the respawn — counting
+        a spurious stability failure and killing the healthy new host.
+        In disagg mode EITHER host's EOF lands here; the respawn
+        replaces the pair."""
         if self._host_dead:
             return
         # Fail every open stream — the host is gone — and wake the
         # supervisor. _host_dead also fences NEW streams (they would
         # otherwise register a queue nobody feeds and hang forever).
         self._host_dead = True
-        self._fail_streams("engine host exited")
+        self._fail_streams(reason)
         if self._host_down is not None:
             self._host_down.set()
 
@@ -378,14 +525,25 @@ class TpuNativeBackend(InferenceBackend):
                           "finish_reason": "error",
                           "restarting": restarting,
                           "error": reason, "text": ""})
-        for w in self._stats_waiters + self._trace_waiters:
+        for w in (self._stats_waiters + self._trace_waiters
+                  + self._prefill_stats_waiters
+                  + self._prefill_trace_waiters):
             if not w.done():
                 w.set_result(None)
         self._stats_waiters.clear()
         self._trace_waiters.clear()
+        self._prefill_stats_waiters.clear()
+        self._prefill_trace_waiters.clear()
+        if self._broker is not None:
+            self._broker.fail_all()
 
-    async def _host_send(self, obj: dict) -> None:
-        proc = self._proc
+    async def _host_send(self, obj: dict,
+                         proc: asyncio.subprocess.Process | None = None
+                         ) -> None:
+        """Write one command line to a host's stdin (default: the
+        primary/decode host)."""
+        if proc is None:
+            proc = self._proc
         if (proc is None or proc.stdin is None
                 or getattr(proc.stdin, "is_closing", lambda: False)()):
             # Mid-respawn (or dead) host: surface as the connection error
@@ -407,9 +565,24 @@ class TpuNativeBackend(InferenceBackend):
                 await self._supervisor
             self._supervisor = None
         self._restarting = False
+        # Prefill host first (disagg): it holds no streams, and stopping
+        # it before the decode host means no handoff can land on a
+        # half-shut pipe.
+        if self._prefill_proc is not None:
+            with contextlib.suppress(ConnectionError, OSError):
+                await self._host_send({"op": "shutdown"},
+                                      proc=self._prefill_proc)
+            try:
+                await asyncio.wait_for(self._prefill_proc.wait(),
+                                       self._stop_grace_s)
+            except asyncio.TimeoutError:
+                self._prefill_proc.kill()
+                await self._prefill_proc.wait()  # reap — no zombie
+            self._prefill_proc = None
+        if self._prefill_reader is not None:
+            self._prefill_reader.cancel()
+            self._prefill_reader = None
         if self._proc is not None:
-            import os
-
             with contextlib.suppress(ConnectionError, OSError):
                 await self._host_send({"op": "shutdown"})
             try:
@@ -422,12 +595,14 @@ class TpuNativeBackend(InferenceBackend):
         if self._reader is not None:
             self._reader.cancel()
             self._reader = None
-        if self._cfg_path:
-            import os
+        for attr in ("_cfg_path", "_prefill_cfg_path"):
+            path = getattr(self, attr)
+            if path:
+                import os
 
-            with contextlib.suppress(OSError):
-                os.unlink(self._cfg_path)
-            self._cfg_path = None
+                with contextlib.suppress(OSError):
+                    os.unlink(path)
+                setattr(self, attr, None)
         if self._scheduler is not None:
             await asyncio.to_thread(self._scheduler.stop)
             if self._command_loop is not None:
@@ -456,9 +631,18 @@ class TpuNativeBackend(InferenceBackend):
                 proc = self._proc
                 if proc is None or self._host_dead:
                     continue  # death already detected; EOF wakes us
-                if (proc.returncode is not None or self._reader is None
-                        or self._reader.done()):
-                    # The process died or the reader task crashed WITHOUT
+                silent_death = (proc.returncode is not None
+                                or self._reader is None
+                                or self._reader.done())
+                if self._disagg and not silent_death:
+                    # The pair is one unit: a dead prefill host/reader
+                    # is the same failure as a dead decode one.
+                    pp = self._prefill_proc
+                    silent_death = (pp is None or pp.returncode is not None
+                                    or self._prefill_reader is None
+                                    or self._prefill_reader.done())
+                if silent_death:
+                    # A process died or a reader task crashed WITHOUT
                     # the EOF path running (e.g. the reader hit an
                     # unexpected exception): nobody failed the streams or
                     # set _host_down, so waiting for it would spin this
@@ -468,18 +652,29 @@ class TpuNativeBackend(InferenceBackend):
                               "handling; recovering")
                     self._host_dead = True
                     self._fail_streams("engine host reader failed")
-                    import contextlib
-
-                    if proc.returncode is None:
-                        with contextlib.suppress(ProcessLookupError):
-                            proc.kill()
+                    self._kill_host_procs()
                     self._host_down.set()
                     continue
                 msg = await self._probe_host_stats(
                     timeout=self._wedge_timeout_s)
+                alive = msg is not None and self._engine_alive
+                if alive and self._disagg and self._started:
+                    # Decode tier answered — the prefill tier must too,
+                    # with a LIVE scheduler thread (a wedged or engine-
+                    # dead prefill host means every new request queues
+                    # forever while active streams look healthy). Its
+                    # engine_alive rides the probe reply directly; the
+                    # reader only tracks the decode host's.
+                    pmsg = await self._probe_prefill_stats(
+                        timeout=self._wedge_timeout_s)
+                    if pmsg is None:
+                        msg = None  # prefill wedge
+                        alive = False
+                    elif not pmsg.get("engine_alive", True):
+                        alive = False
                 if not self._started:
                     return
-                if msg is not None and self._engine_alive:
+                if alive:
                     continue
                 self._down_reason = ("wedge" if msg is None
                                      else "engine_dead")
@@ -487,10 +682,7 @@ class TpuNativeBackend(InferenceBackend):
                     f"supervisor: host {self._down_reason} "
                     f"(pid {proc.pid}, no healthy stats reply within "
                     f"{self._wedge_timeout_s:.1f}s); killing it")
-                import contextlib
-
-                with contextlib.suppress(ProcessLookupError):
-                    proc.kill()
+                self._kill_host_procs()
                 continue  # reader EOF fails streams and sets _host_down
             self._host_down.clear()
             if not self._started or self._circuit_open:
@@ -573,21 +765,36 @@ class TpuNativeBackend(InferenceBackend):
         finally:
             self._restarting = False
 
-    async def _reap_host(self) -> None:
-        """Tear down the current host life (dead or partial) so a fresh
-        spawn starts clean: cancel the reader, kill and reap the process."""
+    def _kill_host_procs(self) -> None:
+        """SIGKILL whatever of the host pair is still running (reaping
+        happens in _reap_host / the readers' EOF paths)."""
         import contextlib
 
-        if self._reader is not None:
-            self._reader.cancel()
-            self._reader = None
-        proc, self._proc = self._proc, None
-        if proc is not None:
-            if proc.returncode is None:
+        for proc in (self._proc, self._prefill_proc):
+            if proc is not None and proc.returncode is None:
                 with contextlib.suppress(ProcessLookupError):
                     proc.kill()
-            with contextlib.suppress(Exception):
-                await proc.wait()
+
+    async def _reap_host(self) -> None:
+        """Tear down the current host life (dead or partial) so a fresh
+        spawn starts clean: cancel the readers, kill and reap the
+        process(es) — in disagg mode the pair is replaced together."""
+        import contextlib
+
+        for attr in ("_reader", "_prefill_reader"):
+            task = getattr(self, attr)
+            if task is not None:
+                task.cancel()
+                setattr(self, attr, None)
+        for attr in ("_proc", "_prefill_proc"):
+            proc = getattr(self, attr)
+            setattr(self, attr, None)
+            if proc is not None:
+                if proc.returncode is None:
+                    with contextlib.suppress(ProcessLookupError):
+                        proc.kill()
+                with contextlib.suppress(Exception):
+                    await proc.wait()
 
     def _supervisor_stats(self) -> dict | None:
         if not (self._process_mode and self._sup_enabled):
@@ -597,39 +804,47 @@ class TpuNativeBackend(InferenceBackend):
                 "restarting": self._restarting,
                 "circuit_open": self._circuit_open}
 
-    async def _probe_host_stats(self, timeout: float = 10.0) -> dict | None:
-        """One fresh stats round-trip to the host; None on timeout/failure
+    async def _probe(self, op: str, waiters: list,
+                     proc: asyncio.subprocess.Process | None,
+                     timeout: float) -> dict | None:
+        """One fresh op round-trip to a host; None on timeout/failure
         (a fire-and-forget probe would return the PREVIOUS probe's answer,
         delaying wedge detection by a health-loop period)."""
         import contextlib
 
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._stats_waiters.append(fut)
+        waiters.append(fut)
         try:
             with contextlib.suppress(ConnectionError, OSError):
-                await self._host_send({"op": "stats"})
+                await self._host_send({"op": op}, proc=proc)
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
             return None
         finally:
-            if fut in self._stats_waiters:
-                self._stats_waiters.remove(fut)
+            if fut in waiters:
+                waiters.remove(fut)
+
+    async def _probe_host_stats(self, timeout: float = 10.0) -> dict | None:
+        return await self._probe("stats", self._stats_waiters, None,
+                                 timeout)
 
     async def _probe_host_trace(self, timeout: float = 10.0) -> dict | None:
-        """One trace-ring round-trip to the host; None on timeout."""
-        import contextlib
+        return await self._probe("trace", self._trace_waiters, None,
+                                 timeout)
 
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._trace_waiters.append(fut)
-        try:
-            with contextlib.suppress(ConnectionError, OSError):
-                await self._host_send({"op": "trace"})
-            return await asyncio.wait_for(fut, timeout)
-        except asyncio.TimeoutError:
+    async def _probe_prefill_stats(self, timeout: float = 10.0
+                                   ) -> dict | None:
+        if self._prefill_proc is None:
             return None
-        finally:
-            if fut in self._trace_waiters:
-                self._trace_waiters.remove(fut)
+        return await self._probe("stats", self._prefill_stats_waiters,
+                                 self._prefill_proc, timeout)
+
+    async def _probe_prefill_trace(self, timeout: float = 10.0
+                                   ) -> dict | None:
+        if self._prefill_proc is None:
+            return None
+        return await self._probe("trace", self._prefill_trace_waiters,
+                                 self._prefill_proc, timeout)
 
     async def trace_components(self) -> list[dict]:
         """Host + scheduler span rings, reconciled onto THIS process's
@@ -649,6 +864,20 @@ class TpuNativeBackend(InferenceBackend):
                     comps.append({**comp, "clock_offset_s":
                                   float(comp.get("clock_offset_s", 0.0))
                                   + self._clock_offset})
+            if self._disagg:
+                # The prefill tier's rings too, on ITS measured offset,
+                # with role-prefixed component names so the merged
+                # timeline shows two distinct process rows (satellite
+                # contract: per-role trace rows, not unified-mode ones).
+                pmsg = await self._probe_prefill_trace()
+                for comp in (pmsg or {}).get("components") or []:
+                    if isinstance(comp, dict):
+                        comps.append({
+                            **comp,
+                            "name": f"prefill_{comp.get('name', 'host')}",
+                            "clock_offset_s":
+                                float(comp.get("clock_offset_s", 0.0))
+                                + self._prefill_clock_offset})
             return comps
         if self._scheduler is not None:
             trace_export = getattr(self._scheduler, "trace_export", None)
@@ -679,6 +908,18 @@ class TpuNativeBackend(InferenceBackend):
                              if h.count}
             if sup:
                 out["supervisor"] = sup
+            if self._disagg:
+                # The handoff ledger (broker counters + prefill-tier
+                # latency percentiles) and the prefill host's own
+                # breakdown, nested so a capture can attribute a slow
+                # TTFT to prefill-tier admission vs handoff vs decode-
+                # tier adoption — the disagg analog of the stage hists.
+                disagg: dict = self._broker.stats()
+                pmsg = await self._probe_prefill_stats()
+                if pmsg is not None:
+                    disagg["prefill_host"] = {
+                        k: v for k, v in pmsg.items() if k != "op"}
+                out["disagg"] = disagg
             return out
         if self._scheduler is None:
             return None
@@ -701,6 +942,10 @@ class TpuNativeBackend(InferenceBackend):
                 return True
             if (self._proc is None or self._host_dead
                     or self._proc.returncode is not None):
+                return False
+            if self._disagg and (
+                    self._prefill_proc is None
+                    or self._prefill_proc.returncode is not None):
                 return False
             if await self._probe_host_stats() is None:
                 return False
@@ -825,8 +1070,12 @@ class TpuNativeBackend(InferenceBackend):
         if self._circuit_open:
             raise BackendError(
                 "engine host unavailable (circuit breaker open)")
-        if (self._restarting or self._host_dead or self._proc is None
-                or self._proc.returncode is not None):
+        down = (self._restarting or self._host_dead or self._proc is None
+                or self._proc.returncode is not None)
+        if not down and self._disagg:
+            down = (self._prefill_proc is None
+                    or self._prefill_proc.returncode is not None)
+        if down:
             if self._sup_enabled:
                 raise BackendRestartingError(
                     "engine host restarting",
@@ -846,7 +1095,7 @@ class TpuNativeBackend(InferenceBackend):
         t_recv = time.monotonic()
         try:
             try:
-                await self._host_send({
+                submit = {
                     "op": "submit", "id": request_id,
                     "messages": request.messages, "max_new": max_new,
                     "sampling": {"temperature": request.temperature or 0.0,
@@ -861,7 +1110,15 @@ class TpuNativeBackend(InferenceBackend):
                     **({"trace": request.trace_id}
                        if request.trace_id else {}),
                     **({"deadline_s": request.deadline_s}
-                       if request.deadline_s is not None else {})})
+                       if request.deadline_s is not None else {})}
+                if self._disagg:
+                    # Disagg: new work enters through the PREFILL tier;
+                    # the broker keeps the state the decode tier will
+                    # need when the handoff frame comes back.
+                    self._broker.note_submit(request_id, submit)
+                    await self._host_send(submit, proc=self._prefill_proc)
+                else:
+                    await self._host_send(submit)
             except (ConnectionError, OSError):
                 # The host died between the fence and the write (the
                 # reader may not have processed the EOF yet, so the
@@ -922,10 +1179,18 @@ class TpuNativeBackend(InferenceBackend):
                     return
         finally:
             self._queues.pop(request_id, None)
-            if (not completed and self._proc is not None
-                    and self._proc.returncode is None):
-                # client abandoned the stream: free the slot host-side
+            if not completed:
+                # client abandoned the stream: free the slot host-side.
+                # In disagg the request may be on EITHER tier (queued or
+                # prefilling on one, decoding on the other) — cancel on
+                # both; the hosts ignore ids they don't hold.
                 import contextlib
 
-                with contextlib.suppress(ConnectionError, OSError):
-                    await self._host_send({"op": "cancel", "id": request_id})
+                if self._broker is not None:
+                    self._broker.forget(request_id)
+                for proc in (self._proc, self._prefill_proc):
+                    if proc is None or proc.returncode is not None:
+                        continue
+                    with contextlib.suppress(ConnectionError, OSError):
+                        await self._host_send(
+                            {"op": "cancel", "id": request_id}, proc=proc)

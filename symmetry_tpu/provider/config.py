@@ -115,6 +115,25 @@ class TpuConfig:
     # latency with it (engine/host.py). "inproc": same-process engine
     # thread (tests, debugging).
     engine_isolation: str = "process"
+    # Disaggregated prefill/decode (engine/disagg/). "unified" (default):
+    # today's behavior, one engine does both phases. "disagg": the
+    # backend runs a PREFILL host (admissions + chunked prefill only;
+    # serializes each finished prompt's KV into a versioned handoff
+    # frame) and a DECODE host (adopts frames through its prefix store —
+    # auto-enabled with a default budget — and generates), with the
+    # handoff broker routing submits to the prefill tier and piping
+    # handoff → adopt between them; the pair is supervised as ONE unit
+    # (either host dying triggers the restarting-shed + respawn path).
+    # "prefill"/"decode" are the per-tier host roles the broker assigns —
+    # set them directly only when driving engine/host.py by hand.
+    # Requires engine_isolation "process" and a single-device engine.
+    # Greedy output is token-identical disagg vs unified (test-enforced).
+    role: str = "unified"
+    # Per-tier overrides for role: disagg — {"prefill": {...}, "decode":
+    # {...}}, each a mapping merged into that tier's tpu section; the
+    # special key "faults" inside a tier lands as that HOST's top-level
+    # faults mapping (chaos-test one tier of the pair).
+    disagg: dict[str, Any] | None = None
     # Engine-host supervision (process isolation only): a heartbeat
     # watchdog piggybacked on the host stats op detects crashes AND
     # wedges with a much tighter deadline than the 15 s provider health

@@ -16,6 +16,9 @@ Instrumented seams (grep for `FAULTS.point` / `FAULTS.apoint`):
     backend.dispatch   tpu_native request submit (host pipe or inproc)
     provider.relay     provider → client per-chunk relay
     scheduler.admit    scheduler request admission
+    disagg.handoff     prefill-tier handoff frame emit (crash = the
+                       prefill host dies with KV built but unshipped;
+                       drop_frame = the request silently vanishes)
 
 Actions:
 

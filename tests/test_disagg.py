@@ -1,0 +1,706 @@
+"""Disaggregated prefill/decode: KV handoff frames, roles, and identity.
+
+Covers the acceptance surface of the disagg PR:
+
+  - frame codec: round-trip across GQA kv_dim shapes, int8-quantized
+    caches (scale planes), bf16 payloads, routing-only (p == 0) frames;
+    truncated/corrupt/wrong-version/wrong-shape frames are REJECTED
+    (versioned header + crc — bad frames must never adopt as KV)
+  - broker: per-role config derivation (role pinned, decode tier's
+    prefix cache defaulted, per-tier faults), request-state migration
+    (adopt op carries sampling/max_new, deadline rebased by prefill-tier
+    time), unknown/cancelled ids dropped
+  - engine roles: construction contracts (decode needs the prefix
+    store, prefill needs a chunk size, mesh refused), adoption rejects
+    geometry/dtype/alignment mismatches, budget rejection degrades to
+    full prefill
+  - THE contract: greedy decode is token-identical between a unified
+    engine and an in-process prefill-role → frames → decode-role pair,
+    across short (routing-only), single-dispatch, and multi-chunk
+    prompts — with per-role scheduler accounting (a decode host books
+    adoption, not admission prefill; a prefill host books handoffs)
+  - host wire ops: the prefill host's handoff frame emit (counters,
+    short-prompt fast path) and the decode host's adopt op (corrupt
+    frame → error event, never a submit)
+"""
+
+import json
+import struct
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symmetry_tpu.engine.disagg import (
+    DEFAULT_DECODE_PREFIX_MB,
+    FrameError,
+    HandoffBroker,
+    decode_kv_handoff,
+    derive_role_config,
+    encode_kv_handoff,
+)
+from symmetry_tpu.engine.engine import EngineError, InferenceEngine, SamplingParams
+from symmetry_tpu.engine.scheduler import GenRequest, Scheduler
+from symmetry_tpu.engine.tokenizer import ByteTokenizer
+from symmetry_tpu.models import init_params, preset
+
+
+# ---------------------------------------------------------------------
+# Frame codec
+
+
+def gqa_arrays(L=3, K=2, D=8, p=16, dtype=np.float32):
+    """kv_heads != heads — the GQA shape the frames must round-trip."""
+    rng = np.random.default_rng(0)
+    return {
+        "k": rng.standard_normal((L, 1, p, K, D)).astype(dtype),
+        "v": rng.standard_normal((L, 1, p, K, D)).astype(dtype),
+    }
+
+
+class TestFrames:
+    def test_roundtrip_gqa_f32(self):
+        arrays = gqa_arrays()
+        tokens = list(range(20))
+        buf = encode_kv_handoff("req-1", tokens, 16, arrays)
+        h = decode_kv_handoff(buf)
+        assert h.request_id == "req-1"
+        assert h.tokens == tuple(tokens)
+        assert h.p == 16 and not h.kv_quant
+        np.testing.assert_array_equal(h.arrays["k"], arrays["k"])
+        np.testing.assert_array_equal(h.arrays["v"], arrays["v"])
+
+    def test_roundtrip_int8_quantized(self):
+        L, K, p = 2, 4, 8
+        arrays = {
+            "k": np.arange(L * p * K * 4, dtype=np.int8).reshape(
+                L, 1, p, K, 4),
+            "v": np.ones((L, 1, p, K, 4), np.int8),
+            "k_scale": np.full((L, 1, K, p), 0.5, np.float32),
+            "v_scale": np.full((L, 1, K, p), 0.25, np.float32),
+        }
+        buf = encode_kv_handoff("q", list(range(10)), p, arrays,
+                                kv_quant=True)
+        h = decode_kv_handoff(buf)
+        assert h.kv_quant
+        np.testing.assert_array_equal(h.arrays["k_scale"],
+                                      arrays["k_scale"])
+        assert h.arrays["k"].dtype == np.int8
+
+    def test_roundtrip_bf16(self):
+        import ml_dtypes
+
+        arrays = {k: v.astype(ml_dtypes.bfloat16)
+                  for k, v in gqa_arrays(p=8).items()}
+        h = decode_kv_handoff(encode_kv_handoff("b", list(range(9)), 8,
+                                                arrays))
+        assert h.arrays["k"].dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(h.arrays["k"], arrays["k"])
+
+    def test_routing_only_frame(self):
+        h = decode_kv_handoff(encode_kv_handoff("r0", [1, 2, 3], 0, None))
+        assert h.p == 0 and h.arrays == {} and h.tokens == (1, 2, 3)
+
+    def test_multi_chunk_prefix_roundtrip(self):
+        """A prefix spanning several prefill chunks is still ONE frame —
+        the codec carries whatever p the prefill tier built."""
+        arrays = gqa_arrays(p=48)  # 6 chunks at chunk=8
+        h = decode_kv_handoff(encode_kv_handoff("m", list(range(50)), 48,
+                                                arrays))
+        assert h.p == 48 and h.arrays["k"].shape[2] == 48
+
+    def test_truncated_rejected(self):
+        buf = encode_kv_handoff("t", list(range(20)), 16, gqa_arrays())
+        for cut in (0, 4, 10, len(buf) // 2, len(buf) - 1):
+            with pytest.raises(FrameError):
+                decode_kv_handoff(buf[:cut])
+
+    def test_corrupt_payload_rejected(self):
+        buf = bytearray(encode_kv_handoff("c", list(range(20)), 16,
+                                          gqa_arrays()))
+        buf[len(buf) // 2] ^= 0xFF
+        with pytest.raises(FrameError, match="checksum"):
+            decode_kv_handoff(bytes(buf))
+
+    def test_wrong_version_rejected(self):
+        buf = bytearray(encode_kv_handoff("v", list(range(20)), 16,
+                                          gqa_arrays()))
+        buf[4:6] = struct.pack("<H", 99)
+        with pytest.raises(FrameError, match="version"):
+            decode_kv_handoff(bytes(buf))
+
+    def test_bad_magic_rejected(self):
+        buf = encode_kv_handoff("m", list(range(20)), 16, gqa_arrays())
+        with pytest.raises(FrameError, match="magic"):
+            decode_kv_handoff(b"NOPE" + buf[4:])
+
+    def test_shape_and_plane_validation(self):
+        arrays = gqa_arrays(p=16)
+        # p axis disagreeing with meta is caught at decode
+        bad = dict(arrays)
+        bad["k"] = arrays["k"][:, :, :8]
+        with pytest.raises(FrameError):
+            decode_kv_handoff(encode_kv_handoff("s", list(range(20)), 16,
+                                                bad))
+        # encoder itself enforces plane presence
+        with pytest.raises(ValueError, match="missing KV planes"):
+            encode_kv_handoff("s", list(range(20)), 16, {"k": arrays["k"]})
+        # quantized frame without scale planes
+        with pytest.raises(ValueError, match="missing KV planes"):
+            encode_kv_handoff("s", list(range(20)), 16, arrays,
+                              kv_quant=True)
+        # p beyond the prompt
+        with pytest.raises(ValueError):
+            encode_kv_handoff("s", [1, 2], 16, arrays)
+
+    def test_decoder_shape_validation(self):
+        """A structurally-valid frame whose meta lies about shapes is
+        still rejected (defense against a buggy/mismatched peer)."""
+        arrays = gqa_arrays(p=16)
+        buf = encode_kv_handoff("d", list(range(20)), 16, arrays)
+        # splice the meta: claim p=8 while arrays carry 16
+        from symmetry_tpu.engine.disagg import encode_frame
+
+        meta = {"id": "d", "tokens": list(range(20)), "p": 8,
+                "kv_quant": False}
+        forged = encode_frame(meta, arrays)
+        with pytest.raises(FrameError):
+            decode_kv_handoff(forged)
+        assert decode_kv_handoff(buf).p == 16  # control
+
+
+# ---------------------------------------------------------------------
+# Broker
+
+
+BASE_CFG = {
+    "name": "p", "public": True, "serverKey": "00" * 32,
+    "modelName": "tiny:test", "apiProvider": "tpu_native",
+    "tpu": {"role": "disagg", "model_preset": "tiny",
+            "max_batch_size": 4,
+            "disagg": {"prefill": {"faults": {"disagg.handoff": "crash"}},
+                       "decode": {"max_batch_size": 8}}},
+}
+
+
+class TestBroker:
+    def test_derive_role_configs(self):
+        pre = derive_role_config(BASE_CFG, "prefill")
+        dec = derive_role_config(BASE_CFG, "decode")
+        assert pre["tpu"]["role"] == "prefill"
+        assert dec["tpu"]["role"] == "decode"
+        # per-tier overrides land in the tier's tpu section only
+        assert pre["tpu"]["max_batch_size"] == 4
+        assert dec["tpu"]["max_batch_size"] == 8
+        # tier faults land TOP-LEVEL on that host only
+        assert pre["faults"] == {"disagg.handoff": "crash"}
+        assert "faults" not in dec
+        # decode tier gets a prefix-cache budget by default
+        assert dec["tpu"]["prefix_cache_mb"] == DEFAULT_DECODE_PREFIX_MB
+        assert "prefix_cache_mb" not in pre["tpu"]
+        # neither derived config keeps the disagg mapping (a tier host
+        # must not recurse)
+        assert "disagg" not in pre["tpu"] and "disagg" not in dec["tpu"]
+        # the source mapping is untouched
+        assert BASE_CFG["tpu"]["role"] == "disagg"
+
+    def test_adopt_op_migrates_state_and_rebases_deadline(self):
+        broker = HandoffBroker()
+        broker.note_submit("r1", {
+            "op": "submit", "id": "r1", "messages": [{"role": "user"}],
+            "max_new": 32, "sampling": {"temperature": 0.5, "seed": 7},
+            "trace": "t-1", "deadline_s": 10.0})
+        time.sleep(0.05)
+        op = broker.adopt_op({"id": "r1", "p": 16, "nbytes": 1234,
+                              "frame": "QUJD"})
+        assert op["op"] == "adopt" and op["id"] == "r1"
+        assert op["frame"] == "QUJD"
+        assert op["max_new"] == 32
+        assert op["sampling"] == {"temperature": 0.5, "seed": 7}
+        assert op["trace"] == "t-1"
+        assert "messages" not in op  # tokens ride the frame
+        assert 9.0 < op["deadline_s"] < 10.0  # rebased, not reset
+        assert broker.counters["handoff_frames"] == 1
+        assert broker.counters["handoff_bytes"] == 1234
+        assert broker.counters["prefix_tokens"] == 16
+        assert broker.pending == 0
+        assert broker.prefill_tier_hist.count == 1
+
+    def test_unknown_or_forgotten_id_drops_frame(self):
+        broker = HandoffBroker()
+        assert broker.adopt_op({"id": "ghost", "p": 0}) is None
+        broker.note_submit("r2", {"max_new": 8})
+        broker.forget("r2")  # cancelled before the handoff came back
+        assert broker.adopt_op({"id": "r2", "p": 0}) is None
+        assert broker.counters["dropped"] == 1
+        stats = broker.stats()
+        assert stats["submitted"] == 1 and stats["pending"] == 0
+
+    def test_fail_all_clears_pending(self):
+        broker = HandoffBroker()
+        broker.note_submit("a", {})
+        broker.note_submit("b", {})
+        broker.fail_all()
+        assert broker.pending == 0
+        assert broker.counters["dropped"] == 2
+
+
+# ---------------------------------------------------------------------
+# Engine roles + the token-identity contract
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = preset("tiny")
+    params = init_params(cfg, jax.random.key(0), jnp.float32)
+    return cfg, params
+
+
+def make_engine(cfg, params, role="unified", cache_mb=16, chunk=8,
+                slots=4, **kw):
+    return InferenceEngine(
+        cfg, params, ByteTokenizer(), max_slots=slots, max_seq_len=64,
+        prefill_buckets=(16, 32), cache_dtype=jnp.float32,
+        prefill_chunk=chunk, prefix_cache_bytes=int(cache_mb * 2**20),
+        role=role, **kw)
+
+
+def drive(sched, prompts, max_new=6, timeout=120):
+    """Submit greedy requests; returns [(text, finish_reason, error)]."""
+    done = threading.Event()
+    out = [None] * len(prompts)
+    texts = [[] for _ in prompts]
+    remaining = [len(prompts)]
+
+    def mk(i):
+        def emit(ev):
+            texts[i].append(ev.text)
+            if ev.done:
+                out[i] = ("".join(texts[i]), ev.finish_reason, ev.error)
+                remaining[0] -= 1
+                if remaining[0] == 0:
+                    done.set()
+        return emit
+
+    for i, ids in enumerate(prompts):
+        sched.submit(GenRequest(prompt_ids=list(ids),
+                                sampling=SamplingParams(),
+                                max_new_tokens=max_new, emit=mk(i),
+                                id=f"r{i}"))
+    assert done.wait(timeout), f"streams incomplete: {out}"
+    return out
+
+
+def host_style_handoff(engine, slot, req):
+    """What the prefill host's sink does: extract the aligned slot-lane
+    KV and serialize it (the real sink lives in engine/host.py; this
+    mirrors it so the identity test exercises the same frame path)."""
+    n = len(req.prompt_ids)
+    A = engine.prefix_align
+    p = A * ((n - 1) // A)
+    arrays = None
+    if p > 0:
+        cache = engine.extract_slot_kv(slot, p)
+        arrays = {"k": np.asarray(cache.k)[:, :, :p],
+                  "v": np.asarray(cache.v)[:, :, :p]}
+        if engine.kv_quant:
+            arrays["k_scale"] = np.asarray(cache.k_scale)[:, :, :, :p]
+            arrays["v_scale"] = np.asarray(cache.v_scale)[:, :, :, :p]
+    return encode_kv_handoff(req.id, req.prompt_ids, p, arrays,
+                             kv_quant=engine.kv_quant)
+
+
+PROMPTS = [
+    list(b"hello world prefix!"),            # 19 toks → p=16, 1 dispatch
+    list(b"hi"),                             # 2 toks → p=0 routing-only
+    list(b"a longer prompt that needs chunked prefill")[:30],  # p=24,
+                                             # multi-chunk at chunk=8
+    list(b"hello world prefill"),            # shares aligned prefix w/ #0
+]
+
+
+class TestRoleContracts:
+    def test_bad_role_rejected(self, setup):
+        cfg, params = setup
+        with pytest.raises(EngineError, match="unknown engine role"):
+            make_engine(cfg, params, role="disagg")
+
+    def test_decode_role_requires_prefix_store(self, setup):
+        cfg, params = setup
+        with pytest.raises(EngineError, match="prefix cache"):
+            make_engine(cfg, params, role="decode", cache_mb=0)
+
+    def test_prefill_role_requires_chunk(self, setup):
+        cfg, params = setup
+        with pytest.raises(EngineError, match="prefill_chunk"):
+            make_engine(cfg, params, role="prefill", chunk=None)
+
+    def test_prefill_scheduler_requires_sink(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params, role="prefill")
+        with pytest.raises(ValueError, match="handoff sink"):
+            Scheduler(engine)
+
+    def test_adoption_rejects_mismatches(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params, role="decode")
+        good = gqa_arrays(L=cfg.num_layers, K=cfg.num_kv_heads,
+                          D=cfg.dim_per_head, p=16)
+        # wrong layer count
+        h = decode_kv_handoff(encode_kv_handoff(
+            "x", list(range(20)), 16,
+            gqa_arrays(L=cfg.num_layers + 1, K=cfg.num_kv_heads,
+                       D=cfg.dim_per_head, p=16)))
+        with pytest.raises(EngineError, match="shape"):
+            engine.adopt_prefix(h)
+        # wrong dtype (engine cache is f32 here)
+        h = decode_kv_handoff(encode_kv_handoff(
+            "x", list(range(20)), 16,
+            {k: v.astype(np.float16) for k, v in good.items()}))
+        with pytest.raises(EngineError, match="dtype"):
+            engine.adopt_prefix(h)
+        # quantization mismatch
+        qarr = {"k": np.zeros((cfg.num_layers, 1, 16, cfg.num_kv_heads,
+                               cfg.dim_per_head), np.int8),
+                "v": np.zeros((cfg.num_layers, 1, 16, cfg.num_kv_heads,
+                               cfg.dim_per_head), np.int8),
+                "k_scale": np.zeros((cfg.num_layers, 1, cfg.num_kv_heads,
+                                     16), np.float32),
+                "v_scale": np.zeros((cfg.num_layers, 1, cfg.num_kv_heads,
+                                     16), np.float32)}
+        h = decode_kv_handoff(encode_kv_handoff(
+            "x", list(range(20)), 16, qarr, kv_quant=True))
+        with pytest.raises(EngineError, match="quantization"):
+            engine.adopt_prefix(h)
+        # misaligned prefix length (align is 8 here)
+        mis = gqa_arrays(L=cfg.num_layers, K=cfg.num_kv_heads,
+                         D=cfg.dim_per_head, p=12)
+        h = decode_kv_handoff(encode_kv_handoff(
+            "x", list(range(20)), 12, mis))
+        with pytest.raises(EngineError, match="aligned"):
+            engine.adopt_prefix(h)
+        # control: a well-formed frame adopts
+        h = decode_kv_handoff(encode_kv_handoff(
+            "x", list(range(20)), 16, good))
+        assert engine.adopt_prefix(h) is True
+        assert engine.adopt_prefix(h) is True  # idempotent (has())
+
+
+class TestDisaggIdentity:
+    """THE acceptance contract: greedy disagg == greedy unified."""
+
+    @pytest.fixture(scope="class")
+    def reference(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params, role="unified", cache_mb=0)
+        engine.warmup()
+        sched = Scheduler(engine)
+        sched.start()
+        try:
+            return drive(sched, PROMPTS)
+        finally:
+            sched.stop()
+
+    def test_greedy_token_identical_and_per_role_stats(self, setup,
+                                                       reference):
+        cfg, params = setup
+        eng_p = make_engine(cfg, params, role="prefill")
+        eng_p.warmup()
+        eng_d = make_engine(cfg, params, role="decode")
+        eng_d.warmup()
+
+        frames: dict[str, bytes] = {}
+        fallback_events = []
+
+        def handoff(slot, req, first):
+            frames[req.id] = host_style_handoff(eng_p, slot, req)
+
+        sched_p = Scheduler(eng_p, handoff=handoff)
+        sched_p.start()
+        sched_d = Scheduler(eng_d)
+        sched_d.start()
+        try:
+            # Tier 1: prefill-role admission builds KV and hands off.
+            for i, ids in enumerate(PROMPTS):
+                sched_p.submit(GenRequest(
+                    prompt_ids=list(ids), sampling=SamplingParams(),
+                    max_new_tokens=6,
+                    emit=lambda ev: fallback_events.append(ev),
+                    id=f"r{i}"))
+            deadline = time.monotonic() + 120
+            while len(frames) < len(PROMPTS):
+                assert time.monotonic() < deadline, \
+                    f"handoffs incomplete: {sorted(frames)}; " \
+                    f"events={fallback_events}"
+                time.sleep(0.02)
+            ps = sched_p.stats()
+            assert ps["role"] == "prefill"
+            assert ps["handoffs"] == len(PROMPTS)
+            assert ps["handoff_s"] > 0
+            # prefill tier never decodes: zero blocks, zero tokens
+            assert ps["block_syncs"] == 0 and ps["tokens"] == 0
+            # no token events ever left the prefill tier
+            assert not fallback_events
+
+            # Tier 2: adopt every frame, then run the SAME prompts.
+            for i in range(len(PROMPTS)):
+                h = decode_kv_handoff(frames[f"r{i}"])
+                if h.p:
+                    assert eng_d.adopt_prefix(h)
+            got = drive(sched_d, PROMPTS)
+            assert [g[0] for g in got] == [r[0] for r in reference], \
+                "greedy disagg text diverged from unified"
+            assert [g[1] for g in got] == [r[1] for r in reference]
+
+            ds = sched_d.stats()
+            assert ds["role"] == "decode"
+            # Satellite contract: a decode-role host books adoption
+            # dispatches, NOT unified-mode admission prefill — the only
+            # admit dispatch allowed is the p=0 routing-only prompt's
+            # full prefill (which IS admission work, on any tier).
+            assert ds["adopt_dispatches"] >= 2  # p=16 unit + p=24 seed
+            assert ds["admit_dispatches"] == 1  # the routing-only prompt
+            assert ds["adopt_s"] > 0
+            assert "adopt_dispatch_s" in ds
+        finally:
+            sched_p.stop()
+            sched_d.stop()
+
+    def test_budget_rejected_adoption_still_token_identical(self, setup,
+                                                            reference):
+        """A decode tier whose store cannot hold the entry falls back to
+        a full prefill — slower, but the stream must be byte-identical."""
+        cfg, params = setup
+        eng_d = make_engine(cfg, params, role="decode", cache_mb=1e-4)
+        # Decode-role construction raises an undersized budget to the
+        # geometry floor (2 × largest-bucket entry bytes) — a default
+        # too small for the model must never silently reject EVERY
+        # adoption.
+        assert eng_d.prefix_store.budget_bytes >= \
+            2 * 32 * eng_d.kv_bytes_per_token()
+        # Simulate a store with no headroom (everything pinned/full):
+        # insert() rejects, lookup misses, admission runs the ordinary
+        # full-prefill path.
+        eng_d.prefix_store.budget_bytes = 64
+        eng_d.warmup()
+        h = decode_kv_handoff(encode_kv_handoff(
+            "r0", PROMPTS[0], 16,
+            gqa_arrays(L=cfg.num_layers, K=cfg.num_kv_heads,
+                       D=cfg.dim_per_head, p=16)))
+        # NOTE: arrays here are random, NOT the true prefix KV — the
+        # rejection path must not adopt them, which the identity check
+        # below proves (adopted garbage would change the text).
+        assert eng_d.adopt_prefix(h) is False
+        sched = Scheduler(eng_d)
+        sched.start()
+        try:
+            got = drive(sched, [PROMPTS[0]])
+            assert got[0][0] == reference[0][0]
+        finally:
+            sched.stop()
+
+
+# ---------------------------------------------------------------------
+# Process-level identity: the same contract through REAL engine hosts
+# (unified single host vs disagg pair), greedy, over the host pipes.
+
+
+@pytest.mark.slow
+class TestBackendDisaggIdentity:
+    @staticmethod
+    def _cfg(role):
+        from symmetry_tpu.provider.config import ConfigManager
+
+        return ConfigManager(config={
+            "name": "disagg-id", "public": False, "serverKey": "00" * 32,
+            "modelName": "tiny:test", "apiProvider": "tpu_native",
+            "dataCollectionEnabled": False,
+            "tpu": {"model_preset": "tiny", "dtype": "float32",
+                    "max_batch_size": 4, "max_seq_len": 128,
+                    "prefill_buckets": [32, 64], "prefill_chunk": 16,
+                    "engine_isolation": "process", "role": role},
+        })
+
+    def test_process_mode_greedy_identity(self):
+        import asyncio
+
+        from symmetry_tpu.provider.backends.base import InferenceRequest
+        from symmetry_tpu.provider.backends.tpu_native import (
+            TpuNativeBackend)
+
+        contents = ["tell me about disagg serving",  # multi-chunk prefix
+                    "hi"]  # minimal prompt (template still spans align)
+
+        async def collect_all(role):
+            backend = TpuNativeBackend(self._cfg(role))
+            await backend.start()
+            try:
+                out = []
+                for content in contents:
+                    text = []
+                    async for chunk in backend.stream(InferenceRequest(
+                            messages=[{"role": "user",
+                                       "content": content}],
+                            max_tokens=8, temperature=0.0)):
+                        if chunk.text:
+                            text.append(chunk.text)
+                    out.append("".join(text))
+                stats = await backend.engine_stats()
+                return out, stats
+            finally:
+                await backend.stop()
+
+        def run(coro):
+            return asyncio.new_event_loop().run_until_complete(
+                asyncio.wait_for(coro, 600))
+
+        unified, _ = run(collect_all("unified"))
+        disagg, stats = run(collect_all("disagg"))
+        assert disagg == unified, \
+            "greedy disagg diverged from unified through real host pipes"
+        dg = stats.get("disagg") or {}
+        assert dg.get("handoff_frames") == 2
+        # The chat template alone spans the 16-token alignment, so even
+        # "hi" ships real KV (routing-only is covered at the host layer
+        # in TestHostWireOps).
+        assert dg.get("routing_only") == 0
+        assert dg.get("handoff_bytes", 0) > 0
+        assert (dg.get("prefill_host") or {}).get("role") == "prefill"
+
+
+# ---------------------------------------------------------------------
+# Host wire ops (no subprocess: EngineHost methods against stub engines)
+
+
+class _StubPrefillEngine:
+    prefix_align = 8
+    kv_quant = False
+
+    def __init__(self, cfg, params):
+        self._real = None  # unused; extract served from canned arrays
+        self.calls = []
+
+    def kv_bytes_per_token(self):
+        return 2 * 2 * 2 * 4 * 4  # 2 planes × L2 × K2 × D4 × f32
+
+    def extract_slot_kv(self, slot, p):
+        import jax.numpy as jnp
+
+        from symmetry_tpu.models.llama import KVCache
+
+        self.calls.append((slot, p))
+        return KVCache(k=jnp.zeros((2, 1, 32, 2, 4), jnp.float32),
+                       v=jnp.zeros((2, 1, 32, 2, 4), jnp.float32),
+                       lengths=jnp.full((1,), p, jnp.int32))
+
+
+class TestHostWireOps:
+    def _host(self, role):
+        from symmetry_tpu.engine.host import EngineHost
+
+        host = EngineHost(config=None)
+        host._role = role
+        return host
+
+    def test_handoff_sink_emits_frame(self, setup, capsys):
+        host = self._host("prefill")
+        host._engine = _StubPrefillEngine(*setup)
+        req = GenRequest(prompt_ids=list(range(20)),
+                         sampling=SamplingParams(), max_new_tokens=4,
+                         emit=lambda ev: None, id="h1")
+        host._reported["h1"] = 0
+        host._handoff_sink(2, req, 99)
+        line = json.loads(capsys.readouterr().out.strip())
+        assert line["op"] == "handoff" and line["id"] == "h1"
+        assert line["p"] == 16 and line["prompt_len"] == 20
+        import base64
+
+        h = decode_kv_handoff(base64.b64decode(line["frame"]))
+        assert h.p == 16 and h.arrays["k"].shape == (2, 1, 16, 2, 4)
+        assert line["nbytes"] == len(base64.b64decode(line["frame"]))
+        assert host.handoff_stats["frames"] == 1
+        assert host.handoff_stats["prefix_tokens"] == 16
+        assert "h1" not in host._reported  # ownership moved tiers
+        assert host._engine.calls == [(2, 16)]
+
+    def test_routing_only_fast_path_no_extract(self, setup, capsys):
+        host = self._host("prefill")
+        host._engine = _StubPrefillEngine(*setup)
+        host._emit_handoff("h2", [1, 2, 3], 0, None)
+        line = json.loads(capsys.readouterr().out.strip())
+        assert line["p"] == 0
+        assert host._engine.calls == []  # no device work for p=0
+        assert host.handoff_stats["routing_only"] == 1
+
+    def _submitting_host(self):
+        host = self._host("decode")
+        submits = []
+        host._scheduler = type("S", (), {
+            "submit": lambda self, req: submits.append(req)})()
+        return host, submits
+
+    def test_adopt_defers_frame_work_to_engine_thread_thunk(self, capsys):
+        """The adopt op submits WITHOUT parsing the frame (the serial
+        command loop must never pay for a multi-hundred-MB decode); the
+        thunk — run by the scheduler on the engine thread — parses,
+        fills prompt_ids, and adopts."""
+        import base64
+
+        host, submits = self._submitting_host()
+        adopted = []
+        host._engine = type("E", (), {
+            "adopt_prefix": lambda self, h: adopted.append(h.p) or True})()
+        tokens = list(range(20))
+        frame = encode_kv_handoff("r8", tokens, 16, gqa_arrays())
+        host._handle_adopt({"op": "adopt", "id": "r8",
+                            "frame": base64.b64encode(frame).decode(),
+                            "max_new": 4})
+        assert len(submits) == 1
+        req = submits[0]
+        assert req.prompt_ids == []  # frame not parsed yet
+        assert host.adopt_stats["frames"] == 0
+        req.adopt(req)
+        assert req.prompt_ids == tokens  # thunk filled it
+        assert adopted == [16]
+        assert host.adopt_stats["frames"] == 1
+        assert host.adopt_stats["adopted"] == 1
+        assert host.adopt_stats["bytes"] == len(frame)
+
+    def test_adopt_corrupt_frame_fails_in_thunk(self, capsys):
+        import base64
+
+        host, submits = self._submitting_host()
+        bad = bytearray(encode_kv_handoff("r9", list(range(20)), 16,
+                                          gqa_arrays()))
+        bad[60] ^= 0xFF
+        host._handle_adopt({"op": "adopt", "id": "r9",
+                            "frame": base64.b64encode(bytes(bad)).decode(),
+                            "max_new": 4})
+        assert len(submits) == 1
+        with pytest.raises(RuntimeError, match="adoption failed"):
+            submits[0].adopt(submits[0])
+        assert host.adopt_stats["errors"] == 1
+        assert host.adopt_stats["frames"] == 0  # nothing adopted
+
+    def test_adopt_id_mismatch_fails_in_thunk(self):
+        import base64
+
+        host, submits = self._submitting_host()
+        frame = encode_kv_handoff("other", [1, 2, 3], 0, None)
+        host._handle_adopt({"op": "adopt", "id": "mine",
+                            "frame": base64.b64encode(frame).decode()})
+        with pytest.raises(RuntimeError, match="adoption failed"):
+            submits[0].adopt(submits[0])
+        assert host.adopt_stats["errors"] == 1
+
+    def test_adopt_missing_frame_is_immediate_error_event(self, capsys):
+        host, submits = self._submitting_host()
+        host._handle_adopt({"op": "adopt", "id": "r10", "max_new": 4})
+        line = json.loads(capsys.readouterr().out.strip())
+        assert line["finish_reason"] == "error"
+        assert "no frame" in line["error"]
+        assert submits == []
+        assert host.adopt_stats["errors"] == 1

@@ -1,0 +1,277 @@
+"""CI disagg smoke: two-host prefill→decode handoff, then a prefill
+crash that completes via the restarting-shed/failover path.
+
+The provider's tpu_native backend runs in `tpu.role: disagg` — a REAL
+prefill engine host and a REAL decode engine host (tiny CPU preset, own
+OS processes, JSON-lines pipes) with versioned KV handoff frames between
+them — and the smoke asserts:
+
+  phase 1 (happy path): a streamed request completes; the engine stats
+  carry the handoff ledger (frames/bytes > 0, the decode host reporting
+  role "decode" with ZERO admission-prefill dispatches, the prefill
+  host nested with role "prefill" and its serialize counters) — the
+  host stats → provider stats contract of the acceptance criteria,
+  end to end through real pipes.
+
+  phase 2 (fault injection): `disagg.handoff=crash@nth=2` is armed in
+  the PREFILL tier only (per-tier faults via tpu.disagg.prefill.faults)
+  — the second request's handoff kills the prefill host mid-request,
+  with the prompt's KV built but unshipped. The in-flight stream must
+  get the retryable restarting shed, the supervisor must respawn the
+  PAIR (exactly one restart, circuit breaker closed), and a retry must
+  complete on the new pair. (nth=2 counts per host LIFE: life 1 serves
+  request 1 then dies on request 2's handoff; life 2 serves the retry —
+  its first handoff — untouched.)
+
+Two modes, same contracts:
+  - full path (default): client → server → provider over the in-memory
+    transport, recovery via client failover (ChatRestart sentinel);
+  - backend-direct (fallback when the `cryptography` network dependency
+    is absent): TpuNativeBackend driven directly, recovery via the
+    BackendRestartingError retry loop the provider/client implement.
+
+Exit 0 on success; exit 1 with a reason otherwise.
+
+Run: python tools/disagg_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+# CPU pinning + shared compile cache BEFORE any jax import (the engine
+# hosts inherit this environment; the cache makes the post-crash respawn
+# a warm start, which is also what keeps this smoke affordable).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/symmetry-tpu-disagg-smoke-cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Sized to fit the 64 bucket with the byte-tokenizer chat template
+# (~19 ids) while spanning >= 2 alignment boundaries (align 16), so the
+# handoff carries real KV and the decode tier admits through adoption.
+PROMPT = "tell me about disagg serving"
+
+
+def provider_config_dict() -> dict:
+    return {
+        "name": "disagg-smoke-prov", "public": True,
+        "serverKey": "00" * 32,
+        "modelName": "tiny:disagg", "apiProvider": "tpu_native",
+        "dataCollectionEnabled": False,
+        "flightRecorder": {"enabled": False},
+        "tpu": {
+            "model_preset": "tiny", "dtype": "float32",
+            "max_batch_size": 4, "max_seq_len": 128,
+            "prefill_buckets": [32, 64], "prefill_chunk": 16,
+            "role": "disagg",
+            "supervisor": {"heartbeat_s": 2.0, "wedge_timeout_s": 5.0,
+                           "backoff_base_s": 0.2, "backoff_max_s": 1.0,
+                           "max_respawns": 3, "spawn_timeout_s": 300.0,
+                           "stop_grace_s": 5.0, "min_stable_s": 0.5},
+            # Per-tier fault: the PREFILL host's second handoff crashes
+            # it (phase 2); the decode host is never armed.
+            "disagg": {"prefill": {
+                "faults": {"disagg.handoff": "crash@nth=2"}}},
+        },
+    }
+
+
+def assert_phase1_stats(stats: dict) -> dict:
+    assert stats.get("role") == "decode", \
+        f"decode host role wrong: {stats.get('role')}"
+    # Decode tier books ADOPTION, not admission prefill: the prompt is
+    # long enough for an aligned prefix, so zero admit dispatches.
+    assert stats.get("admit_dispatches") == 0, \
+        f"decode host inherited unified admission accounting: " \
+        f"{stats.get('admit_dispatches')} admit dispatches"
+    assert stats.get("adopt_dispatches", 0) >= 1, "no adoption dispatch"
+    dg = stats.get("disagg") or {}
+    assert dg.get("handoff_frames", 0) >= 1, f"no handoff counted: {dg}"
+    assert dg.get("handoff_bytes", 0) > 0
+    assert (dg.get("prefill_tier_s") or {}).get("count", 0) >= 1
+    ph = dg.get("prefill_host") or {}
+    assert ph.get("role") == "prefill", f"prefill host stats: {ph}"
+    assert (ph.get("handoff") or {}).get("frames", 0) >= 1
+    assert ph.get("handoffs", 0) >= 1  # scheduler-side counter
+    # Prefill work lives HERE (this prompt spans > 1 chunk, so it lands
+    # as chunk dispatches; short prompts would land as admit dispatches)
+    assert (ph.get("admit_dispatches", 0)
+            + ph.get("chunk_dispatches", 0)) >= 1
+    return dg
+
+
+async def run_backend_direct() -> int:
+    """The two-host contract without the network layer (used when the
+    `cryptography` dependency for the wire path is unavailable)."""
+    from symmetry_tpu.provider.backends.base import (
+        BackendRestartingError, InferenceRequest)
+    from symmetry_tpu.provider.backends.tpu_native import TpuNativeBackend
+    from symmetry_tpu.provider.config import ConfigManager
+
+    async def collect(backend, content):
+        text = []
+        async for chunk in backend.stream(InferenceRequest(
+                messages=[{"role": "user", "content": content}],
+                max_tokens=8, temperature=0.0)):
+            if chunk.text:
+                text.append(chunk.text)
+        return "".join(text)
+
+    backend = TpuNativeBackend(ConfigManager(
+        config=provider_config_dict()))
+    restarts_seen = []
+    try:
+        await backend.start()
+        backend.on_host_restart = restarts_seen.append
+
+        # phase 1: happy-path handoff
+        text1 = await collect(backend, PROMPT)
+        assert text1, "phase 1 streamed no text"
+        dg = assert_phase1_stats(await backend.engine_stats())
+        print(f"disagg smoke: phase 1 streamed {len(text1)} chars; "
+              f"{dg['handoff_frames']} handoff frame(s), "
+              f"{dg['handoff_bytes']} bytes, prefill-tier p50 "
+              f"{(dg.get('prefill_tier_s') or {}).get('p50')}s")
+
+        # phase 2: prefill-host crash mid-request → restarting shed →
+        # respawned pair serves the retry
+        shed = False
+        try:
+            await collect(backend, PROMPT + " again?")
+        except BackendRestartingError as exc:
+            shed = True
+            assert exc.retry_after_s is not None
+        assert shed, "prefill crash did not shed as restarting"
+        # The respawn (and its flight-recorder hook) runs async in the
+        # supervisor — give it a beat before asserting on the hook.
+        for _ in range(100):
+            if restarts_seen:
+                break
+            await asyncio.sleep(0.1)
+        assert restarts_seen == ["crash"], f"hook saw {restarts_seen}"
+        text2 = None
+        for _ in range(200):  # retry through the respawn window
+            try:
+                text2 = await collect(backend, PROMPT + " again?")
+                break
+            except BackendRestartingError:
+                await asyncio.sleep(0.25)
+        assert text2, "retry never completed on the respawned pair"
+        stats2 = await backend.engine_stats()
+        sup = stats2.get("supervisor") or {}
+        assert sup.get("restarts", 0) >= 1, f"no restart recorded: {sup}"
+        assert not sup.get("circuit_open"), "circuit breaker tripped"
+        assert await backend.healthy()
+        print(f"disagg smoke: phase 2 crash → restarting shed → retry "
+              f"completed {len(text2)} chars on the respawned pair "
+              f"(supervisor restarts={sup.get('restarts')})")
+    finally:
+        await backend.stop()
+    return 0
+
+
+async def run_network() -> int:
+    """The full path: client → server → provider on the in-memory
+    transport, recovery via client failover."""
+    from symmetry_tpu.client.client import ChatRestart, SymmetryClient
+    from symmetry_tpu.identity import Identity
+    from symmetry_tpu.provider.config import ConfigManager
+    from symmetry_tpu.provider.provider import SymmetryProvider
+    from symmetry_tpu.server.broker import SymmetryServer
+    from symmetry_tpu.transport.memory import MemoryTransport
+
+    hub = MemoryTransport()
+    server_ident = Identity.from_name("disagg-smoke-server")
+    server = SymmetryServer(server_ident, hub, ping_interval_s=30.0)
+    await server.start("mem://server")
+
+    cfg_dict = provider_config_dict()
+    cfg_dict["serverKey"] = server_ident.public_hex
+    provider = SymmetryProvider(
+        ConfigManager(config=cfg_dict), transport=hub,
+        identity=Identity.from_name("disagg-smoke-p"),
+        server_address="mem://server")
+    await provider.start("mem://disagg-smoke-p")
+    await provider.wait_registered()
+
+    client = SymmetryClient(Identity.from_name("disagg-smoke-cli"), hub)
+
+    # phase 1: happy-path handoff through the wire
+    deltas = []
+    async for item in client.chat_failover(
+            "mem://server", server_ident.public_key, "tiny:disagg",
+            [{"role": "user", "content": PROMPT}], max_tokens=8,
+            temperature=0.0):
+        deltas.append(item)
+    assert not any(isinstance(d, ChatRestart) for d in deltas), \
+        "phase 1 must not restart"
+    text1 = "".join(d for d in deltas if isinstance(d, str))
+    assert text1, "phase 1 streamed no text"
+    dg = assert_phase1_stats(await provider.backend.engine_stats())
+    print(f"disagg smoke: phase 1 streamed {len(text1)} chars over the "
+          f"wire; {dg['handoff_frames']} handoff frame(s), "
+          f"{dg['handoff_bytes']} bytes, prefill-tier p50 "
+          f"{(dg.get('prefill_tier_s') or {}).get('p50')}s")
+
+    # phase 2: prefill-host crash mid-request → restarting shed →
+    # client failover retry completes on the respawned pair
+    restarts_seen = []
+    provider.backend.on_host_restart = restarts_seen.append
+    events = []
+    async for item in client.chat_failover(
+            "mem://server", server_ident.public_key, "tiny:disagg",
+            [{"role": "user", "content": PROMPT + " again?"}],
+            max_tokens=8, temperature=0.0, busy_retry_rounds=8):
+        events.append(item)
+    restarts = [e for e in events if isinstance(e, ChatRestart)]
+    assert restarts, "prefill crash produced no failover restart"
+    cut = events.index(restarts[-1])
+    text2 = "".join(e for e in events[cut + 1:] if isinstance(e, str))
+    assert text2, "no text after failover — request never completed"
+    assert restarts_seen and restarts_seen[0] == "crash", \
+        f"supervisor saw {restarts_seen}, expected a crash"
+
+    for _ in range(100):  # let the supervisor bookkeeping settle
+        if provider.backend._restarts >= 1 \
+                and not provider.backend._restarting:
+            break
+        await asyncio.sleep(0.1)
+    stats2 = await provider.backend.engine_stats()
+    sup = stats2.get("supervisor") or {}
+    assert sup.get("restarts", 0) >= 1, f"no restart recorded: {sup}"
+    assert not sup.get("circuit_open"), "circuit breaker tripped"
+    print(f"disagg smoke: phase 2 crash → restarting shed → "
+          f"{len(restarts)} failover restart(s) → completed "
+          f"{len(text2)} chars on the respawned pair "
+          f"(supervisor restarts={sup.get('restarts')})")
+
+    await provider.stop(drain_timeout_s=2)
+    await server.stop()
+    return 0
+
+
+def main() -> int:
+    try:
+        import cryptography  # noqa: F401 — wire-path dependency probe
+
+        runner = run_network()
+    except ImportError:
+        print("disagg smoke: cryptography unavailable — running the "
+              "backend-direct mode (same two-host contracts, no wire)",
+              file=sys.stderr)
+        runner = run_backend_direct()
+    try:
+        return asyncio.new_event_loop().run_until_complete(
+            asyncio.wait_for(runner, 900))
+    except AssertionError as exc:
+        print(f"disagg smoke FAILED: {exc}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
