@@ -1,0 +1,50 @@
+"""symlint: project-invariant static analysis (see tools/symlint.py).
+
+Four AST checkers over the repo, each making one runtime invariant
+statically checkable:
+
+  wire-contract     host-pipe op / MessageKey producer↔consumer sets
+  concurrency       cross-thread mutation locks; blocking-in-async
+  recompile-hazard  value syncs / data branches inside jit traces
+  fault-seam        SYMMETRY_FAULTS arming ↔ FAULTS.point guards
+
+Run via `python tools/symlint.py` (text or --json, --baseline
+suppression file, exit 1 on non-baselined findings). The suite is also
+importable — `run(root)` — which is how tests/test_analysis.py asserts
+the repo itself stays clean.
+"""
+
+from __future__ import annotations
+
+from symmetry_tpu.analysis import (
+    concurrency,
+    fault_seams,
+    recompile,
+    wire_contract,
+)
+from symmetry_tpu.analysis.core import (
+    Baseline,
+    CheckerSpec,
+    Finding,
+    Project,
+    run_suite,
+)
+
+ALL_CHECKERS: tuple[CheckerSpec, ...] = (
+    wire_contract.SPEC,
+    concurrency.SPEC,
+    recompile.SPEC,
+    fault_seams.SPEC,
+)
+
+
+def run(root: str, checkers: tuple[CheckerSpec, ...] = ALL_CHECKERS,
+        baseline: Baseline | None = None,
+        rels: list[str] | None = None) -> list[Finding]:
+    """Scan `root` (or just `rels` under it) with the given checkers."""
+    project = Project.scan(root, rels)
+    return run_suite(project, checkers, baseline)
+
+
+__all__ = ["ALL_CHECKERS", "Baseline", "CheckerSpec", "Finding",
+           "Project", "run", "run_suite"]

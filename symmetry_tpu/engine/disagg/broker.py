@@ -28,6 +28,7 @@ import copy
 import time
 from typing import Any
 
+from symmetry_tpu.protocol.keys import HostOp
 from symmetry_tpu.utils.trace import Histogram
 
 # The decode tier adopts handoff frames through its prefix store; a
@@ -134,7 +135,7 @@ class HandoffBroker:
         self.counters["prefix_tokens"] += p
         if p == 0:
             self.counters["routing_only"] += 1
-        op: dict[str, Any] = {"op": "adopt", "id": req_id,
+        op: dict[str, Any] = {"op": HostOp.ADOPT, "id": req_id,
                               "frame": handoff.get("frame")}
         for k in ("max_new", "sampling", "speculative", "trace"):
             if k in keep:

@@ -90,6 +90,7 @@ from typing import TYPE_CHECKING, Any
 
 from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
 from symmetry_tpu.engine.scheduler import GenRequest, Scheduler
+from symmetry_tpu.protocol.keys import HostOp
 from symmetry_tpu.provider.config import ConfigManager
 from symmetry_tpu.utils.faults import FAULTS
 from symmetry_tpu.utils.logging import logger
@@ -215,9 +216,9 @@ class EngineHost:
         `event` frame (wire-compatible with pre-batching readers)."""
         events = [self._event_dict(req.id, ev) for req, ev in batch]
         if len(events) == 1:
-            self._write({"op": "event", **events[0]}, events=1)
+            self._write({"op": HostOp.EVENT, **events[0]}, events=1)
         else:
-            self._write({"op": "events", "events": events},
+            self._write({"op": HostOp.EVENTS, "events": events},
                         events=len(events))
 
     # ------------------------------------------------------------- lifecycle
@@ -260,7 +261,7 @@ class EngineHost:
         self.tracer.enabled = tracing
         self._scheduler.tracer.enabled = tracing
         self._scheduler.start()
-        self._write({"op": "ready",
+        self._write({"op": HostOp.READY,
                      "model": self._config.model_name,
                      "role": self._role,
                      "slots": self._engine.max_slots,
@@ -288,23 +289,23 @@ class EngineHost:
                 logger.warning(f"host: bad command line {line[:80]!r}")
                 continue
             op = msg.get("op")
-            if op == "submit":
+            if op == HostOp.SUBMIT:
                 self._submit(msg)
-            elif op == "adopt":
+            elif op == HostOp.ADOPT:
                 self._handle_adopt(msg)
-            elif op == "cancel":
+            elif op == HostOp.CANCEL:
                 req_id = str(msg.get("id", ""))
                 if req_id in self._reported:  # only live requests; a late
                     self._cancelled.add(req_id)  # cancel must not leak ids
-            elif op == "clock":
+            elif op == HostOp.CLOCK:
                 self._handle_clock(msg)
-            elif op == "trace":
+            elif op == HostOp.TRACE:
                 self._handle_trace()
-            elif op == "stats":
+            elif op == HostOp.STATS:
                 stats = getattr(self._scheduler, "stats", None)
                 m = stats() if stats is not None else dict(
                     self._scheduler.metrics)
-                m["op"] = "stats"
+                m["op"] = HostOp.STATS
                 # liveness of the engine thread — the wedged-decode-loop
                 # signal the provider's health loop needs (SURVEY §5.3)
                 thread = self._scheduler._thread
@@ -331,7 +332,7 @@ class EngineHost:
                     # injection actually happened.
                     m["faults"] = FAULTS.counters()
                 self._write(m)
-            elif op == "shutdown":
+            elif op == HostOp.SHUTDOWN:
                 break
         self._scheduler.stop()
         if getattr(self, "_command_loop", None) is not None:
@@ -344,7 +345,7 @@ class EngineHost:
         own stamps and takes the min-RTT NTP midpoint — the measured
         offset the per-stage TTFT attribution applies instead of clamping
         negative cross-process spans to zero."""
-        self._write({"op": "clock", "t0": msg.get("t0"),
+        self._write({"op": HostOp.CLOCK, "t0": msg.get("t0"),
                      "t": time.monotonic()})
 
     def _handle_trace(self) -> None:
@@ -355,7 +356,7 @@ class EngineHost:
         trace_export = getattr(self._scheduler, "trace_export", None)
         if trace_export is not None:
             comps.append(trace_export())
-        self._write({"op": "trace", "clock": time.monotonic(),
+        self._write({"op": HostOp.TRACE, "clock": time.monotonic(),
                      "components": comps})
 
     # --------------------------------------------------------------- submit
@@ -375,7 +376,7 @@ class EngineHost:
             prompt_ids = self._engine.tokenizer.apply_chat_template(
                 msg.get("messages") or [])
         except Exception as exc:  # noqa: BLE001 — tokenizer failure → event
-            self._write({"op": "event", "id": req_id, "text": "",
+            self._write({"op": HostOp.EVENT, "id": req_id, "text": "",
                          "done": True, "finish_reason": "error",
                          "error": f"tokenization failed: {exc}"}, events=1)
             return
@@ -394,7 +395,7 @@ class EngineHost:
         def emit(ev, req_id=req_id) -> None:
             # Fallback path only: the scheduler delivers through the
             # emit_batch sink; this fires if batching is ever disabled.
-            self._write({"op": "event", **self._event_dict(req_id, ev)},
+            self._write({"op": HostOp.EVENT, **self._event_dict(req_id, ev)},
                         events=1)
 
         spec = msg.get("speculative")
@@ -472,19 +473,24 @@ class EngineHost:
 
         b64 = base64.b64encode(frame).decode("ascii")
         dt = time.monotonic() - t0
-        self.handoff_stats["frames"] += 1
-        self.handoff_stats["bytes"] += len(frame)
-        self.handoff_stats["prefix_tokens"] += p
-        if p == 0:
-            self.handoff_stats["routing_only"] += 1
-        self.handoff_stats["serialize_s"] += dt
+        # Under _wlock: this method runs on the ENGINE thread via the
+        # scheduler's handoff sink AND on the pipe-reader thread via the
+        # short-prompt fast path in _submit — unlocked `dict[k] += 1`
+        # from two threads loses updates (symlint C202).
+        with self._wlock:
+            self.handoff_stats["frames"] += 1
+            self.handoff_stats["bytes"] += len(frame)
+            self.handoff_stats["prefix_tokens"] += p
+            if p == 0:
+                self.handoff_stats["routing_only"] += 1
+            self.handoff_stats["serialize_s"] += dt
         # This host's bookkeeping for the request ends here: token
         # events (and any cancel) now belong to the decode tier.
         self._reported.pop(req_id, None)
         self._cancelled.discard(req_id)
         self.tracer.record("handoff_emit", t0, dt, request_id=req_id,
                            p=p, bytes=len(frame))
-        self._write({"op": "handoff", "id": req_id, "p": p,
+        self._write({"op": HostOp.HANDOFF, "id": req_id, "p": p,
                      "prompt_len": len(prompt_ids),
                      "nbytes": len(frame), "frame": b64})
 
@@ -508,8 +514,12 @@ class EngineHost:
         req_id = str(msg.get("id", ""))
         frame_b64 = msg.get("frame")
         if not isinstance(frame_b64, str) or not frame_b64:
-            self.adopt_stats["errors"] += 1
-            self._write({"op": "event", "id": req_id, "text": "",
+            # adopt_stats is written from this pipe-reader thread AND
+            # from the adopt thunk on the engine thread; every mutation
+            # holds _wlock (symlint C202).
+            with self._wlock:
+                self.adopt_stats["errors"] += 1
+            self._write({"op": HostOp.EVENT, "id": req_id, "text": "",
                          "done": True, "finish_reason": "error",
                          "error": "handoff adoption failed: adopt op "
                                   "carries no frame"}, events=1)
@@ -532,19 +542,21 @@ class EngineHost:
                 ok = (self._engine.adopt_prefix(handoff)
                       if handoff.p else False)
             except Exception as exc:  # noqa: BLE001 — fail one request
-                self.adopt_stats["errors"] += 1
+                with self._wlock:
+                    self.adopt_stats["errors"] += 1
                 raise RuntimeError(
                     f"handoff adoption failed: {exc}") from exc
-            self.adopt_stats["frames"] += 1
-            self.adopt_stats["bytes"] += len(raw)
-            self.adopt_stats["deserialize_s"] += time.monotonic() - t0
-            if handoff.p:
-                if ok:
-                    self.adopt_stats["adopted"] += 1
-                else:
-                    # Store rejected (budget): full prefill fallback —
-                    # slower but still token-identical for greedy.
-                    self.adopt_stats["rejected"] += 1
+            with self._wlock:
+                self.adopt_stats["frames"] += 1
+                self.adopt_stats["bytes"] += len(raw)
+                self.adopt_stats["deserialize_s"] += time.monotonic() - t0
+                if handoff.p:
+                    if ok:
+                        self.adopt_stats["adopted"] += 1
+                    else:
+                        # Store rejected (budget): full prefill fallback
+                        # — slower but still token-identical for greedy.
+                        self.adopt_stats["rejected"] += 1
 
         s = msg.get("sampling") or {}
         sampling = SamplingParams(
@@ -556,7 +568,7 @@ class EngineHost:
         self._reported[req_id] = 0
 
         def emit(ev, req_id=req_id) -> None:
-            self._write({"op": "event", **self._event_dict(req_id, ev)},
+            self._write({"op": HostOp.EVENT, **self._event_dict(req_id, ev)},
                         events=1)
 
         spec = msg.get("speculative")

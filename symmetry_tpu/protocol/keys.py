@@ -57,6 +57,40 @@ class MessageKey:
     RELAY_CLOSE = "relayClose"                # either end / server teardown
 
 
+class HostOp:
+    """Engine-host pipe ops — the `{"op": ...}` JSON-lines protocol
+    between the provider backend and its engine-host subprocess(es)
+    (spec: engine/host.py docstring; disagg forwarding:
+    engine/disagg/broker.py).
+
+    One registry on purpose: producers and consumers both import these
+    constants, and the symlint wire-contract checker (tools/symlint.py)
+    fails CI on any raw op literal or any op produced without a
+    consumer — a renamed op used to mean a silently-dropped frame and
+    a hung stream, not an error."""
+
+    # --- commands: provider/broker → host stdin ---
+    SUBMIT = "submit"       # new request (messages, sampling, deadline…)
+    ADOPT = "adopt"         # decode role: adopt a handed-off KV frame
+    CANCEL = "cancel"       # abort one in-flight request by id
+    CLOCK = "clock"         # clock-offset handshake probe (echoed back)
+    TRACE = "trace"         # span-ring snapshot request (echoed back)
+    STATS = "stats"         # scheduler/emit counters probe (echoed back)
+    SHUTDOWN = "shutdown"   # graceful drain + exit
+
+    # --- frames: host stdout → provider ---
+    READY = "ready"         # warmup done, model/slots/geometry attached
+    EVENT = "event"         # one token event (legacy single-event frame)
+    EVENTS = "events"       # batched per-block token events (hot path)
+    HANDOFF = "handoff"     # prefill role: serialized KV prefix frame
+
+
+HOST_OPS = frozenset(
+    v for k, v in vars(HostOp).items()
+    if not k.startswith("_") and isinstance(v, str)
+)
+
+
 SERVER_MESSAGE_KEYS = frozenset(
     v for k, v in vars(MessageKey).items() if not k.startswith("_")
 )

@@ -18,6 +18,7 @@ from typing import Any, AsyncIterator
 
 from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
 from symmetry_tpu.engine.scheduler import AsyncSession, Scheduler
+from symmetry_tpu.protocol.keys import HostOp
 from symmetry_tpu.provider.backends.base import (
     BackendDeadlineError,
     BackendError,
@@ -295,7 +296,7 @@ class TpuNativeBackend(InferenceBackend):
                 continue
             if not isinstance(msg, dict):
                 continue  # stray scalar on stdout (see _read_events)
-            if msg.get("op") == "ready":
+            if msg.get("op") == HostOp.READY:
                 return
 
     async def _spawn_host(self) -> None:
@@ -347,7 +348,7 @@ class TpuNativeBackend(InferenceBackend):
         samples: list[tuple[float, float, float]] = []
         for _ in range(rounds):
             t0 = time.monotonic()
-            await self._host_send({"op": "clock", "t0": t0}, proc=proc)
+            await self._host_send({"op": HostOp.CLOCK, "t0": t0}, proc=proc)
             while True:
                 line = await proc.stdout.readline()
                 if not line:
@@ -359,7 +360,7 @@ class TpuNativeBackend(InferenceBackend):
                     continue
                 if not isinstance(msg, dict):
                     continue  # stray scalar on stdout (see _read_events)
-                if msg.get("op") == "clock" and msg.get("t0") == t0:
+                if msg.get("op") == HostOp.CLOCK and msg.get("t0") == t0:
                     samples.append((t0, float(msg["t"]), time.monotonic()))
                     break
         return clock_handshake_offset(samples)
@@ -383,7 +384,7 @@ class TpuNativeBackend(InferenceBackend):
                 # be failed and no respawn would ever run.
                 continue
             op = msg.get("op")
-            if op == "stats":
+            if op == HostOp.STATS:
                 # stats reply: liveness for the health loop + the full
                 # scheduler breakdown for engine_stats() consumers
                 self._engine_alive = bool(msg.get("engine_alive", True))
@@ -392,13 +393,13 @@ class TpuNativeBackend(InferenceBackend):
                     if not w.done():
                         w.set_result(msg)
                 continue
-            if op == "trace":
+            if op == HostOp.TRACE:
                 waiters, self._trace_waiters = self._trace_waiters, []
                 for w in waiters:
                     if not w.done():
                         w.set_result(msg)
                 continue
-            if op == "events":
+            if op == HostOp.EVENTS:
                 # Batched frame: one pipe line carries every slot's delta
                 # for a decode block. Fan out in frame order — per-request
                 # (and cross-request) ordering is the list order.
@@ -415,7 +416,7 @@ class TpuNativeBackend(InferenceBackend):
                     if q is not None:
                         q.put_nowait(ev)
                 continue
-            if op != "event":
+            if op != HostOp.EVENT:
                 continue
             self.relay_stats["host_frames"] += 1
             self.relay_stats["host_events"] += 1
@@ -448,7 +449,7 @@ class TpuNativeBackend(InferenceBackend):
             if not isinstance(msg, dict):
                 continue
             op = msg.get("op")
-            if op == "handoff":
+            if op == HostOp.HANDOFF:
                 adopt = self._broker.adopt_op(msg)
                 if adopt is None:
                     continue  # request already cancelled/failed
@@ -459,23 +460,23 @@ class TpuNativeBackend(InferenceBackend):
                     # about to shed every stream, this one included.
                     pass
                 continue
-            if op == "stats":
+            if op == HostOp.STATS:
                 waiters, self._prefill_stats_waiters = (
                     self._prefill_stats_waiters, [])
                 for w in waiters:
                     if not w.done():
                         w.set_result(msg)
                 continue
-            if op == "trace":
+            if op == HostOp.TRACE:
                 waiters, self._prefill_trace_waiters = (
                     self._prefill_trace_waiters, [])
                 for w in waiters:
                     if not w.done():
                         w.set_result(msg)
                 continue
-            if op in ("event", "events"):
+            if op in (HostOp.EVENT, HostOp.EVENTS):
                 events = (msg.get("events")
-                          if op == "events" else [msg])
+                          if op == HostOp.EVENTS else [msg])
                 if not isinstance(events, list):
                     continue
                 for ev in events:
@@ -521,7 +522,7 @@ class TpuNativeBackend(InferenceBackend):
         restarting = (self._started and self._sup_enabled
                       and not self._circuit_open)
         for q in self._queues.values():
-            q.put_nowait({"op": "event", "done": True,
+            q.put_nowait({"op": HostOp.EVENT, "done": True,
                           "finish_reason": "error",
                           "restarting": restarting,
                           "error": reason, "text": ""})
@@ -570,7 +571,7 @@ class TpuNativeBackend(InferenceBackend):
         # half-shut pipe.
         if self._prefill_proc is not None:
             with contextlib.suppress(ConnectionError, OSError):
-                await self._host_send({"op": "shutdown"},
+                await self._host_send({"op": HostOp.SHUTDOWN},
                                       proc=self._prefill_proc)
             try:
                 await asyncio.wait_for(self._prefill_proc.wait(),
@@ -584,7 +585,7 @@ class TpuNativeBackend(InferenceBackend):
             self._prefill_reader = None
         if self._proc is not None:
             with contextlib.suppress(ConnectionError, OSError):
-                await self._host_send({"op": "shutdown"})
+                await self._host_send({"op": HostOp.SHUTDOWN})
             try:
                 await asyncio.wait_for(self._proc.wait(),
                                        self._stop_grace_s)
@@ -825,25 +826,25 @@ class TpuNativeBackend(InferenceBackend):
                 waiters.remove(fut)
 
     async def _probe_host_stats(self, timeout: float = 10.0) -> dict | None:
-        return await self._probe("stats", self._stats_waiters, None,
+        return await self._probe(HostOp.STATS, self._stats_waiters, None,
                                  timeout)
 
     async def _probe_host_trace(self, timeout: float = 10.0) -> dict | None:
-        return await self._probe("trace", self._trace_waiters, None,
+        return await self._probe(HostOp.TRACE, self._trace_waiters, None,
                                  timeout)
 
     async def _probe_prefill_stats(self, timeout: float = 10.0
                                    ) -> dict | None:
         if self._prefill_proc is None:
             return None
-        return await self._probe("stats", self._prefill_stats_waiters,
+        return await self._probe(HostOp.STATS, self._prefill_stats_waiters,
                                  self._prefill_proc, timeout)
 
     async def _probe_prefill_trace(self, timeout: float = 10.0
                                    ) -> dict | None:
         if self._prefill_proc is None:
             return None
-        return await self._probe("trace", self._prefill_trace_waiters,
+        return await self._probe(HostOp.TRACE, self._prefill_trace_waiters,
                                  self._prefill_proc, timeout)
 
     async def trace_components(self) -> list[dict]:
@@ -1096,7 +1097,7 @@ class TpuNativeBackend(InferenceBackend):
         try:
             try:
                 submit = {
-                    "op": "submit", "id": request_id,
+                    "op": HostOp.SUBMIT, "id": request_id,
                     "messages": request.messages, "max_new": max_new,
                     "sampling": {"temperature": request.temperature or 0.0,
                                  "top_p": (request.top_p
@@ -1193,4 +1194,4 @@ class TpuNativeBackend(InferenceBackend):
                         continue
                     with contextlib.suppress(ConnectionError, OSError):
                         await self._host_send(
-                            {"op": "cancel", "id": request_id}, proc=proc)
+                            {"op": HostOp.CANCEL, "id": request_id}, proc=proc)

@@ -456,7 +456,7 @@ class SymmetryProvider:
             "queued": (max(0, self._in_flight - slots)
                        if slots is not None else 0),
             "pending_first_token": self._unstarted,
-            **({"queue_limit": getattr(self.backend, "queue_limit")}
+            **({"queue_limit": self.backend.queue_limit}
                if getattr(self.backend, "queue_limit", None) is not None
                else {}),
             "connections": len(self._client_peers),

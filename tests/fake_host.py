@@ -40,6 +40,7 @@ import yaml
 # of the spawning process's cwd.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from symmetry_tpu.protocol.keys import HostOp
 from symmetry_tpu.utils.faults import FAULTS  # noqa: E402
 
 
@@ -67,10 +68,10 @@ class FakeHost:
         for i in range(n - 1):
             if req_id in self._cancelled:
                 break
-            self.write({"op": "event", "id": req_id, "text": f"t{i} ",
+            self.write({"op": HostOp.EVENT, "id": req_id, "text": f"t{i} ",
                         "tokens": i + 1, "tokens_new": 1})
             time.sleep(self._delay)
-        self.write({"op": "event", "id": req_id, "text": "", "done": True,
+        self.write({"op": HostOp.EVENT, "id": req_id, "text": "", "done": True,
                     "finish_reason": "stop", "tokens": n, "tokens_new": 0})
         self._cancelled.discard(req_id)
 
@@ -82,7 +83,7 @@ class FakeHost:
         if self._die_after is not None:
             threading.Timer(float(self._die_after),
                             lambda: os._exit(86)).start()
-        self.write({"op": "ready", "model": self._cfg.get("modelName", "fake"),
+        self.write({"op": HostOp.READY, "model": self._cfg.get("modelName", "fake"),
                     "slots": 4, "max_seq_len": 128,
                     "build_s": 0.0, "warmup_s": 0.0})
         for line in sys.stdin:
@@ -96,23 +97,23 @@ class FakeHost:
             except ValueError:
                 continue
             op = msg.get("op")
-            if op == "clock":
-                self.write({"op": "clock", "t0": msg.get("t0"),
+            if op == HostOp.CLOCK:
+                self.write({"op": HostOp.CLOCK, "t0": msg.get("t0"),
                             "t": time.monotonic()})
-            elif op == "stats":
-                self.write({"op": "stats", "engine_alive": True,
+            elif op == HostOp.STATS:
+                self.write({"op": HostOp.STATS, "engine_alive": True,
                             "requests": 0, "tokens": 0,
                             **({"faults": FAULTS.counters()}
                                if FAULTS.enabled else {})})
-            elif op == "submit":
+            elif op == HostOp.SUBMIT:
                 threading.Thread(target=self._stream, args=(msg,),
                                  daemon=True).start()
-            elif op == "cancel":
+            elif op == HostOp.CANCEL:
                 self._cancelled.add(str(msg.get("id", "")))
-            elif op == "trace":
-                self.write({"op": "trace", "clock": time.monotonic(),
+            elif op == HostOp.TRACE:
+                self.write({"op": HostOp.TRACE, "clock": time.monotonic(),
                             "components": []})
-            elif op == "shutdown":
+            elif op == HostOp.SHUTDOWN:
                 return 0
         return 0
 

@@ -1,0 +1,639 @@
+"""symlint analyzer suite tests (symmetry_tpu/analysis/, tools/symlint.py).
+
+Per checker: a seeded true positive (the drift the checker exists to
+catch) and a true negative (the idiomatic clean shape must not flag).
+Plus: baseline suppression semantics, the runner's JSON schema and exit
+codes (the CI gate is `exit != 0` on a seeded wire-op mismatch), and
+the self-test — the repo itself must run clean modulo the justified
+baseline, which is also the regression lock on the concurrency fixes
+this suite originally surfaced (engine/host.py handoff/adopt stats).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from symmetry_tpu.analysis import ALL_CHECKERS, Baseline, run
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+KEYS_PY = '''\
+class HostOp:
+    SUBMIT = "submit"
+    EVENT = "event"
+    EVENTS = "events"
+
+
+class MessageKey:
+    PING = "ping"
+    PONG = "pong"
+'''
+
+
+def write_tree(root, files: dict[str, str]) -> str:
+    for rel, src in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(src)
+    return str(root)
+
+
+def codes(findings) -> set[str]:
+    return {f.code for f in findings}
+
+
+# ------------------------------------------------------------ wire-contract
+
+
+class TestWireContract:
+    def test_mismatch_and_raw_literal_flag(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/protocol/keys.py": KEYS_PY,
+            # producer emits a typo'd op, plus a registered op spelled
+            # as a raw literal…
+            "symmetry_tpu/engine/host.py": (
+                'def emit(w):\n'
+                '    w({"op": "evnt", "id": "r1"})\n'
+                '    w({"op": "submit", "id": "r1"})\n'),
+            # …while the consumer dispatches on the real one
+            "symmetry_tpu/provider/backends/tpu_native.py": (
+                'from symmetry_tpu.protocol.keys import HostOp\n'
+                'def pump(msg):\n'
+                '    op = msg.get("op")\n'
+                '    if op == HostOp.EVENT:\n'
+                '        return msg\n'),
+        })
+        fs = run(root)
+        got = codes(fs)
+        assert "W102" in got     # "evnt" produced, never consumed
+        assert "W103" in got     # "event" consumed, never produced
+        assert "W104" in got     # "evnt" unknown to HostOp
+        assert "W101" in got     # raw literal in a registry'd group file
+        syms = {f.symbol for f in fs if f.code == "W102"}
+        assert syms == {"evnt", "submit"}  # both lack a consumer
+
+    def test_clean_when_both_sides_use_constants(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/protocol/keys.py": KEYS_PY,
+            "symmetry_tpu/engine/host.py": (
+                'from symmetry_tpu.protocol.keys import HostOp\n'
+                'def emit(w):\n'
+                '    w({"op": HostOp.EVENT, "id": "r1"})\n'
+                '    m = {}\n'
+                '    m["op"] = HostOp.EVENTS\n'
+                '    w(m)\n'),
+            "symmetry_tpu/provider/backends/tpu_native.py": (
+                'from symmetry_tpu.protocol.keys import HostOp\n'
+                'def pump(msg):\n'
+                '    op = msg.get("op")\n'
+                '    if op in (HostOp.EVENT, HostOp.EVENTS):\n'
+                '        return msg\n'),
+        })
+        assert run(root) == []
+
+    def test_nonexistent_registry_attribute_flags(self, tmp_path):
+        # HostOp.EVNT (typo'd CONSTANT, not value) must flag, not vanish
+        # from the consumed set: at runtime it is an AttributeError on a
+        # rarely-taken dispatch arm.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/protocol/keys.py": KEYS_PY,
+            "symmetry_tpu/engine/host.py": (
+                'from symmetry_tpu.protocol.keys import HostOp\n'
+                'def emit(w):\n'
+                '    w({"op": HostOp.EVENT})\n'),
+            "symmetry_tpu/provider/backends/tpu_native.py": (
+                'from symmetry_tpu.protocol.keys import HostOp\n'
+                'def pump(msg):\n'
+                '    op = msg.get("op")\n'
+                '    if op == HostOp.EVNT:\n'
+                '        return msg\n'
+                '    if op == HostOp.EVENT:\n'
+                '        return msg\n'),
+        })
+        fs = run(root)
+        w104 = [f for f in fs if f.code == "W104"]
+        assert [f.symbol for f in w104] == ["HostOp.EVNT"]
+
+    def test_subscript_consumer_shape_recognized(self, tmp_path):
+        # `msg["op"] == HostOp.X` is a consumer too — missing it would
+        # false-W102 the producer of a perfectly consumed op.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/protocol/keys.py": KEYS_PY,
+            "symmetry_tpu/engine/host.py": (
+                'from symmetry_tpu.protocol.keys import HostOp\n'
+                'def emit(w):\n'
+                '    w({"op": HostOp.EVENT})\n'),
+            "symmetry_tpu/provider/backends/tpu_native.py": (
+                'from symmetry_tpu.protocol.keys import HostOp\n'
+                'def pump(msg):\n'
+                '    if msg["op"] == HostOp.EVENT:\n'
+                '        return msg\n'),
+        })
+        assert run(root) == []
+
+    def test_message_key_send_without_handler(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/protocol/keys.py": KEYS_PY,
+            "symmetry_tpu/provider/provider.py": (
+                'from symmetry_tpu.protocol.keys import MessageKey\n'
+                'async def serve(peer, msg):\n'
+                '    if msg.key == MessageKey.PING:\n'
+                '        await peer.send(MessageKey.PONG)\n'),
+            # nobody handles pong, nobody sends ping
+        })
+        got = codes(run(root))
+        assert "W106" in got and "W107" in got
+
+
+# -------------------------------------------------------------- concurrency
+
+
+class TestConcurrency:
+    def test_blocking_call_in_async_flags(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/provider/p.py": (
+                'import time\n'
+                'async def relay():\n'
+                '    time.sleep(1.0)\n'),
+        })
+        fs = run(root)
+        assert codes(fs) == {"C201"}
+
+    def test_async_sleep_and_executor_helper_clean(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/provider/p.py": (
+                'import asyncio, time\n'
+                'async def relay():\n'
+                '    await asyncio.sleep(1.0)\n'
+                '    def build():\n'
+                '        time.sleep(0.1)  # runs in a thread, allowed\n'
+                '    await asyncio.to_thread(build)\n'),
+        })
+        assert run(root) == []
+
+    def test_cross_thread_mutation_without_lock_flags(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/s.py": (
+                'import threading\n'
+                'class Loop:\n'
+                '    def __init__(self):\n'
+                '        self.count = 0\n'
+                '    def start(self):\n'
+                '        threading.Thread(target=self._run).start()\n'
+                '    def _run(self):\n'
+                '        self.count += 1\n'
+                '    def submit(self):\n'
+                '        self.count += 1\n'),
+        })
+        fs = run(root)
+        assert codes(fs) == {"C202"}
+        assert fs[0].symbol == "Loop.count"
+
+    def test_locked_mutation_clean(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/s.py": (
+                'import threading\n'
+                'class Loop:\n'
+                '    def __init__(self):\n'
+                '        self.count = 0\n'
+                '        self._lock = threading.Lock()\n'
+                '    def start(self):\n'
+                '        threading.Thread(target=self._run).start()\n'
+                '    def _run(self):\n'
+                '        with self._lock:\n'
+                '            self.count += 1\n'
+                '    def submit(self):\n'
+                '        with self._lock:\n'
+                '            self.count += 1\n'),
+        })
+        assert run(root) == []
+
+    def test_escaped_closure_counts_as_thread_context(self, tmp_path):
+        # The exact shape of the engine-host adopt-thunk race this
+        # checker caught for real: a local def handed to other
+        # machinery mutates the same counter the pipe thread does.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/h.py": (
+                'class Host:\n'
+                '    def __init__(self, sched):\n'
+                '        self.stats = {"errors": 0}\n'
+                '        self._sched = sched\n'
+                '    def handle(self, msg):\n'
+                '        def adopt(req):\n'
+                '            self.stats["errors"] += 1\n'
+                '        self._sched.submit(adopt)\n'
+                '        self.stats["errors"] += 1\n'),
+        })
+        fs = run(root)
+        assert codes(fs) == {"C202"}
+        assert "stats['errors']" in fs[0].symbol
+
+    def test_different_locks_do_not_exclude(self, tmp_path):
+        # Two sites each "locked" — but by DIFFERENT locks: still a race.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/s.py": (
+                'import threading\n'
+                'class Loop:\n'
+                '    def start(self):\n'
+                '        threading.Thread(target=self._run).start()\n'
+                '    def _run(self):\n'
+                '        with self._stats_lock:\n'
+                '            self.count += 1\n'
+                '    def submit(self):\n'
+                '        with self._io_lock:\n'
+                '            self.count += 1\n'),
+        })
+        fs = run(root)
+        assert codes(fs) == {"C202"}
+        # the diagnostic must not claim "unlocked" — both sites hold a
+        # lock, just not the same one
+        assert "no common lock" in fs[0].message
+
+    def test_mutator_method_calls_are_mutations(self, tmp_path):
+        # The .update()/.pop() shape of the same race class — invisible
+        # to Assign/AugAssign extraction, so tracked explicitly.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/s.py": (
+                'import threading\n'
+                'class Loop:\n'
+                '    def start(self):\n'
+                '        threading.Thread(target=self._run).start()\n'
+                '    def _run(self):\n'
+                '        self.stats.update(done=1)\n'
+                '    def submit(self, k):\n'
+                '        self.stats.pop(k, None)\n'),
+        })
+        fs = run(root)
+        assert codes(fs) == {"C202"}
+        assert fs[0].symbol == "Loop.stats"
+
+    def test_result_with_timeout_still_blocks(self, tmp_path):
+        # Future.result(timeout=30) blocks the loop for up to 30 s —
+        # the timeout kwarg must not exempt it.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/provider/p.py": (
+                'async def relay(fut):\n'
+                '    return fut.result(timeout=30)\n'),
+        })
+        assert codes(run(root)) == {"C201"}
+
+    def test_whole_dict_mutator_collides_with_key_writes(self, tmp_path):
+        # thread A rewrites the dict wholesale, thread B bumps one key:
+        # different granularities, same race.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/s.py": (
+                'import threading\n'
+                'class Loop:\n'
+                '    def start(self):\n'
+                '        threading.Thread(target=self._run).start()\n'
+                '    def _run(self):\n'
+                '        self.stats.update(requests=0)\n'
+                '    def submit(self):\n'
+                '        self.stats["requests"] += 1\n'),
+        })
+        fs = run(root)
+        assert codes(fs) == {"C202"}
+        assert "stats['requests']" in fs[0].symbol
+
+    def test_nested_async_blocking_reported_once(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/network/n.py": (
+                'import time\n'
+                'async def dial():\n'
+                '    async def burst():\n'
+                '        time.sleep(0.1)\n'
+                '    await burst()\n'),
+        })
+        fs = run(root)
+        assert [f.symbol for f in fs] == ["burst:time.sleep"]
+
+    def test_per_key_granularity_is_not_a_race(self, tmp_path):
+        # engine thread owns metrics["steps"], callers own
+        # metrics["requests"]: distinct GIL-atomic keys, no finding.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/s.py": (
+                'import threading\n'
+                'class Loop:\n'
+                '    def __init__(self):\n'
+                '        self.metrics = {"requests": 0, "steps": 0}\n'
+                '    def start(self):\n'
+                '        threading.Thread(target=self._run).start()\n'
+                '    def _run(self):\n'
+                '        self.metrics["steps"] += 1\n'
+                '    def submit(self):\n'
+                '        self.metrics["requests"] += 1\n'),
+        })
+        assert run(root) == []
+
+
+# --------------------------------------------------------- recompile-hazard
+
+
+class TestRecompileHazard:
+    def test_value_branch_and_int_flag(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/ops/k.py": (
+                'import functools, jax\n'
+                '@functools.partial(jax.jit, static_argnames=("bk",))\n'
+                'def f(x, n, bk):\n'
+                '    if n > 0:\n'
+                '        x = x + 1\n'
+                '    m = int(n)\n'
+                '    return x, m\n'),
+        })
+        got = codes(run(root))
+        assert got == {"R301", "R302"}
+
+    def test_shape_branch_and_static_arg_clean(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/ops/k.py": (
+                'import functools, jax\n'
+                '@functools.partial(jax.jit, static_argnames=("bk",))\n'
+                'def f(x, bk, w=None):\n'
+                '    if x.shape[0] > 1 and bk > 8:\n'
+                '        x = x * 2\n'
+                '    if w is not None:\n'
+                '        x = x + w\n'
+                '    n = int(x.shape[1])\n'
+                '    return x, n\n'),
+        })
+        assert run(root) == []
+
+    def test_call_site_jit_wrapping_and_host_pull(self, tmp_path):
+        # the engine's `self._p = jax.jit(prefill, donate_argnums=…)`
+        # shape: the wrapped def is found by name, np.asarray flags
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/engine.py": (
+                'import jax\n'
+                'import numpy as np\n'
+                'class E:\n'
+                '    def build(self):\n'
+                '        def prefill(tokens, params):\n'
+                '            host = np.asarray(tokens)\n'
+                '            return host\n'
+                '        self._prefill = jax.jit(prefill,'
+                ' donate_argnums=(0,))\n'),
+        })
+        fs = run(root)
+        assert codes(fs) == {"R303"}
+        assert fs[0].symbol.startswith("prefill:")
+
+    def test_same_named_defs_are_each_analyzed(self, tmp_path):
+        # Two builders each jit-wrap their own nested `def step`: a
+        # name-keyed registry would analyze the first and silently
+        # skip the hazard in the second.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/engine.py": (
+                'import jax\n'
+                'class A:\n'
+                '    def build(self):\n'
+                '        def step(x):\n'
+                '            return x\n'
+                '        self._s = jax.jit(step)\n'
+                'class B:\n'
+                '    def build(self):\n'
+                '        def step(x, n):\n'
+                '            return x, int(n)\n'
+                '        self._s = jax.jit(step)\n'),
+        })
+        fs = run(root)
+        assert codes(fs) == {"R301"}
+
+
+# --------------------------------------------------------------- fault-seam
+
+
+class TestFaultSeam:
+    def test_armed_without_guard_flags(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "tests/test_chaos.py": (
+                'CFG = {"faults": {"host.pipe_wrote": "crash@nth=2"}}\n'),
+            "symmetry_tpu/utils/faults.py": (
+                'class FaultInjector:\n'
+                '    pass\n'),
+        })
+        fs = run(root)
+        assert codes(fs) == {"S401"}
+        assert fs[0].symbol == "host.pipe_wrote"
+
+    def test_guard_without_arming_flags(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/host.py": (
+                'from symmetry_tpu.utils.faults import FAULTS\n'
+                'def write(frame):\n'
+                '    if FAULTS.enabled and'
+                ' FAULTS.point("host.pipe_write"):\n'
+                '        return\n'),
+        })
+        fs = run(root)
+        assert codes(fs) == {"S402"}
+        assert fs[0].symbol == "host.pipe_write"
+
+    def test_matched_pair_and_env_string_clean(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/host.py": (
+                'from symmetry_tpu.utils.faults import FAULTS\n'
+                'def write(frame):\n'
+                '    if FAULTS.enabled and'
+                ' FAULTS.point("host.pipe_write"):\n'
+                '        return\n'),
+            "tests/test_chaos.py": (
+                'SPEC = "host.pipe_write=crash@nth=2"\n'),
+        })
+        assert run(root) == []
+
+    def test_self_contained_injector_test_exempt(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "tests/test_faults.py": (
+                'from symmetry_tpu.utils.faults import FAULTS\n'
+                'def test_roundtrip():\n'
+                '    FAULTS.load({"x.y": "error"})\n'
+                '    assert FAULTS.point("x.y") is False\n'),
+        })
+        assert run(root) == []
+
+
+# ----------------------------------------------------- baseline + runner
+
+
+class TestBaselineAndRunner:
+    MISMATCH = {
+        "symmetry_tpu/protocol/keys.py": KEYS_PY,
+        "symmetry_tpu/engine/host.py": (
+            'from symmetry_tpu.protocol.keys import HostOp\n'
+            'def emit(w):\n'
+            '    w({"op": HostOp.SUBMIT})\n'),
+    }
+
+    def test_baseline_suppresses_by_fingerprint(self, tmp_path):
+        root = write_tree(tmp_path, self.MISMATCH)
+        fs = run(root)
+        assert fs and all(not f.baselined for f in fs)
+        bl = Baseline([{"fingerprint": f.fingerprint, "reason": "test"}
+                       for f in fs])
+        fs2 = run(root, baseline=bl)
+        assert fs2 and all(f.baselined for f in fs2)
+        assert bl.unused() == []
+
+    def test_baseline_requires_reasons(self, tmp_path):
+        path = tmp_path / "bl.json"
+        path.write_text(json.dumps(
+            {"suppressions": [{"fingerprint": "X:y:z"}]}))
+        with pytest.raises(ValueError, match="no\\s+reason"):
+            Baseline.load(str(path))
+
+    def _symlint(self, *args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "symlint.py"),
+             *args],
+            capture_output=True, text=True)
+
+    def test_runner_exits_nonzero_on_seeded_wire_mismatch(self, tmp_path):
+        # The CI-gate contract: a produced-but-never-consumed op must
+        # fail the step.
+        root = write_tree(tmp_path, self.MISMATCH)
+        r = self._symlint("--root", root)
+        assert r.returncode == 1
+        assert "W102" in r.stdout and "submit" in r.stdout
+
+    def test_runner_json_schema(self, tmp_path):
+        root = write_tree(tmp_path, self.MISMATCH)
+        r = self._symlint("--root", root, "--json")
+        assert r.returncode == 1
+        report = json.loads(r.stdout)
+        assert report["version"] == 1
+        assert set(report["counts"]) == {"total", "new", "baselined"}
+        assert report["counts"]["new"] == len(report["findings"]) > 0
+        f = report["findings"][0]
+        assert set(f) == {"checker", "code", "path", "line", "message",
+                          "symbol", "fingerprint", "baselined"}
+        assert f["fingerprint"].startswith(f["code"] + ":")
+        assert [s.name for s in ALL_CHECKERS] == report["checkers"]
+
+    def test_runner_checker_filter_and_clean_exit(self, tmp_path):
+        root = write_tree(tmp_path, self.MISMATCH)
+        # the mismatch is wire-only: filtering to fault-seam is clean
+        r = self._symlint("--root", root, "--checker", "fault-seam")
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_path_filter_keeps_cross_file_context(self, tmp_path):
+        # Positional paths filter the REPORT, not the scan: the clean
+        # consumer file exits 0 even though the mismatch lives in the
+        # producer file — and naming the producer still fails.
+        root = write_tree(tmp_path, {
+            **self.MISMATCH,
+            "symmetry_tpu/provider/backends/tpu_native.py": (
+                'from symmetry_tpu.protocol.keys import HostOp\n'
+                'def pump(msg):\n'
+                '    op = msg.get("op")\n'
+                '    if op == HostOp.EVENT:\n'
+                '        return msg\n'),
+        })
+        r = self._symlint("--root", root,
+                          "symmetry_tpu/protocol/keys.py")
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = self._symlint("--root", root, "symmetry_tpu/engine/host.py")
+        assert r.returncode == 1 and "W102" in r.stdout
+        # a typo'd filter path is a broken invocation, not a clean run
+        r = self._symlint("--root", root, "no/such/file.py")
+        assert r.returncode == 2 and "matched no scanned file" in r.stderr
+
+    def test_unused_baseline_entry_reported_and_strict(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/empty.py": "X = 1\n"})
+        bl = tmp_path / "bl.json"
+        bl.write_text(json.dumps({"suppressions": [
+            {"fingerprint": "W102:gone.py:ghost", "reason": "stale"}]}))
+        r = self._symlint("--root", root, "--baseline", str(bl))
+        assert r.returncode == 0 and "matched nothing" in r.stderr
+        r = self._symlint("--root", root, "--baseline", str(bl),
+                          "--strict-baseline")
+        assert r.returncode == 1
+
+    def test_checker_filter_does_not_stale_other_checkers_entries(
+            self, tmp_path):
+        # A C202 suppression is not stale just because this run was
+        # wire-contract-only — pruning on that advice would break the
+        # next full run.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/empty.py": "X = 1\n"})
+        bl = tmp_path / "bl.json"
+        bl.write_text(json.dumps({"suppressions": [
+            {"fingerprint": "C202:a.py:Cls.attr", "reason": "owned"}]}))
+        r = self._symlint("--root", root, "--baseline", str(bl),
+                          "--checker", "wire-contract",
+                          "--strict-baseline")
+        assert r.returncode == 0 and "matched nothing" not in r.stderr
+        # …but the same entry IS stale when its checker runs
+        r = self._symlint("--root", root, "--baseline", str(bl),
+                          "--checker", "concurrency", "--strict-baseline")
+        assert r.returncode == 1 and "matched nothing" in r.stderr
+
+
+# ------------------------------------------------------------- self-test
+
+
+class TestRepoClean:
+    def test_repo_runs_clean_modulo_baseline(self):
+        """The acceptance gate, from the inside: zero non-baselined
+        findings on this checkout, and no stale baseline entries."""
+        bl = Baseline.load(os.path.join(REPO, "tools",
+                                        "symlint_baseline.json"))
+        findings = run(REPO, baseline=bl)
+        fresh = [f for f in findings if not f.baselined]
+        assert fresh == [], "\n".join(f.render() for f in fresh)
+        assert bl.unused() == [], (
+            "stale baseline entries — prune tools/symlint_baseline.json")
+
+    def test_host_op_registry_matches_protocol_docstring_ops(self):
+        # The registry the wire checker pivots on must cover the ops the
+        # engine host actually dispatches (drift here would quietly
+        # weaken every W-code).
+        from symmetry_tpu.protocol.keys import HOST_OPS
+        for op in ("submit", "adopt", "cancel", "clock", "trace",
+                   "stats", "shutdown", "ready", "event", "events",
+                   "handoff"):
+            assert op in HOST_OPS
+
+
+class TestHostStatsLockRegression:
+    """Regression for the two real C202 findings symlint surfaced:
+    EngineHost.handoff_stats / adopt_stats were mutated from the
+    pipe-reader thread AND the engine thread without a lock. The fix
+    takes _wlock around every mutation; this hammers the handoff path
+    from two threads and requires exact counts."""
+
+    def test_emit_handoff_counters_are_exact_under_contention(self):
+        from symmetry_tpu.engine.host import EngineHost
+
+        host = EngineHost(None)
+
+        class _Eng:
+            kv_quant = False
+
+        host._engine = _Eng()
+        host._write = lambda obj, events=0: None  # no real pipe
+        n, threads = 200, 4
+        old = sys.getswitchinterval()
+        sys.setswitchinterval(1e-5)
+        try:
+            def hammer():
+                for i in range(n):
+                    host._emit_handoff(f"r{i}", [1, 2, 3], 0, None)
+
+            ts = [threading.Thread(target=hammer) for _ in range(threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        finally:
+            sys.setswitchinterval(old)
+        assert host.handoff_stats["frames"] == n * threads
+        assert host.handoff_stats["routing_only"] == n * threads

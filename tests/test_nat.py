@@ -353,7 +353,7 @@ class TestRelayCap:
             control = _FakePeer()
             server._provider_peers["prov-key"] = control
             client = _FakePeer()
-            for i in range(server.MAX_RELAYS_PER_CLIENT):
+            for _ in range(server.MAX_RELAYS_PER_CLIENT):
                 await server._handle_relay_connect(
                     client, "client-key", {"providerKey": "prov-key"})
             assert len(server._relays) == server.MAX_RELAYS_PER_CLIENT

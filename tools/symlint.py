@@ -1,0 +1,182 @@
+#!/usr/bin/env python3
+"""symlint — the project-invariant static-analysis gate.
+
+Runs the four AST checkers in symmetry_tpu/analysis/ over the repo and
+exits non-zero when any finding is not covered by the baseline file,
+so CI fails on protocol/concurrency/recompile/fault-seam drift before
+the test suite even starts (the whole run is ~4 s of `ast.parse` +
+checker passes, no JAX import, no device).
+
+Usage:
+    python tools/symlint.py                  # text output, repo root
+    python tools/symlint.py --json           # machine-readable report
+    python tools/symlint.py --checker wire-contract --checker fault-seam
+    python tools/symlint.py --baseline tools/symlint_baseline.json
+    python tools/symlint.py --no-baseline    # show EVERYTHING
+    python tools/symlint.py path/a.py        # report only these files
+
+Positional paths FILTER the report, they do not shrink the scan: the
+checkers are cross-file by design (a producer's consumer usually lives
+in another file), so the whole repo is always analyzed and findings
+are then restricted to the named files. Unused-baseline reporting is
+suppressed in filtered mode — entries for unlisted files are not
+stale.
+
+Baseline workflow: a finding that is intentional (e.g. a per-request
+dict key owned by one thread at a time) gets a justified entry in
+tools/symlint_baseline.json keyed by its line-number-free fingerprint
+(printed with --json, or with --fingerprints in text mode). Unused
+baseline entries are reported so stale suppressions cannot silently
+shadow a future regression; --strict-baseline turns them into a
+failure.
+
+Exit codes: 0 clean (or baseline-only), 1 new findings (or unused
+baseline entries under --strict-baseline), 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from symmetry_tpu.analysis import ALL_CHECKERS, Baseline, run  # noqa: E402
+from symmetry_tpu.analysis.core import iter_py_files  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join("tools", "symlint_baseline.json")
+SCHEMA_VERSION = 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="symlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative .py files to REPORT on (the "
+                         "scan always covers the whole repo — the "
+                         "checkers are cross-file)")
+    ap.add_argument("--root", default=_REPO_ROOT,
+                    help="repo root to scan (default: this checkout)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable JSON report on stdout")
+    ap.add_argument("--checker", action="append", default=None,
+                    metavar="NAME",
+                    help="run only this checker (repeatable); "
+                         "see --list-checkers")
+    ap.add_argument("--list-checkers", action="store_true",
+                    help="list checker names and exit")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help=f"suppression file (default: {DEFAULT_BASELINE} "
+                         f"under --root when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file")
+    ap.add_argument("--strict-baseline", action="store_true",
+                    help="fail when baseline entries matched nothing")
+    ap.add_argument("--fingerprints", action="store_true",
+                    help="append each finding's fingerprint in text mode "
+                         "(what a baseline entry must quote)")
+    args = ap.parse_args(argv)
+
+    if args.list_checkers:
+        for spec in ALL_CHECKERS:
+            print(f"{spec.name:18s} {', '.join(spec.codes):38s} {spec.doc}")
+        return 0
+
+    checkers = ALL_CHECKERS
+    if args.checker:
+        by_name = {s.name: s for s in ALL_CHECKERS}
+        unknown = [c for c in args.checker if c not in by_name]
+        if unknown:
+            print(f"symlint: unknown checker(s): {', '.join(unknown)} "
+                  f"(have: {', '.join(by_name)})", file=sys.stderr)
+            return 2
+        checkers = tuple(by_name[c] for c in args.checker)
+
+    baseline = None
+    baseline_path = None
+    if not args.no_baseline:
+        baseline_path = args.baseline or os.path.join(args.root,
+                                                      DEFAULT_BASELINE)
+        if os.path.exists(baseline_path):
+            try:
+                baseline = Baseline.load(baseline_path)
+            except (ValueError, OSError, json.JSONDecodeError) as exc:
+                print(f"symlint: bad baseline {baseline_path}: {exc}",
+                      file=sys.stderr)
+                return 2
+        elif args.baseline:
+            print(f"symlint: baseline not found: {baseline_path}",
+                  file=sys.stderr)
+            return 2
+
+    # Filter paths are root-relative; absolute paths are re-anchored.
+    only = {(os.path.relpath(p, args.root) if os.path.isabs(p) else p)
+            .replace(os.sep, "/") for p in args.paths}
+    try:
+        findings = run(args.root, checkers, baseline)
+    except (OSError, ValueError) as exc:
+        print(f"symlint: {exc}", file=sys.stderr)
+        return 2
+    if only:
+        # A filter entry that matches nothing scanned is a broken
+        # invocation (typo, moved file), not a clean result — a hook
+        # that silently checks nothing is worse than no hook.
+        scanned = set(iter_py_files(args.root))
+        ghosts = sorted(p for p in only if p not in scanned)
+        if ghosts:
+            print(f"symlint: path filter matched no scanned file: "
+                  f"{', '.join(ghosts)}", file=sys.stderr)
+            return 2
+        findings = [f for f in findings if f.path in only]
+
+    new = [f for f in findings if not f.baselined]
+    old = [f for f in findings if f.baselined]
+    # Staleness is only judgeable for what actually ran: in path-
+    # filtered mode skip the unused report entirely, and under a
+    # --checker filter only consider entries whose code belongs to a
+    # selected checker — a C202 suppression is not stale just because
+    # this run was wire-contract-only.
+    unused: list[str] = []
+    if baseline is not None and not only:
+        selected_codes = {c for s in checkers for c in s.codes}
+        unused = [fp for fp in baseline.unused()
+                  if fp.split(":", 1)[0] in selected_codes]
+
+    if args.as_json:
+        report = {
+            "version": SCHEMA_VERSION,
+            "root": args.root,
+            "checkers": [s.name for s in checkers],
+            "baseline": baseline_path if baseline is not None else None,
+            "findings": [f.to_dict() for f in findings],
+            "baseline_unused": unused,
+            "counts": {"total": len(findings), "new": len(new),
+                       "baselined": len(old)},
+        }
+        print(json.dumps(report, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+            if args.fingerprints:
+                print(f"    fingerprint: {f.fingerprint}")
+        for fp in unused:
+            print(f"symlint: baseline entry matched nothing "
+                  f"(stale? prune it): {fp}", file=sys.stderr)
+        summary = (f"symlint: {len(new)} new finding(s), "
+                   f"{len(old)} baselined, "
+                   f"{len(checkers)} checker(s)")
+        print(summary, file=sys.stderr)
+
+    if new:
+        return 1
+    if unused and args.strict_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
