@@ -290,6 +290,7 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             speculative: bool = False, draft_k: int = 8,
             fused_dequant: bool = False, trace_out: str | None = None,
             tracing: bool = True, disagg: bool = False,
+            disagg_transport: str | None = None,
             multi_turn: int = 1) -> dict:
     """The NORTH-STAR measurement (BASELINE.json metric): aggregate WIRE
     tok/s and p50/p99 TTFT through the full serving path — server +
@@ -359,8 +360,17 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 # Disaggregated prefill/decode: the provider runs a
                 # prefill host + decode host pair with KV handoff
                 # (engine/disagg/); handoff counters land in the JSON's
-                # engine.disagg block.
+                # engine.disagg block. --disagg-transport swaps the
+                # local pipes for the cross-machine handoff link (an
+                # inline prefill node inside the provider process,
+                # reached ONLY over the mem:// or tcp:// link).
                 **({"role": "disagg"} if disagg else {}),
+                **({"disagg": {
+                        "peer": ("tcp://127.0.0.1:0"
+                                 if disagg_transport == "tcp"
+                                 else "mem://bench-disagg"),
+                        "inline": True}}
+                   if disagg and disagg_transport else {}),
                 # tracing=False empties the engine-side span rings — the
                 # A/B knob for proving the recorder's overhead stays
                 # under 1% of greedy decode tok/s (--no-trace vs default
@@ -986,14 +996,44 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 ph = dg.get("prefill_host") or {}
                 ho = ph.get("handoff") or {}
                 ad = engine_stats.get("adopt") or {}
+                # The handoff cost SPLIT as explicit top-level fields
+                # (they used to be one opaque number inside nested host
+                # stats): serialize = the prefill host's frame-encode
+                # wall; wire = emit → broker receipt through the pipe
+                # (local pair) or the chunked link (network mode), on
+                # reconciled clocks. Link counters (retries, credit
+                # stalls) ride when the cross-machine link is in play.
+                ws = dg.get("wire_s") or {}
+                diag["disagg"]["handoff_serialize_s"] = \
+                    ho.get("serialize_s")
+                diag["disagg"]["handoff_wire_s_total"] = \
+                    dg.get("wire_s_total")
+                node = dg.get("node") or {}
+                link = dg.get("link") or {}
+                if node or link:
+                    diag["disagg"]["handoff_wire"] = {
+                        "retries": node.get("retries"),
+                        "failed": node.get("failed"),
+                        "credit_stalls": node.get("credit_stalls"),
+                        "credit_stall_s": node.get("credit_stall_s"),
+                        "connects": link.get("connects"),
+                        "drops": link.get("drops"),
+                        "partial_discards": link.get("partial_discards"),
+                    }
                 print(f"[bench] disagg: {dg.get('handoff_frames')} "
                       f"handoffs / {dg.get('handoff_bytes')} bytes "
                       f"({dg.get('prefix_tokens')} prefix tokens, "
                       f"{dg.get('routing_only')} routing-only) | "
                       f"prefill tier p50/p99 {_rnd(pt.get('p50'))}/"
                       f"{_rnd(pt.get('p99'))}s | serialize "
-                      f"{ho.get('serialize_s')}s | adopt "
-                      f"{ad.get('deserialize_s')}s host-side, "
+                      f"{ho.get('serialize_s')}s | wire p50/p99 "
+                      f"{_rnd(ws.get('p50'))}/{_rnd(ws.get('p99'))}s "
+                      f"(total {_rnd(dg.get('wire_s_total'))}s"
+                      + (f", {node.get('retries')} retries, "
+                         f"{node.get('credit_stalls')} credit stalls"
+                         if node else "")
+                      + f") | adopt {ad.get('deserialize_s')}s "
+                      f"host-side, "
                       f"{_rnd(engine_stats.get('adopt_s'))}s dispatch",
                       file=sys.stderr)
             # The attribution that mattered in round 3: wire TTFT far above
@@ -1128,7 +1168,10 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                          else "")
                       + (f", speculative wave (k={draft_k})" if speculative
                          else "")
-                      + (", disagg prefill/decode tiers" if disagg else "")
+                      + ((", disagg prefill/decode tiers"
+                          + (f" over {disagg_transport} link"
+                             if disagg_transport else ""))
+                         if disagg else "")
                       + (f", {multi_turn}-turn sessions" if multi_turn > 1
                          else "")
                       + f", {max_new} tok/req, {slots} slots, block {block}, "
@@ -1325,6 +1368,17 @@ def main() -> None:
                          "the JSON's engine.disagg block. The disagg "
                          "A/B is this flag on vs off at otherwise "
                          "identical settings")
+    ap.add_argument("--disagg-transport", default=None,
+                    choices=("memory", "tcp"),
+                    help="run the disagg pair over the CROSS-MACHINE "
+                         "handoff link (engine/disagg/net.py) instead "
+                         "of local pipes: the provider runs the decode "
+                         "tier + an inline prefill node joined only by "
+                         "the chunked/credit-gated link (memory = "
+                         "in-process frame queues, tcp = real loopback "
+                         "sockets). Adds handoff wire latency/bytes/"
+                         "retries/credit-stalls to the JSON beside the "
+                         "serialize wall (--disagg only)")
     ap.add_argument("--multi-turn", type=int, default=1, metavar="N",
                     help="conversation workload (--e2e): every client "
                          "runs one N-turn session, re-submitting the "
@@ -1433,6 +1487,9 @@ def main() -> None:
     if args.multi_turn > 1 and (args.shared_prefix or args.speculative):
         ap.error("--multi-turn is its own workload; drop "
                  "--shared-prefix/--speculative")
+    if args.disagg_transport and not args.disagg:
+        ap.error("--disagg-transport selects the handoff link for the "
+                 "disagg pair; it needs --disagg")
     if args.clients is None:
         args.clients = (32 if args.multi_turn > 1
                         else 96 if (args.shared_prefix or args.speculative)
@@ -1543,7 +1600,9 @@ def main() -> None:
                 speculative=args.speculative, draft_k=args.draft_k,
                 fused_dequant=args.fused_dequant,
                 trace_out=args.trace_out, tracing=not args.no_trace,
-                disagg=args.disagg, multi_turn=args.multi_turn)
+                disagg=args.disagg,
+                disagg_transport=args.disagg_transport,
+                multi_turn=args.multi_turn)
 
         try:
             result = e2e_attempt(args.max_seq, args.max_new)

@@ -54,10 +54,11 @@ _GUARD_METHODS = {"point", "apoint"}
 _FIRE_METHODS = {"point", "apoint", "fire"}
 
 # One `seam=action[@trigger]` entry of the SYMMETRY_FAULTS grammar. The
-# seam shape is pinned to dotted lower_snake names so ordinary
-# `key=value` strings elsewhere in the repo can never parse as specs.
+# seam shape is pinned to dotted lower_snake names (one or more dots —
+# `disagg.net.drop_link` is three segments) so ordinary `key=value`
+# strings elsewhere in the repo can never parse as specs.
 _SPEC_ENTRY = re.compile(
-    r"^(?P<seam>[a-z_][a-z0-9_]*\.[a-z_][a-z0-9_]*)="
+    r"^(?P<seam>[a-z_][a-z0-9_]*(?:\.[a-z_][a-z0-9_]*)+)="
     r"(?P<action>crash|hang|delay|error|drop_frame)"
     r"(?:\([^)]*\))?(?:@[a-z=0-9_.]+)?$")
 

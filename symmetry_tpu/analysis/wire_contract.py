@@ -21,6 +21,14 @@ watchdog fires. This checker makes the contract static:
   W106  MessageKey sent somewhere but handled nowhere in the tier
   W107  MessageKey handled somewhere but sent nowhere in the tier
 
+The handoff-LINK protocol (engine/disagg/net.py envelope headers,
+`LinkOp` registry) is checked with the same W101–W104 semantics over
+its own group (LINK_GROUP): the link ops deliberately reuse some HostOp
+value strings (a link `submit` forwards a host `submit`), so each
+registry is resolved only against its own `<Class>.<ATTR>` references —
+a `LinkOp.X` attribute is invisible to the HostOp scan and vice versa,
+and raw literals are flagged against whichever registry owns the group.
+
 Producer extraction: any dict literal with an `"op"` key (string
 constant or `HostOp.X`). Consumer extraction: comparisons and
 membership tests where one side is an op constant and the other is an
@@ -62,6 +70,14 @@ OP_GROUP = (
     "tests/fake_host.py",
 )
 
+# The handoff-link protocol group (LinkOp registry): both endpoints of
+# the cross-machine link live here; anything that grows a new link-op
+# producer or consumer belongs in this set.
+LINK_GROUP = (
+    "symmetry_tpu/engine/disagg/net.py",
+    "symmetry_tpu/engine/disagg/node.py",
+)
+
 # The MessageKey tier: everything that sends or handles peer frames.
 KEY_GROUP = (
     "symmetry_tpu/provider/*.py",
@@ -76,6 +92,7 @@ KEY_GROUP = (
 _SEND_METHODS = {"send"}
 
 _OP_REGISTRY_CLASS = "HostOp"
+_LINK_REGISTRY_CLASS = "LinkOp"
 _KEY_REGISTRY_CLASS = "MessageKey"
 
 
@@ -129,8 +146,11 @@ def _is_op_shaped(node: ast.AST) -> bool:
 
 
 def _collect_ops(sf: SourceFile, registry: dict[str, str],
-                 missing: list) -> tuple[list[_OpUse], list[_OpUse]]:
-    """(produced, consumed) op uses in one file; nonexistent
+                 missing: list, registry_class: str = _OP_REGISTRY_CLASS
+                 ) -> tuple[list[_OpUse], list[_OpUse]]:
+    """(produced, consumed) op uses in one file, resolved against ONE
+    registry class (HostOp or LinkOp — references to the other class
+    are invisible here and scanned by their own group); nonexistent
     registry attributes land in `missing` as (file, dotted, line)."""
     produced: list[_OpUse] = []
     consumed: list[_OpUse] = []
@@ -140,7 +160,7 @@ def _collect_ops(sf: SourceFile, registry: dict[str, str],
             for k, v in zip(node.keys, node.values):
                 if const_str(k) == "op":
                     val, raw = _op_value(v, registry,
-                                         _OP_REGISTRY_CLASS, miss)
+                                         registry_class, miss)
                     if val is not None:
                         produced.append(_OpUse(val, v.lineno, raw, sf))
         elif isinstance(node, ast.Assign):
@@ -149,7 +169,7 @@ def _collect_ops(sf: SourceFile, registry: dict[str, str],
                 if (isinstance(t, ast.Subscript)
                         and const_str(t.slice) == "op"):
                     val, raw = _op_value(node.value, registry,
-                                         _OP_REGISTRY_CLASS, miss)
+                                         registry_class, miss)
                     if val is not None:
                         produced.append(
                             _OpUse(val, node.value.lineno, raw, sf))
@@ -163,13 +183,13 @@ def _collect_ops(sf: SourceFile, registry: dict[str, str],
                 if _is_op_shaped(side):
                     continue
                 val, raw = _op_value(side, registry,
-                                     _OP_REGISTRY_CLASS, miss)
+                                     registry_class, miss)
                 if val is not None:
                     consumed.append(_OpUse(val, side.lineno, raw, sf))
                 elif isinstance(side, (ast.Tuple, ast.List, ast.Set)):
                     for elt in side.elts:
                         val, raw = _op_value(elt, registry,
-                                             _OP_REGISTRY_CLASS, miss)
+                                             registry_class, miss)
                         if val is not None:
                             consumed.append(
                                 _OpUse(val, elt.lineno, raw, sf))
@@ -228,52 +248,60 @@ def _missing_findings(missing: list) -> list[Finding]:
 
 def check(project: Project) -> list[Finding]:
     findings: list[Finding] = []
-
-    # ---- host-pipe ops ------------------------------------------------
-    op_registry = project.class_constants(_OP_REGISTRY_CLASS)
-    op_values = set(op_registry.values())
-    produced: list[_OpUse] = []
-    consumed: list[_OpUse] = []
     missing: list = []
-    for sf in project.select(OP_GROUP):
-        p, c = _collect_ops(sf, op_registry, missing)
-        produced.extend(p)
-        consumed.extend(c)
-    findings.extend(_missing_findings(missing))
-    missing = []
 
     def _finding(code: str, use: _OpUse, msg: str) -> Finding:
         return Finding(checker=NAME, code=code, path=use.file.rel,
                        line=use.line, message=msg, symbol=use.value)
 
-    for use in produced + consumed:
-        if op_registry and use.raw and use.value in op_values:
-            findings.append(_finding(
-                "W101", use,
-                f'raw op literal "{use.value}" — use HostOp.'
-                f'{next(k for k, v in op_registry.items() if v == use.value)}'
-                f' from symmetry_tpu/protocol/keys.py'))
-        if op_registry and use.value not in op_values:
-            findings.append(_finding(
-                "W104", use,
-                f'op "{use.value}" is not registered in HostOp '
-                f'(symmetry_tpu/protocol/keys.py) — unknown wire op'))
-    produced_vals = {u.value for u in produced}
-    consumed_vals = {u.value for u in consumed}
-    for use in produced:
-        if use.value not in consumed_vals:
-            findings.append(_finding(
-                "W102", use,
-                f'op "{use.value}" is produced here but no consumer in '
-                f'the host-pipe group dispatches on it — the frame '
-                f'would be silently dropped'))
-    for use in consumed:
-        if use.value not in produced_vals:
-            findings.append(_finding(
-                "W103", use,
-                f'op "{use.value}" is dispatched on here but nothing in '
-                f'the host-pipe group produces it — dead consumer or '
-                f'renamed producer'))
+    def _scan_op_group(registry_class: str, group: tuple[str, ...],
+                       label: str) -> None:
+        """One producer/consumer agreement pass: W101–W104 for one op
+        registry over its file group."""
+        registry = project.class_constants(registry_class)
+        values = set(registry.values())
+        produced: list[_OpUse] = []
+        consumed: list[_OpUse] = []
+        miss: list = []
+        for sf in project.select(group):
+            p, c = _collect_ops(sf, registry, miss, registry_class)
+            produced.extend(p)
+            consumed.extend(c)
+        findings.extend(_missing_findings(miss))
+        for use in produced + consumed:
+            if registry and use.raw and use.value in values:
+                findings.append(_finding(
+                    "W101", use,
+                    f'raw op literal "{use.value}" — use '
+                    f'{registry_class}.'
+                    f'{next(k for k, v in registry.items() if v == use.value)}'
+                    f' from symmetry_tpu/protocol/keys.py'))
+            if registry and use.value not in values:
+                findings.append(_finding(
+                    "W104", use,
+                    f'op "{use.value}" is not registered in '
+                    f'{registry_class} (symmetry_tpu/protocol/keys.py) '
+                    f'— unknown wire op'))
+        produced_vals = {u.value for u in produced}
+        consumed_vals = {u.value for u in consumed}
+        for use in produced:
+            if use.value not in consumed_vals:
+                findings.append(_finding(
+                    "W102", use,
+                    f'op "{use.value}" is produced here but no consumer '
+                    f'in the {label} group dispatches on it — the frame '
+                    f'would be silently dropped'))
+        for use in consumed:
+            if use.value not in produced_vals:
+                findings.append(_finding(
+                    "W103", use,
+                    f'op "{use.value}" is dispatched on here but '
+                    f'nothing in the {label} group produces it — dead '
+                    f'consumer or renamed producer'))
+
+    # ---- host-pipe ops + handoff-link ops -----------------------------
+    _scan_op_group(_OP_REGISTRY_CLASS, OP_GROUP, "host-pipe")
+    _scan_op_group(_LINK_REGISTRY_CLASS, LINK_GROUP, "handoff-link")
 
     # ---- MessageKey tier ---------------------------------------------
     key_registry = project.class_constants(_KEY_REGISTRY_CLASS)

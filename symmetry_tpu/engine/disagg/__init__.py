@@ -6,6 +6,13 @@ another, with each finished prompt's KV crossing the boundary as a
 versioned binary frame the decode tier adopts through its prefix store.
 `tpu.role` selects a host's tier; `tpu.role: disagg` makes the
 tpu_native backend run the pair under one supervisor.
+
+Cross-machine: `tpu.disagg.peer` switches the backend to NETWORK mode —
+the decode tier stays local and the prefill tier runs on another
+machine as an engine/disagg/node.py PrefillNode, the two joined by the
+chunked, credit-flow-controlled, acked handoff link in
+engine/disagg/net.py over the transport/ stack (MemoryTransport in
+tests, TCP in production, optional Noise encryption).
 """
 
 from symmetry_tpu.engine.disagg.broker import (
@@ -21,12 +28,20 @@ from symmetry_tpu.engine.disagg.frames import (
     encode_frame,
     encode_kv_handoff,
 )
+from symmetry_tpu.engine.disagg.net import (
+    DecodeLink,
+    LinkConfig,
+    LinkError,
+)
 
 __all__ = [
     "DEFAULT_DECODE_PREFIX_MB",
+    "DecodeLink",
     "FrameError",
     "HandoffBroker",
     "KVHandoff",
+    "LinkConfig",
+    "LinkError",
     "decode_frame",
     "decode_kv_handoff",
     "derive_role_config",

@@ -29,7 +29,7 @@ import time
 from typing import Any
 
 from symmetry_tpu.protocol.keys import HostOp
-from symmetry_tpu.utils.trace import Histogram
+from symmetry_tpu.utils.trace import Histogram, Tracer
 
 # The decode tier adopts handoff frames through its prefix store; a
 # decode host configured without one could only ever full-prefill, which
@@ -80,11 +80,32 @@ class HandoffBroker:
         self._pending: dict[str, tuple[dict[str, Any], float]] = {}
         self.counters = {"submitted": 0, "handoff_frames": 0,
                          "handoff_bytes": 0, "prefix_tokens": 0,
-                         "routing_only": 0, "dropped": 0}
+                         "routing_only": 0, "dropped": 0,
+                         # The WIRE leg of the handoff (serialize time
+                         # lives host-side in handoff_stats): pipe hop
+                         # for the local pair, chunked link transfer in
+                         # network mode. Zero until a handoff carries a
+                         # stamp or a precomputed wire_s.
+                         "wire_frames": 0, "wire_bytes": 0,
+                         "wire_s_total": 0.0}
         # Prefill-tier residence per request: provider submit → handoff
         # frame back at the broker. THE disagg latency number — what the
         # decode tier's TTFT no longer has to contain.
         self.prefill_tier_hist = Histogram()
+        # Handoff wire latency per frame: emit stamp (prefill host pipe
+        # write, or the link sender's transfer start) → frame back at
+        # this broker. Emit stamps from the other tier's clock are
+        # mapped through `prefill_clock_offset` — the host-pipe
+        # handshake offset locally, the link handshake offset across
+        # machines — so the split survives skewed clocks.
+        self.wire_hist = Histogram()
+        self.prefill_clock_offset = 0.0
+        # The wire leg as SPANS too: one "handoff_wire" span per frame
+        # (start = receipt − wire, stamps on this process's clock), so
+        # the merged Perfetto timeline shows the handoff crossing the
+        # pipe/link between the prefill tier's rows and the decode
+        # tier's adopt_dispatch rows.
+        self.tracer = Tracer()
 
     # ------------------------------------------------------------- state
 
@@ -110,6 +131,18 @@ class HandoffBroker:
         self.counters["dropped"] += len(self._pending)
         self._pending.clear()
 
+    def shed_pending(self) -> list[str]:
+        """The handoff LINK died (network mode): every request whose
+        migration was in flight is unrecoverable on this path — return
+        their ids so the backend can shed each stream structured-
+        retryable (the client fails over / retries through the
+        reconnect window). Requests already adopted by the decode tier
+        are untouched; they no longer need the prefill tier."""
+        ids = list(self._pending)
+        self.counters["dropped"] += len(ids)
+        self._pending.clear()
+        return ids
+
     @property
     def pending(self) -> int:
         return len(self._pending)
@@ -130,7 +163,25 @@ class HandoffBroker:
         now = time.monotonic()
         self.prefill_tier_hist.observe(now - t_submit)
         self.counters["handoff_frames"] += 1
-        self.counters["handoff_bytes"] += int(handoff.get("nbytes", 0))
+        nbytes = int(handoff.get("nbytes", 0))
+        self.counters["handoff_bytes"] += nbytes
+        # Wire-leg split: either precomputed by the link receiver
+        # ("wire_s", network mode — it holds the measured link offset)
+        # or derived here from the prefill host's emit stamp ("t")
+        # mapped through the host-pipe clock offset (local pair).
+        wire = handoff.get("wire_s")
+        if wire is None and handoff.get("t") is not None:
+            wire = max(now - (float(handoff["t"])
+                              - self.prefill_clock_offset), 0.0)
+        if wire is not None:
+            wire = float(wire)
+            self.wire_hist.observe(wire)
+            self.counters["wire_frames"] += 1
+            self.counters["wire_bytes"] += nbytes
+            self.counters["wire_s_total"] += wire
+            if self.tracer.enabled:
+                self.tracer.record("handoff_wire", now - wire, wire,
+                                   request_id=req_id, bytes=nbytes)
         p = int(handoff.get("p", 0))
         self.counters["prefix_tokens"] += p
         if p == 0:
@@ -151,6 +202,8 @@ class HandoffBroker:
 
     def stats(self) -> dict[str, Any]:
         out: dict[str, Any] = dict(self.counters)
+        out["wire_s_total"] = round(out["wire_s_total"], 4)
         out["pending"] = len(self._pending)
         out["prefill_tier_s"] = self.prefill_tier_hist.to_dict()
+        out["wire_s"] = self.wire_hist.to_dict()
         return out

@@ -490,9 +490,13 @@ class EngineHost:
         self._cancelled.discard(req_id)
         self.tracer.record("handoff_emit", t0, dt, request_id=req_id,
                            p=p, bytes=len(frame))
+        # "t": emit stamp (this clock) — the broker subtracts it
+        # (through the measured pipe clock offset) from its receipt
+        # time, splitting handoff WIRE latency from serialize wall.
         self._write({"op": HostOp.HANDOFF, "id": req_id, "p": p,
                      "prompt_len": len(prompt_ids),
-                     "nbytes": len(frame), "frame": b64})
+                     "nbytes": len(frame), "frame": b64,
+                     "t": round(time.monotonic(), 4)})
 
     def _handle_adopt(self, msg: dict) -> None:
         """Decode-role command: submit the migrated request with an

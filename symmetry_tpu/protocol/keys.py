@@ -91,6 +91,50 @@ HOST_OPS = frozenset(
 )
 
 
+class LinkOp:
+    """Cross-machine handoff-link ops — the `{"op": ...}` envelope headers
+    of the disagg network transport (engine/disagg/net.py) between a
+    decode-tier node (the tpu_native provider) and a prefill-tier node
+    (engine/disagg/node.py), carried over the transport/ stack.
+
+    Same registry discipline as HostOp: producers and consumers both
+    import these constants and the symlint wire-contract checker scans
+    the link-protocol group (LINK_GROUP in analysis/wire_contract.py),
+    so a renamed link op fails CI instead of silently stranding a
+    handoff mid-wire. Where a link op FORWARDS a host op (submit,
+    cancel, stats, trace), the value is deliberately the same string —
+    the node can splice the payload straight onto the host pipe."""
+
+    # --- control (both directions) ---
+    HELLO = "hello"         # link handshake: version, role, credit window
+    CLOCK = "clock"         # clock-offset probe (echoed with "t"), same
+                            # NTP-midpoint protocol as the host pipe
+
+    # --- decode node → prefill node ---
+    SUBMIT = "submit"       # forwarded host submit op (payload = JSON line)
+    CANCEL = "cancel"       # forwarded host cancel op
+    STATS = "stats"         # stats probe: node replies host stats + link stats
+    TRACE = "trace"         # trace probe: node replies host span rings
+    CREDIT = "credit"       # flow control: return n consumed chunk bytes
+    ACK = "ack"             # handoff transfer fully reassembled + forwarded
+    NAK = "nak"             # transfer failed integrity — sender retransmits
+
+    # --- prefill node → decode node ---
+    BEGIN = "begin"         # handoff transfer start: id, xfer, len, meta
+    CHUNK = "chunk"         # one payload chunk: id, xfer, seq + raw bytes
+    END = "end"             # transfer complete: id, xfer, crc
+    FAIL = "fail"           # handoff abandoned (retries exhausted / host
+                            # death) — the decode node sheds the request
+    EVENT = "event"         # prefill-tier terminal event (tokenization /
+                            # admission error, deadline shed) forwarded
+
+
+LINK_OPS = frozenset(
+    v for k, v in vars(LinkOp).items()
+    if not k.startswith("_") and isinstance(v, str)
+)
+
+
 SERVER_MESSAGE_KEYS = frozenset(
     v for k, v in vars(MessageKey).items() if not k.startswith("_")
 )

@@ -18,7 +18,7 @@ from typing import Any, AsyncIterator
 
 from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
 from symmetry_tpu.engine.scheduler import AsyncSession, Scheduler
-from symmetry_tpu.protocol.keys import HostOp
+from symmetry_tpu.protocol.keys import HostOp, LinkOp
 from symmetry_tpu.provider.backends.base import (
     BackendDeadlineError,
     BackendError,
@@ -87,10 +87,29 @@ class TpuNativeBackend(InferenceBackend):
         self._prefill_clock_offset: float = 0.0
         self._prefill_stats_waiters: list[asyncio.Future] = []
         self._prefill_trace_waiters: list[asyncio.Future] = []
+        # --- cross-machine handoff link (tpu.disagg.peer) -------------
+        # NETWORK mode: the prefill tier is NOT a local subprocess but a
+        # PrefillNode (engine/disagg/node.py) reached over the handoff
+        # link (engine/disagg/net.py) — this backend runs only the
+        # decode host locally and dials the peer. `tpu.disagg.inline`
+        # self-hosts the node in-process (full wire path, one process:
+        # benches/smokes/tests). Link loss is a first-class failure:
+        # in-flight migrations shed structured-retryable and the link
+        # reconnects with backoff, independent of host supervision.
+        self._link = None            # DecodeLink in network mode
+        self._link_cfg = None
+        self._inline_node = None     # in-process PrefillNode
+        self._net_mode = False
         if self._disagg:
-            from symmetry_tpu.engine.disagg import HandoffBroker
+            from symmetry_tpu.engine.disagg import (
+                HandoffBroker, LinkConfig)
 
             self._broker = HandoffBroker()
+            self._broker.tracer.enabled = bool(
+                getattr(config.tpu, "tracing", True))
+            self._link_cfg = LinkConfig(
+                getattr(config.tpu, "disagg", None))
+            self._net_mode = self._link_cfg.network_mode
         self._started = False
         self._host_dead = False
         self._engine_alive = True  # host-reported scheduler liveness
@@ -164,6 +183,12 @@ class TpuNativeBackend(InferenceBackend):
     def _process_mode(self) -> bool:
         return getattr(self._config.tpu, "engine_isolation",
                        "process") == "process"
+
+    @property
+    def _local_pair(self) -> bool:
+        """Disagg with BOTH tiers as local subprocesses (PR 7's shape);
+        network mode replaces the prefill side with the handoff link."""
+        return self._disagg and not self._net_mode
 
     async def start(self) -> None:
         """Load weights and start the engine (may take minutes for large
@@ -250,15 +275,20 @@ class TpuNativeBackend(InferenceBackend):
         if self._disagg:
             from symmetry_tpu.engine.disagg import derive_role_config
 
-            # Two derived config files, one per tier (the decode one is
-            # the PRIMARY self._cfg_path — stats/liveness target).
+            # The decode tier is always the PRIMARY self._cfg_path
+            # (stats/liveness target). The prefill config file exists
+            # only for the local pair — in network mode the prefill
+            # tier derives its own config on its own machine.
             self._cfg_path = write_cfg(derive_role_config(cfg, "decode"))
-            self._prefill_cfg_path = write_cfg(
-                derive_role_config(cfg, "prefill"))
+            if self._local_pair:
+                self._prefill_cfg_path = write_cfg(
+                    derive_role_config(cfg, "prefill"))
         else:
             self._cfg_path = write_cfg(cfg)
         self._host_down = asyncio.Event()
         await self._spawn_host()
+        if self._net_mode:
+            await self._start_link()
         if self._sup_enabled:
             self._supervisor = asyncio.get_running_loop().create_task(
                 self._supervise())
@@ -309,7 +339,7 @@ class TpuNativeBackend(InferenceBackend):
         self._host_dead = False
         self._engine_alive = True
         self._proc = await self._spawn_one(self._cfg_path)
-        if self._disagg:
+        if self._local_pair:
             self._prefill_proc = await self._spawn_one(
                 self._prefill_cfg_path)
         await self._await_ready(
@@ -317,10 +347,14 @@ class TpuNativeBackend(InferenceBackend):
         self._clock_offset = await self._clock_handshake(self._proc)
         self._reader = asyncio.get_running_loop().create_task(
             self._read_events())
-        if self._disagg:
+        if self._local_pair:
             await self._await_ready(self._prefill_proc, "prefill host")
             self._prefill_clock_offset = await self._clock_handshake(
                 self._prefill_proc)
+            # The broker's wire-leg split maps the prefill host's
+            # handoff emit stamps through this measured offset.
+            self._broker.prefill_clock_offset = \
+                self._prefill_clock_offset
             self._prefill_reader = asyncio.get_running_loop().create_task(
                 self._read_prefill_events())
             log.info(
@@ -332,6 +366,109 @@ class TpuNativeBackend(InferenceBackend):
                  f"{', disagg pair' if self._disagg else ''}): "
                  f"model={self._model_name} "
                  f"clock_offset={self._clock_offset * 1e6:+.0f}us")
+
+    # ------------------------------------------------- handoff link (net)
+
+    async def _start_link(self) -> None:
+        """Network-mode startup: optional inline PrefillNode, then the
+        DecodeLink dial loop. A peer that is not up yet is NOT fatal —
+        the link keeps reconnecting with backoff and submits shed
+        retryable until it lands (static pairing means the operator
+        brings the prefill machine up on its own schedule)."""
+        from symmetry_tpu.engine.disagg.net import DecodeLink, LinkError
+
+        peer = self._link_cfg.peer
+        if self._link_cfg.inline:
+            from symmetry_tpu.engine.disagg.node import PrefillNode
+
+            self._inline_node = PrefillNode(self._config, listen=peer)
+            await self._inline_node.start()
+            # tcp://host:0 resolved to the real bound port.
+            self._link_cfg.peer = self._inline_node.address
+        self._link = DecodeLink(
+            self._link_cfg,
+            on_handoff=self._link_handoff,
+            on_event=self._link_event,
+            on_fail=self._link_fail,
+            on_down=self._link_down)
+        try:
+            await self._link.start(
+                wait_s=min(self._spawn_timeout_s, 120.0))
+        except LinkError as exc:
+            log.warning(f"{exc}; continuing — submits shed retryable "
+                        f"until the link connects")
+
+    async def _link_handoff(self, meta: dict, frame: bytes) -> None:
+        """A complete, CRC-verified handoff frame off the link → the
+        decode host's adopt path. Raising here naks the transfer (the
+        sender retries); the ack only goes out after this returns, so
+        the decode host's stdin write is inside the link's ack/credit
+        backpressure loop."""
+        import base64
+
+        handoff = {"id": meta.get("id"), "p": int(meta.get("p", 0)),
+                   "prompt_len": meta.get("prompt_len"),
+                   "nbytes": len(frame),
+                   "frame": base64.b64encode(frame).decode("ascii")}
+        if "wire_s" in meta:
+            handoff["wire_s"] = meta["wire_s"]
+        adopt = self._broker.adopt_op(handoff)
+        if adopt is None:
+            return  # request already cancelled/failed — drop the frame
+        try:
+            await self._host_send(adopt)
+        except (ConnectionError, OSError):
+            # The DECODE host's pipe failed (it is dying/respawning) —
+            # a nak would make the sender retransmit the whole frame
+            # at a problem that is local, and the retry would find the
+            # broker entry already consumed and be ACKed as delivered
+            # while adopting nothing. Ack the wire leg (it WAS
+            # delivered intact) and shed the request retryable; the
+            # host death path is about to shed every stream anyway.
+            self._shed_request(
+                str(meta.get("id", "")),
+                "decode host unavailable for adoption")
+
+    def _link_event(self, msg: dict) -> None:
+        """Prefill-tier terminal events arriving over the link
+        (tokenization/admission errors, deadline sheds) — same routing
+        as the local pair's _read_prefill_events."""
+        events = (msg.get("events")
+                  if msg.get("op") == HostOp.EVENTS else [msg])
+        if not isinstance(events, list):
+            return
+        for ev in events:
+            if not isinstance(ev, dict):
+                continue
+            req_id = str(ev.get("id", ""))
+            if ev.get("done"):
+                self._broker.forget(req_id)
+            q = self._queues.get(req_id)
+            if q is not None:
+                q.put_nowait(ev)
+
+    def _shed_request(self, req_id: str, error: str) -> None:
+        """One in-flight request → the structured RETRYABLE restarting
+        shed (clients fail over / retry; the link or tier that failed
+        is already recovering)."""
+        self._broker.forget(req_id)
+        q = self._queues.get(req_id)
+        if q is not None:
+            q.put_nowait({"op": HostOp.EVENT, "id": req_id, "text": "",
+                          "done": True, "finish_reason": "error",
+                          "restarting": True, "error": error})
+
+    def _link_fail(self, req_id: str, reason: str) -> None:
+        self._shed_request(
+            req_id, f"handoff failed on the link: {reason or 'unknown'}")
+
+    def _link_down(self, reason: str) -> None:
+        """The handoff link died (cable pull, peer restart, injected
+        drop): every migration still in flight is shed retryable —
+        never hung — while already-adopted streams keep decoding and
+        the DecodeLink reconnects with backoff."""
+        for req_id in self._broker.shed_pending():
+            self._shed_request(req_id, f"handoff link lost: {reason}")
 
     async def _clock_handshake(self, proc: asyncio.subprocess.Process,
                                rounds: int = 5) -> float:
@@ -566,6 +703,15 @@ class TpuNativeBackend(InferenceBackend):
                 await self._supervisor
             self._supervisor = None
         self._restarting = False
+        # Handoff link first (network mode): no new handoff may land on
+        # a decode host that is about to drain. The inline node owns
+        # its own prefill host shutdown.
+        if self._link is not None:
+            await self._link.stop()
+            self._link = None
+        if self._inline_node is not None:
+            await self._inline_node.stop()
+            self._inline_node = None
         # Prefill host first (disagg): it holds no streams, and stopping
         # it before the decode host means no handoff can land on a
         # half-shut pipe.
@@ -635,9 +781,11 @@ class TpuNativeBackend(InferenceBackend):
                 silent_death = (proc.returncode is not None
                                 or self._reader is None
                                 or self._reader.done())
-                if self._disagg and not silent_death:
+                if self._local_pair and not silent_death:
                     # The pair is one unit: a dead prefill host/reader
-                    # is the same failure as a dead decode one.
+                    # is the same failure as a dead decode one. (In
+                    # network mode the prefill tier is supervised on
+                    # ITS machine; the link owns that failure domain.)
                     pp = self._prefill_proc
                     silent_death = (pp is None or pp.returncode is not None
                                     or self._prefill_reader is None
@@ -659,7 +807,7 @@ class TpuNativeBackend(InferenceBackend):
                 msg = await self._probe_host_stats(
                     timeout=self._wedge_timeout_s)
                 alive = msg is not None and self._engine_alive
-                if alive and self._disagg and self._started:
+                if alive and self._local_pair and self._started:
                     # Decode tier answered — the prefill tier must too,
                     # with a LIVE scheduler thread (a wedged or engine-
                     # dead prefill host means every new request queues
@@ -870,7 +1018,17 @@ class TpuNativeBackend(InferenceBackend):
                 # with role-prefixed component names so the merged
                 # timeline shows two distinct process rows (satellite
                 # contract: per-role trace rows, not unified-mode ones).
-                pmsg = await self._probe_prefill_trace()
+                # In network mode the rings cross the LINK and the link
+                # handshake offset reconciles the other MACHINE's clock.
+                if self._net_mode:
+                    link = self._link
+                    pmsg = (await link.probe(LinkOp.TRACE)
+                            if link is not None and link.connected
+                            else None)
+                    offset = link.clock_offset if link is not None else 0.0
+                else:
+                    pmsg = await self._probe_prefill_trace()
+                    offset = self._prefill_clock_offset
                 for comp in (pmsg or {}).get("components") or []:
                     if isinstance(comp, dict):
                         comps.append({
@@ -878,7 +1036,11 @@ class TpuNativeBackend(InferenceBackend):
                             "name": f"prefill_{comp.get('name', 'host')}",
                             "clock_offset_s":
                                 float(comp.get("clock_offset_s", 0.0))
-                                + self._prefill_clock_offset})
+                                + offset})
+                # The wire leg itself: one span per handoff frame,
+                # already on THIS process's clock.
+                comps.append(
+                    self._broker.tracer.component("handoff_link"))
             return comps
         if self._scheduler is not None:
             trace_export = getattr(self._scheduler, "trace_export", None)
@@ -910,16 +1072,40 @@ class TpuNativeBackend(InferenceBackend):
             if sup:
                 out["supervisor"] = sup
             if self._disagg:
-                # The handoff ledger (broker counters + prefill-tier
-                # latency percentiles) and the prefill host's own
-                # breakdown, nested so a capture can attribute a slow
-                # TTFT to prefill-tier admission vs handoff vs decode-
-                # tier adoption — the disagg analog of the stage hists.
+                # The handoff ledger (broker counters, prefill-tier
+                # latency percentiles, the wire-leg split) and the
+                # prefill host's own breakdown, nested so a capture can
+                # attribute a slow TTFT to prefill-tier admission vs
+                # handoff serialize vs WIRE vs decode-tier adoption —
+                # the disagg analog of the stage hists.
                 disagg: dict = self._broker.stats()
-                pmsg = await self._probe_prefill_stats()
-                if pmsg is not None:
-                    disagg["prefill_host"] = {
-                        k: v for k, v in pmsg.items() if k != "op"}
+                if self._net_mode:
+                    link = self._link
+                    if link is not None:
+                        reply = (await link.probe(LinkOp.STATS)
+                                 if link.connected else None)
+                        if reply:
+                            host = reply.get("host")
+                            if isinstance(host, dict):
+                                disagg["prefill_host"] = {
+                                    k: v for k, v in host.items()
+                                    if k != "op"}
+                            if isinstance(reply.get("node"), dict):
+                                # Prefill-node-side link counters:
+                                # sender retries, credit stalls/wall,
+                                # handoffs pumped, host restarts.
+                                disagg["node"] = reply["node"]
+                        disagg["link"] = {
+                            **link.stats,
+                            "connected": link.connected,
+                            "clock_offset_s": round(
+                                link.clock_offset, 6),
+                            **link.reassembly_stats}
+                else:
+                    pmsg = await self._probe_prefill_stats()
+                    if pmsg is not None:
+                        disagg["prefill_host"] = {
+                            k: v for k, v in pmsg.items() if k != "op"}
                 out["disagg"] = disagg
             return out
         if self._scheduler is None:
@@ -944,7 +1130,7 @@ class TpuNativeBackend(InferenceBackend):
             if (self._proc is None or self._host_dead
                     or self._proc.returncode is not None):
                 return False
-            if self._disagg and (
+            if self._local_pair and (
                     self._prefill_proc is None
                     or self._prefill_proc.returncode is not None):
                 return False
@@ -1073,7 +1259,7 @@ class TpuNativeBackend(InferenceBackend):
                 "engine host unavailable (circuit breaker open)")
         down = (self._restarting or self._host_dead or self._proc is None
                 or self._proc.returncode is not None)
-        if not down and self._disagg:
+        if not down and self._local_pair:
             down = (self._prefill_proc is None
                     or self._prefill_proc.returncode is not None)
         if down:
@@ -1082,6 +1268,13 @@ class TpuNativeBackend(InferenceBackend):
                     "engine host restarting",
                     retry_after_s=self._restart_eta_s())
             raise BackendError("engine host exited")
+        if self._net_mode and (self._link is None
+                               or not self._link.connected):
+            # Link down is ALWAYS a retryable shed (the reconnect loop
+            # is already running), independent of host supervision.
+            raise BackendRestartingError(
+                "handoff link down (reconnecting)",
+                retry_after_s=self._link_cfg.reconnect_base_s * 2)
 
     async def _stream_host(self, request: InferenceRequest, request_id: str,
                            created: int, max_new: int
@@ -1115,9 +1308,16 @@ class TpuNativeBackend(InferenceBackend):
                 if self._disagg:
                     # Disagg: new work enters through the PREFILL tier;
                     # the broker keeps the state the decode tier will
-                    # need when the handoff frame comes back.
+                    # need when the handoff frame comes back. Network
+                    # mode sends the submit over the handoff link (a
+                    # LinkError is a ConnectionError — the handler
+                    # below turns it into the retryable shed).
                     self._broker.note_submit(request_id, submit)
-                    await self._host_send(submit, proc=self._prefill_proc)
+                    if self._net_mode:
+                        await self._link.submit(submit)
+                    else:
+                        await self._host_send(submit,
+                                              proc=self._prefill_proc)
                 else:
                     await self._host_send(submit)
             except (ConnectionError, OSError):
@@ -1189,6 +1389,12 @@ class TpuNativeBackend(InferenceBackend):
 
                 if self._broker is not None:
                     self._broker.forget(request_id)
+                if self._net_mode and self._link is not None:
+                    # The request may still be queued/prefilling on the
+                    # REMOTE tier — cancel travels the link.
+                    with contextlib.suppress(ConnectionError, OSError):
+                        await self._link.cancel(
+                            {"op": HostOp.CANCEL, "id": request_id})
                 for proc in (self._proc, self._prefill_proc):
                     if proc is None or proc.returncode is not None:
                         continue

@@ -133,6 +133,27 @@ class TpuConfig:
     # {...}}, each a mapping merged into that tier's tpu section; the
     # special key "faults" inside a tier lands as that HOST's top-level
     # faults mapping (chaos-test one tier of the pair).
+    #
+    # CROSS-MACHINE keys (engine/disagg/net.py — the handoff link):
+    #   peer: "tcp://host:port"   decode/provider side: dial the prefill
+    #                             node there instead of spawning a local
+    #                             prefill host (NETWORK mode)
+    #   listen: "tcp://0.0.0.0:port"  prefill-node side (node.py): bind
+    #   inline: bool = false      backend self-hosts the PrefillNode
+    #                             in-process and dials it at `peer` —
+    #                             the full wire path in one provider
+    #                             (bench --disagg-transport, CI smoke)
+    #   chunk_kb: int = 1024      handoff chunk size on the link
+    #   credit_mb: float = 64     receiver credit window (bounds
+    #                             in-flight bytes; exhaustion throttles
+    #                             prefill admissions via the sink)
+    #   ack_timeout_s: float = 30 unacked transfer → retransmit
+    #   max_retries: int = 2      then the request sheds retryable
+    #   reconnect_base_s/reconnect_max_s   link redial backoff
+    #   encrypt: bool = false     Noise handshake on the link (needs the
+    #                             `cryptography` dependency); optional
+    #   secret: str               identity seed name; peer_key: hex —
+    #                             pin the expected remote static key
     disagg: dict[str, Any] | None = None
     # Engine-host supervision (process isolation only): a heartbeat
     # watchdog piggybacked on the host stats op detects crashes AND

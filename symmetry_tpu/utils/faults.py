@@ -19,6 +19,15 @@ Instrumented seams (grep for `FAULTS.point` / `FAULTS.apoint`):
     disagg.handoff     prefill-tier handoff frame emit (crash = the
                        prefill host dies with KV built but unshipped;
                        drop_frame = the request silently vanishes)
+    disagg.net.send    handoff-link message egress (drop_frame = one
+                       link message lost; error/hang = a flaky wire)
+    disagg.net.recv    handoff-link message ingress (same actions,
+                       receive side)
+    disagg.net.drop_link  hit once per handoff transfer attempt, after
+                       its first chunk; drop_frame = hard-cut the link
+                       mid-handoff (a deterministic cable pull — the
+                       decode tier must discard the partial frame,
+                       shed in-flight migrations retryable, reconnect)
 
 Actions:
 

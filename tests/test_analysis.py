@@ -98,6 +98,104 @@ class TestWireContract:
         })
         assert run(root) == []
 
+    def test_link_op_group_mismatch_and_raw_literal(self, tmp_path):
+        """The handoff-link protocol (LinkOp, engine/disagg/net.py +
+        node.py) gets the same W101–W104 discipline over its own group
+        — and LinkOp's deliberate HostOp value reuse must NOT leak
+        across registries (a LinkOp.X reference is invisible to the
+        HostOp scan and vice versa)."""
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/protocol/keys.py": (
+                KEYS_PY + '\n\n'
+                'class LinkOp:\n'
+                '    SUBMIT = "submit"\n'
+                '    BEGIN = "begin"\n'
+                '    CHUNK = "chunk"\n'),
+            # link producer: raw literal for a registered link op, plus
+            # an op consumed nowhere in the link group
+            "symmetry_tpu/engine/disagg/net.py": (
+                'from symmetry_tpu.protocol.keys import LinkOp\n'
+                'async def send(link):\n'
+                '    await link.send({"op": "begin", "xfer": "x"})\n'
+                '    await link.send({"op": LinkOp.CHUNK, "seq": 0})\n'),
+            "symmetry_tpu/engine/disagg/node.py": (
+                'from symmetry_tpu.protocol.keys import LinkOp\n'
+                'def pump(header):\n'
+                '    op = header.get("op")\n'
+                '    if op == LinkOp.CHUNK:\n'
+                '        return header\n'
+                '    if op == LinkOp.SUBMIT:\n'
+                '        return header\n'),
+        })
+        fs = [f for f in run(root) if f.checker == "wire-contract"]
+        got = codes(fs)
+        assert "W101" in got     # raw "begin" literal in the link group
+        assert "W102" in got     # begin produced, never consumed
+        assert "W103" in got     # submit consumed, never produced
+        w103 = {f.symbol for f in fs if f.code == "W103"}
+        # "submit" is unmatched in the LINK group even though HostOp
+        # also registers the value — the registries do not cross-talk.
+        assert "submit" in w103
+
+    def test_link_op_group_clean_with_constants(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/protocol/keys.py": (
+                KEYS_PY + '\n\n'
+                'class LinkOp:\n'
+                '    BEGIN = "begin"\n'),
+            "symmetry_tpu/engine/disagg/net.py": (
+                'from symmetry_tpu.protocol.keys import LinkOp\n'
+                'async def send(link):\n'
+                '    await link.send({"op": LinkOp.BEGIN})\n'),
+            "symmetry_tpu/engine/disagg/node.py": (
+                'from symmetry_tpu.protocol.keys import LinkOp\n'
+                'def pump(header):\n'
+                '    op = header.get("op")\n'
+                '    if op == LinkOp.BEGIN:\n'
+                '        return header\n'),
+        })
+        assert [f for f in run(root) if f.checker == "wire-contract"] \
+            == []
+
+    def test_real_link_registry_fully_covered(self):
+        """Registry-coverage pin on the REAL repo: every LinkOp constant
+        is BOTH produced (a `{"op": LinkOp.X}` dict display) and
+        consumed (a compare/membership against LinkOp.X) somewhere in
+        the link group — an op that loses either side fails here before
+        it strands a handoff on the wire."""
+        import ast
+
+        from symmetry_tpu.protocol.keys import LINK_OPS, LinkOp
+
+        def link_attrs(node):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Attribute) and \
+                        isinstance(sub.value, ast.Name) and \
+                        sub.value.id == "LinkOp":
+                    yield sub.attr
+
+        produced: set[str] = set()
+        consumed: set[str] = set()
+        for rel in ("symmetry_tpu/engine/disagg/net.py",
+                    "symmetry_tpu/engine/disagg/node.py"):
+            with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+                tree = ast.parse(fh.read())
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Dict):
+                    for k, v in zip(node.keys, node.values):
+                        if (isinstance(k, ast.Constant)
+                                and k.value == "op"):
+                            produced.update(link_attrs(v))
+                elif isinstance(node, ast.Compare):
+                    consumed.update(link_attrs(node))
+        names = {k for k in vars(LinkOp) if not k.startswith("_")}
+        assert produced >= names, \
+            f"LinkOp constants never produced: {names - produced}"
+        assert consumed >= names, \
+            f"LinkOp constants never consumed: {names - consumed}"
+        assert len(LINK_OPS) == len(names), \
+            "duplicate LinkOp values would alias wire ops"
+
     def test_nonexistent_registry_attribute_flags(self, tmp_path):
         # HostOp.EVNT (typo'd CONSTANT, not value) must flag, not vanish
         # from the consumed set: at runtime it is an AttributeError on a

@@ -1,5 +1,6 @@
 """CI disagg smoke: two-host prefill→decode handoff, then a prefill
-crash that completes via the restarting-shed/failover path.
+crash that completes via the restarting-shed/failover path, then the
+CROSS-MACHINE link variant with an injected mid-handoff link drop.
 
 The provider's tpu_native backend runs in `tpu.role: disagg` — a REAL
 prefill engine host and a REAL decode engine host (tiny CPU preset, own
@@ -23,7 +24,19 @@ them — and the smoke asserts:
   request 1 then dies on request 2's handoff; life 2 serves the retry —
   its first handoff — untouched.)
 
-Two modes, same contracts:
+  phase 3 (TCP link chaos, always runs after either mode): the backend
+  runs in NETWORK mode (`tpu.disagg.peer` + inline PrefillNode) — the
+  tiers connected ONLY through the chunked/credit-gated handoff link
+  over real TCP loopback (engine/disagg/net.py). Request 1 proves the
+  happy path and the wire-split stats (wire_frames/wire_s beside the
+  prefill host's serialize_s). Then `disagg.net.drop_link=drop_frame@
+  nth=2` cuts the link mid-handoff on request 2: the decode tier must
+  DISCARD the partial transfer (zero partial adoptions — the decode
+  host's adopt error counter stays 0), shed the in-flight request
+  structured-retryable, reconnect with backoff, and complete the retry
+  on the re-established link.
+
+Two modes for phases 1–2, same contracts:
   - full path (default): client → server → provider over the in-memory
     transport, recovery via client failover (ChatRestart sentinel);
   - backend-direct (fallback when the `cryptography` network dependency
@@ -255,6 +268,99 @@ async def run_network() -> int:
     return 0
 
 
+async def run_link_chaos() -> int:
+    """Phase 3: the two tiers joined ONLY by the TCP handoff link, with
+    a mid-handoff link drop injected via the disagg.net.drop_link seam."""
+    from symmetry_tpu.provider.backends.base import (
+        BackendRestartingError, InferenceRequest)
+    from symmetry_tpu.provider.backends.tpu_native import TpuNativeBackend
+    from symmetry_tpu.provider.config import ConfigManager
+    from symmetry_tpu.utils.faults import FAULTS
+
+    cfg = provider_config_dict()
+    cfg["name"] = "disagg-link-prov"
+    # Network mode: inline PrefillNode over real TCP loopback; small
+    # chunks so every handoff is genuinely multi-chunk on the wire; no
+    # per-tier handoff-crash fault here (that was phases 1–2).
+    cfg["tpu"]["disagg"] = {"peer": "tcp://127.0.0.1:0", "inline": True,
+                            "chunk_kb": 4, "reconnect_base_s": 0.2}
+    # The drop_link seam counts one hit per transfer attempt (fired
+    # after the first chunk): request 1's handoff is hit 1 (clean),
+    # request 2's handoff is hit 2 → the cable pull, mid-transfer.
+    FAULTS.load({"disagg.net.drop_link": "drop_frame@nth=2"})
+
+    async def collect(backend, content):
+        text = []
+        async for chunk in backend.stream(InferenceRequest(
+                messages=[{"role": "user", "content": content}],
+                max_tokens=8, temperature=0.0)):
+            if chunk.text:
+                text.append(chunk.text)
+        return "".join(text)
+
+    backend = TpuNativeBackend(ConfigManager(config=cfg))
+    try:
+        await backend.start()
+
+        # happy path over the wire + the serialize-vs-wire split
+        text1 = await collect(backend, PROMPT)
+        assert text1, "link phase streamed no text"
+        dg = assert_phase1_stats(await backend.engine_stats())
+        assert dg.get("wire_frames", 0) >= 1, f"no wire split: {dg}"
+        assert (dg.get("wire_s") or {}).get("count", 0) >= 1
+        assert dg.get("wire_bytes", 0) > 0
+        ho = ((dg.get("prefill_host") or {}).get("handoff") or {})
+        assert ho.get("serialize_s", 0) > 0, \
+            "serialize wall missing beside the wire split"
+        link = dg.get("link") or {}
+        assert link.get("connected") is True, f"link stats: {link}"
+        node = dg.get("node") or {}
+        assert node.get("handoffs_sent", 0) >= 1, f"node stats: {node}"
+        print(f"disagg smoke: link phase streamed {len(text1)} chars "
+              f"over TCP; wire p50 "
+              f"{(dg.get('wire_s') or {}).get('p50')}s beside "
+              f"serialize {ho.get('serialize_s')}s")
+
+        # mid-handoff link drop → retryable shed → reconnect → retry
+        shed = False
+        try:
+            await collect(backend, PROMPT + " once more")
+        except BackendRestartingError:
+            shed = True
+        assert shed, "link drop did not shed the in-flight request"
+        text2 = None
+        for _ in range(200):  # retry through the reconnect window
+            try:
+                text2 = await collect(backend, PROMPT + " once more")
+                break
+            except BackendRestartingError:
+                await asyncio.sleep(0.25)
+        assert text2, "retry never completed on the re-dialed link"
+        stats = await backend.engine_stats()
+        dg = stats.get("disagg") or {}
+        link = dg.get("link") or {}
+        assert link.get("connects", 0) >= 2, f"no reconnect: {link}"
+        assert link.get("drops", 0) >= 1, f"no drop recorded: {link}"
+        assert link.get("partial_discards", 0) >= 1, \
+            f"partial transfer not discarded: {link}"
+        # ZERO partial adoptions: the decode host only ever saw intact,
+        # CRC-verified frames (its adopt path booked no errors).
+        ad = stats.get("adopt") or {}
+        assert ad.get("errors", 0) == 0, f"decode host adopt stats: {ad}"
+        sup = stats.get("supervisor") or {}
+        assert sup.get("restarts", 0) == 0, \
+            f"link loss must not restart the decode host: {sup}"
+        print(f"disagg smoke: link phase drop → shed → reconnect "
+              f"(connects={link.get('connects')}, "
+              f"drops={link.get('drops')}, partial_discards="
+              f"{link.get('partial_discards')}) → retry completed "
+              f"{len(text2)} chars; zero partial adoptions")
+    finally:
+        await backend.stop()
+        FAULTS.clear()
+    return 0
+
+
 def main() -> int:
     try:
         import cryptography  # noqa: F401 — wire-path dependency probe
@@ -265,9 +371,13 @@ def main() -> int:
               "backend-direct mode (same two-host contracts, no wire)",
               file=sys.stderr)
         runner = run_backend_direct()
+    loop = asyncio.new_event_loop()
     try:
-        return asyncio.new_event_loop().run_until_complete(
-            asyncio.wait_for(runner, 900))
+        rc = loop.run_until_complete(asyncio.wait_for(runner, 900))
+        if rc == 0:
+            rc = loop.run_until_complete(
+                asyncio.wait_for(run_link_chaos(), 900))
+        return rc
     except AssertionError as exc:
         print(f"disagg smoke FAILED: {exc}", file=sys.stderr)
         return 1
