@@ -291,7 +291,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             fused_dequant: bool = False, trace_out: str | None = None,
             tracing: bool = True, disagg: bool = False,
             disagg_transport: str | None = None,
-            multi_turn: int = 1) -> dict:
+            multi_turn: int = 1,
+            metrics_out: str | None = None) -> dict:
     """The NORTH-STAR measurement (BASELINE.json metric): aggregate WIRE
     tok/s and p50/p99 TTFT through the full serving path — server +
     tpu_native provider + N concurrent streaming clients over TCP
@@ -609,6 +610,7 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
 
         engine_stats: dict | None = None
         provider_stats: dict | None = None
+        metrics_block: dict | None = None
         # Engine build + warmup runs in the provider process (minutes for
         # 8B cold: weight init + XLA compiles); none of it counts toward
         # the measured window. Registration marks readiness. The log fh is
@@ -723,6 +725,23 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                     try:
                         provider_stats = await stats_session.stats()
                         engine_stats = provider_stats.get("engine")
+                        # Final metrics-registry snapshot (the stats
+                        # reply's tier-labeled `metrics` block): inlined
+                        # into the bench JSON so every BENCH_r*.json is
+                        # self-describing, and optionally its own file
+                        # (--metrics-out) for offline diffing.
+                        metrics_block = provider_stats.get("metrics")
+                        if metrics_out and metrics_block:
+                            with open(metrics_out, "w") as mf:
+                                json.dump(metrics_block, mf, indent=1)
+                            n_fams = sum(
+                                len(s.get("snapshot", {})
+                                    .get("families") or {})
+                                for s in metrics_block.get("snapshots",
+                                                           []))
+                            print(f"[bench] metrics snapshot → "
+                                  f"{metrics_out} ({n_fams} families)",
+                                  file=sys.stderr)
                         if trace_out:
                             # Distributed-trace capture (utils/trace.py):
                             # one traced request measures the session's
@@ -1204,6 +1223,9 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             # breakdown lands in the JSON capture, not just stderr text.
             **({"ttft_stages": ttft_stages} if ttft_stages else {}),
             **({"engine": diag} if diag else {}),
+            # Final metrics-registry snapshot (tier-labeled): the bench
+            # artifact carries the fleet-telemetry cut it ended with.
+            **({"metrics": metrics_block} if metrics_block else {}),
         }
 
     return asyncio.new_event_loop().run_until_complete(main())
@@ -1470,6 +1492,13 @@ def main() -> None:
                          "(tpu.tracing=false). The tracing-overhead A/B "
                          "is this flag on vs off at otherwise identical "
                          "settings; acceptance: within 1%% tok/s")
+    ap.add_argument("--metrics-out", default=None, metavar="PATH",
+                    help="write the provider's final metrics-registry "
+                         "snapshot (tier-labeled JSON, utils/metrics.py "
+                         "shape) beside the run; the same snapshot is "
+                         "inlined under the result's `metrics` block "
+                         "either way, so BENCH_r*.json artifacts are "
+                         "self-describing (--e2e)")
     ap.add_argument("--e2e-client-worker", action="store_true",
                     help=argparse.SUPPRESS)  # internal: one fleet shard
     args = ap.parse_args()
@@ -1602,7 +1631,8 @@ def main() -> None:
                 trace_out=args.trace_out, tracing=not args.no_trace,
                 disagg=args.disagg,
                 disagg_transport=args.disagg_transport,
-                multi_turn=args.multi_turn)
+                multi_turn=args.multi_turn,
+                metrics_out=args.metrics_out)
 
         try:
             result = e2e_attempt(args.max_seq, args.max_new)

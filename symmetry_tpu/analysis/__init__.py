@@ -1,12 +1,13 @@
 """symlint: project-invariant static analysis (see tools/symlint.py).
 
-Four AST checkers over the repo, each making one runtime invariant
+Five AST checkers over the repo, each making one runtime invariant
 statically checkable:
 
   wire-contract     host-pipe op / MessageKey producer↔consumer sets
   concurrency       cross-thread mutation locks; blocking-in-async
   recompile-hazard  value syncs / data branches inside jit traces
   fault-seam        SYMMETRY_FAULTS arming ↔ FAULTS.point guards
+  metric-names      MetricName registry ↔ METRICS emission sites
 
 Run via `python tools/symlint.py` (text or --json, --baseline
 suppression file, exit 1 on non-baselined findings). The suite is also
@@ -19,6 +20,7 @@ from __future__ import annotations
 from symmetry_tpu.analysis import (
     concurrency,
     fault_seams,
+    metric_names,
     recompile,
     wire_contract,
 )
@@ -35,6 +37,7 @@ ALL_CHECKERS: tuple[CheckerSpec, ...] = (
     concurrency.SPEC,
     recompile.SPEC,
     fault_seams.SPEC,
+    metric_names.SPEC,
 )
 
 

@@ -29,6 +29,7 @@ import time
 from typing import Any
 
 from symmetry_tpu.protocol.keys import HostOp
+from symmetry_tpu.utils.metrics import METRICS, MetricName
 from symmetry_tpu.utils.trace import Histogram, Tracer
 
 # The decode tier adopts handoff frames through its prefix store; a
@@ -106,6 +107,21 @@ class HandoffBroker:
         # pipe/link between the prefill tier's rows and the decode
         # tier's adopt_dispatch rows.
         self.tracer = Tracer()
+        # Always-on registry series (utils/metrics.py, provider-process
+        # registry): the handoff ledger as scrape-able families beside
+        # the stats() snapshot.
+        self._m_frames = METRICS.counter(
+            MetricName.HANDOFF_FRAMES, "handoff frames migrated")
+        self._m_bytes = METRICS.counter(
+            MetricName.HANDOFF_BYTES, "handoff frame bytes migrated")
+        self._m_pending = METRICS.gauge(
+            MetricName.HANDOFF_PENDING,
+            "requests submitted to the prefill tier, frame not yet back")
+        self._m_wire = METRICS.histogram(
+            MetricName.HANDOFF_WIRE, "handoff wire leg per frame")
+        self._m_prefill_tier = METRICS.histogram(
+            MetricName.HANDOFF_PREFILL_TIER,
+            "prefill-tier residency per request (submit to frame back)")
 
     # ------------------------------------------------------------- state
 
@@ -118,18 +134,21 @@ class HandoffBroker:
                 if k in submit}
         self._pending[request_id] = (keep, time.monotonic())
         self.counters["submitted"] += 1
+        self._m_pending.set(len(self._pending))
 
     def forget(self, request_id: str) -> None:
         """The request ended on the prefill tier (tokenization error,
         admission error, deadline shed, cancel) — nothing to migrate."""
         if self._pending.pop(request_id, None) is not None:
             self.counters["dropped"] += 1
+            self._m_pending.set(len(self._pending))
 
     def fail_all(self) -> None:
         """Host pair is going down: every pending migration is dead (the
         streams are failed by the backend's shed path)."""
         self.counters["dropped"] += len(self._pending)
         self._pending.clear()
+        self._m_pending.set(0)
 
     def shed_pending(self) -> list[str]:
         """The handoff LINK died (network mode): every request whose
@@ -141,6 +160,7 @@ class HandoffBroker:
         ids = list(self._pending)
         self.counters["dropped"] += len(ids)
         self._pending.clear()
+        self._m_pending.set(0)
         return ids
 
     @property
@@ -162,9 +182,13 @@ class HandoffBroker:
         keep, t_submit = entry
         now = time.monotonic()
         self.prefill_tier_hist.observe(now - t_submit)
+        self._m_prefill_tier.observe(now - t_submit)
         self.counters["handoff_frames"] += 1
         nbytes = int(handoff.get("nbytes", 0))
         self.counters["handoff_bytes"] += nbytes
+        self._m_frames.inc()
+        self._m_bytes.inc(nbytes)
+        self._m_pending.set(len(self._pending))
         # Wire-leg split: either precomputed by the link receiver
         # ("wire_s", network mode — it holds the measured link offset)
         # or derived here from the prefill host's emit stamp ("t")
@@ -176,6 +200,7 @@ class HandoffBroker:
         if wire is not None:
             wire = float(wire)
             self.wire_hist.observe(wire)
+            self._m_wire.observe(wire)
             self.counters["wire_frames"] += 1
             self.counters["wire_bytes"] += nbytes
             self.counters["wire_s_total"] += wire

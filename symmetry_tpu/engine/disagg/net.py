@@ -76,6 +76,7 @@ from symmetry_tpu.protocol.keys import LinkOp
 from symmetry_tpu.transport.base import Connection, Transport
 from symmetry_tpu.utils.faults import FAULTS
 from symmetry_tpu.utils.logging import logger as log
+from symmetry_tpu.utils.metrics import METRICS, MetricName
 
 LINK_VERSION = 1
 MAGIC = b"SYLK"
@@ -344,6 +345,9 @@ class CreditGate:
         self._credit = window
         self._waiter: asyncio.Future | None = None
         self.stats = {"credit_stalls": 0, "credit_stall_s": 0.0}
+        self._m_stalls = METRICS.counter(
+            MetricName.LINK_CREDIT_STALLS,
+            "sender stalls on an exhausted credit window")
 
     @property
     def available(self) -> int:
@@ -372,6 +376,7 @@ class CreditGate:
             if stalled_at is None:
                 stalled_at = time.monotonic()
                 self.stats["credit_stalls"] += 1
+                self._m_stalls.inc()
             self._waiter = asyncio.get_running_loop().create_future()
             await self._waiter
         if stalled_at is not None:
@@ -392,6 +397,13 @@ class Reassembler:
     def __init__(self) -> None:
         self._bufs: dict[str, dict[str, Any]] = {}
         self.stats = {"partial_discards": 0, "stale_chunks": 0}
+        self._m_partials = METRICS.counter(
+            MetricName.LINK_PARTIAL_DISCARDS,
+            "partial/corrupt transfers discarded (never adopted)")
+
+    def _discard(self, n: int = 1) -> None:
+        self.stats["partial_discards"] += n
+        self._m_partials.inc(n)
 
     @property
     def active(self) -> int:
@@ -410,7 +422,7 @@ class Reassembler:
             # violation. Evict the oldest — its sender retries or fails.
             stale = next(iter(self._bufs))
             self._bufs.pop(stale)
-            self.stats["partial_discards"] += 1
+            self._discard()
         self._bufs[xfer] = {"buf": bytearray(), "total": total,
                             "next_seq": 0, "meta": header}
 
@@ -426,7 +438,7 @@ class Reassembler:
             # Out-of-order over an ordered transport = protocol bug or
             # corruption; kill the attempt, let the retry fix it.
             self._bufs.pop(str(header.get("xfer", "")), None)
-            self.stats["partial_discards"] += 1
+            self._discard()
             raise LinkError(
                 f"chunk seq {header.get('seq')} != expected "
                 f"{entry['next_seq']}")
@@ -434,7 +446,7 @@ class Reassembler:
         entry["buf"] += payload
         if len(entry["buf"]) > entry["total"]:
             self._bufs.pop(str(header.get("xfer", "")), None)
-            self.stats["partial_discards"] += 1
+            self._discard()
             raise LinkError("transfer overflow: more chunk bytes than "
                             "the begin header promised")
         return True
@@ -448,12 +460,12 @@ class Reassembler:
             raise LinkError(f"end for unknown transfer {xfer!r}")
         buf = bytes(entry["buf"])
         if len(buf) != entry["total"]:
-            self.stats["partial_discards"] += 1
+            self._discard()
             raise LinkError(f"transfer truncated: {len(buf)} of "
                             f"{entry['total']} bytes")
         crc = int(header.get("crc", -1))
         if zlib.crc32(buf) != crc:
-            self.stats["partial_discards"] += 1
+            self._discard()
             raise LinkError("transfer checksum mismatch")
         return entry["meta"], buf
 
@@ -461,7 +473,7 @@ class Reassembler:
         """Link died: discard every partial buffer. Returns the count —
         each was a handoff mid-flight whose request the caller sheds."""
         n = len(self._bufs)
-        self.stats["partial_discards"] += n
+        self._discard(n)
         self._bufs.clear()
         return n
 
@@ -484,6 +496,9 @@ class HandoffSender:
         self._acks: dict[str, asyncio.Future] = {}
         self.stats = {"handoffs_sent": 0, "handoff_bytes_sent": 0,
                       "retries": 0, "failed": 0}
+        self._m_retries = METRICS.counter(
+            MetricName.LINK_RETRIES,
+            "handoff transfer retransmissions performed")
 
     def on_ack(self, header: dict[str, Any], ok: bool) -> None:
         fut = self._acks.get(str(header.get("xfer", "")))
@@ -525,6 +540,7 @@ class HandoffSender:
                 # retries counts RETRANSMISSIONS actually performed —
                 # the stat the bench reads as wasted wire work.
                 self.stats["retries"] += 1
+                self._m_retries.inc()
             log.warning(f"handoff {req_id} attempt {attempt} "
                         f"unacked/nak'd; "
                         f"{'retrying' if retrying else 'giving up'}")
@@ -666,6 +682,18 @@ class DecodeLink:
             LinkOp.STATS: [], LinkOp.TRACE: []}
         self.stats = {"connects": 0, "drops": 0, "wire_frames": 0,
                       "wire_bytes": 0}
+        self._m_connects = METRICS.counter(
+            MetricName.LINK_CONNECTS, "handoff link connects")
+        self._m_drops = METRICS.counter(
+            MetricName.LINK_DROPS, "handoff link drops")
+        self._m_connected = METRICS.gauge(
+            MetricName.LINK_CONNECTED, "handoff link up (1) / down (0)")
+        self._m_wire_frames = METRICS.counter(
+            MetricName.LINK_WIRE_FRAMES,
+            "complete handoff frames received off the link")
+        self._m_wire_bytes = METRICS.counter(
+            MetricName.LINK_WIRE_BYTES,
+            "handoff frame bytes received off the link")
 
     # -------------------------------------------------------- lifecycle
 
@@ -785,6 +813,8 @@ class DecodeLink:
             self._link = link
             self._connected.set()
             self.stats["connects"] += 1
+            self._m_connects.inc()
+            self._m_connected.set(1)
             log.info(f"handoff link up: {link.remote_address} "
                      f"clock_offset={self.clock_offset * 1e6:+.0f}us")
             if self._on_up is not None:
@@ -799,6 +829,8 @@ class DecodeLink:
             self._connected.clear()
             self._link = None
             self.stats["drops"] += 1
+            self._m_drops.inc()
+            self._m_connected.set(0)
             shed = self._reasm.abort_all()
             for lst in self._waiters.values():
                 for fut in lst:
@@ -879,6 +911,8 @@ class DecodeLink:
             meta = {**meta, "wire_s": wire_s}
         self.stats["wire_frames"] += 1
         self.stats["wire_bytes"] += len(frame)
+        self._m_wire_frames.inc()
+        self._m_wire_bytes.inc(len(frame))
         xfer = str(header.get("xfer", ""))
         try:
             await self._on_handoff(meta, frame)

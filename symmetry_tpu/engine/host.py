@@ -35,6 +35,10 @@ Protocol: JSON lines.
             provider brackets our CLOCK_MONOTONIC read with its own —
             the NTP midpoint replaces the old assume-zero-offset policy)
            {"op": "trace"}   (span-ring snapshot for the Perfetto export)
+           {"op": "metrics"}   (metrics-registry snapshot probe: the
+            reply carries this process's utils/metrics.py families —
+            the provider merges them tier-labeled into its Prometheus
+            exposition and the peer-wire metrics reply)
            {"op": "stats"} | {"op": "shutdown"}
   stdout → {"op": "ready", "model": …}            (after warmup)
            {"op": "clock", "t0", "t": our monotonic at receipt}
@@ -53,6 +57,8 @@ Protocol: JSON lines.
             aligned KV prefix, serialized; p == 0 is routing-only — the
             prompt was too short for an aligned prefix and the decode
             tier prefills it whole)
+           {"op": "metrics", "role", "families": {…}}   (registry
+            snapshot, utils/metrics.py shape)
            {"op": "stats", …}   (scheduler counters incl. deferred_depth,
             prefill_jobs_active, the prefix_cache hit/miss/evict/bytes
             block when the shared-prefix KV cache is enabled, and the
@@ -93,7 +99,8 @@ from symmetry_tpu.engine.scheduler import GenRequest, Scheduler
 from symmetry_tpu.protocol.keys import HostOp
 from symmetry_tpu.provider.config import ConfigManager
 from symmetry_tpu.utils.faults import FAULTS
-from symmetry_tpu.utils.logging import logger
+from symmetry_tpu.utils.logging import logger, set_component
+from symmetry_tpu.utils.metrics import METRICS, MetricName
 from symmetry_tpu.utils.trace import Tracer
 
 if TYPE_CHECKING:
@@ -151,6 +158,32 @@ class EngineHost:
         self.adopt_stats = {"frames": 0, "bytes": 0, "adopted": 0,
                             "rejected": 0, "errors": 0,
                             "deserialize_s": 0.0}
+        # Always-on registry families (utils/metrics.py): this process's
+        # slice of the fleet time series, shipped to the provider via the
+        # HostOp.METRICS probe and tier-labeled there. `metrics.enabled:
+        # false` in the provider config disables the whole registry (the
+        # host reads the same config copy in start()).
+        self._m_pipe_writes = METRICS.counter(
+            MetricName.HOST_PIPE_WRITES, "host stdout frames written")
+        self._m_pipe_bytes = METRICS.counter(
+            MetricName.HOST_PIPE_BYTES, "host stdout bytes written")
+        self._m_pipe_events = METRICS.counter(
+            MetricName.HOST_PIPE_EVENTS, "token events carried on the pipe")
+        self._m_handoff_frames = METRICS.counter(
+            MetricName.HOST_HANDOFF_FRAMES,
+            "handoff frames emitted (prefill role)")
+        self._m_handoff_bytes = METRICS.counter(
+            MetricName.HOST_HANDOFF_BYTES, "handoff frame bytes emitted")
+        self._m_handoff_serialize = METRICS.histogram(
+            MetricName.HOST_HANDOFF_SERIALIZE,
+            "handoff extract+serialize wall per frame")
+        self._m_adopt_frames = METRICS.counter(
+            MetricName.HOST_ADOPT_FRAMES,
+            "handoff frames processed by the decode role",
+            labels=("outcome",))
+        self._m_adopt_deserialize = METRICS.histogram(
+            MetricName.HOST_ADOPT_DESERIALIZE,
+            "handoff decode+validate+insert wall per frame")
 
     # ---------------------------------------------------------------- wire
 
@@ -169,6 +202,10 @@ class EngineHost:
                 self.emit_stats["pipe_batched_frames"] += 1
             sys.stdout.write(line + "\n")
             sys.stdout.flush()
+        self._m_pipe_writes.inc()
+        self._m_pipe_bytes.inc(len(line) + 1)
+        if events:
+            self._m_pipe_events.inc(events)
         if events > 0:
             # Event frames only (one per block): the flush hold is the
             # "emit" leg of the TTFT chain, worth a span; ready/stats
@@ -260,6 +297,12 @@ class EngineHost:
         tracing = bool(getattr(self._config.tpu, "tracing", True))
         self.tracer.enabled = tracing
         self._scheduler.tracer.enabled = tracing
+        # Metrics registry gate (metrics.enabled: false → every registry
+        # op in this process is one branch) + the structured-log
+        # component tag for this process's records.
+        mcfg = self._config.get("metrics") or {}
+        METRICS.enabled = bool(mcfg.get("enabled", True))
+        set_component("host")
         self._scheduler.start()
         self._write({"op": HostOp.READY,
                      "model": self._config.model_name,
@@ -332,6 +375,8 @@ class EngineHost:
                     # injection actually happened.
                     m["faults"] = FAULTS.counters()
                 self._write(m)
+            elif op == HostOp.METRICS:
+                self._handle_metrics()
             elif op == HostOp.SHUTDOWN:
                 break
         self._scheduler.stop()
@@ -347,6 +392,13 @@ class EngineHost:
         negative cross-process spans to zero."""
         self._write({"op": HostOp.CLOCK, "t0": msg.get("t0"),
                      "t": time.monotonic()})
+
+    def _handle_metrics(self) -> None:
+        """Metrics-registry snapshot: this process's families (compact —
+        no recent-sample rings on the wire) plus the tier role, so the
+        provider can merge them tier-labeled into its exposition."""
+        snap = METRICS.snapshot(compact=True)
+        self._write({"op": HostOp.METRICS, "role": self._role, **snap})
 
     def _handle_trace(self) -> None:
         """Span-ring snapshot: this process's host + scheduler rings,
@@ -484,6 +536,9 @@ class EngineHost:
             if p == 0:
                 self.handoff_stats["routing_only"] += 1
             self.handoff_stats["serialize_s"] += dt
+        self._m_handoff_frames.inc()
+        self._m_handoff_bytes.inc(len(frame))
+        self._m_handoff_serialize.observe(dt)
         # This host's bookkeeping for the request ends here: token
         # events (and any cancel) now belong to the decode tier.
         self._reported.pop(req_id, None)
@@ -523,6 +578,7 @@ class EngineHost:
             # holds _wlock (symlint C202).
             with self._wlock:
                 self.adopt_stats["errors"] += 1
+            self._m_adopt_frames.inc(outcome="error")
             self._write({"op": HostOp.EVENT, "id": req_id, "text": "",
                          "done": True, "finish_reason": "error",
                          "error": "handoff adoption failed: adopt op "
@@ -548,12 +604,14 @@ class EngineHost:
             except Exception as exc:  # noqa: BLE001 — fail one request
                 with self._wlock:
                     self.adopt_stats["errors"] += 1
+                self._m_adopt_frames.inc(outcome="error")
                 raise RuntimeError(
                     f"handoff adoption failed: {exc}") from exc
+            dt = time.monotonic() - t0
             with self._wlock:
                 self.adopt_stats["frames"] += 1
                 self.adopt_stats["bytes"] += len(raw)
-                self.adopt_stats["deserialize_s"] += time.monotonic() - t0
+                self.adopt_stats["deserialize_s"] += dt
                 if handoff.p:
                     if ok:
                         self.adopt_stats["adopted"] += 1
@@ -561,6 +619,15 @@ class EngineHost:
                         # Store rejected (budget): full prefill fallback
                         # — slower but still token-identical for greedy.
                         self.adopt_stats["rejected"] += 1
+            self._m_adopt_deserialize.observe(dt)
+            if handoff.p:
+                self._m_adopt_frames.inc(
+                    outcome="adopted" if ok else "rejected")
+            else:
+                # p == 0 routing-only frames count too — the registry
+                # total must agree with adopt_stats["frames"], the same
+                # quantity on the stats() surface.
+                self._m_adopt_frames.inc(outcome="routing_only")
 
         s = msg.get("sampling") or {}
         sampling = SamplingParams(

@@ -244,7 +244,37 @@ class Scheduler:
                         "spec_verify_blocks": 0, "spec_drafted": 0,
                         "spec_accepted": 0, "spec_rolled_back": 0,
                         "spec_tokens": 0, "spec_verify_s": 0.0}
+        from symmetry_tpu.utils.metrics import METRICS, MetricName
         from symmetry_tpu.utils.trace import Histogram, Tracer
+
+        # Always-on time series (utils/metrics.py): the same counters the
+        # stats() snapshot reports, but as registry families a Prometheus
+        # scrape / symtop poll reads without a stats round-trip. Emitted
+        # at block/dispatch granularity only — never per token — and
+        # disabled-mode cost is one branch (metrics.enabled: false).
+        self._m_requests = METRICS.counter(
+            MetricName.SCHED_REQUESTS, "requests submitted to the scheduler")
+        self._m_tokens = METRICS.counter(
+            MetricName.SCHED_TOKENS, "tokens emitted by the engine")
+        self._m_queue_depth = METRICS.gauge(
+            MetricName.SCHED_QUEUE_DEPTH,
+            "inbox + budget-deferred admission backlog")
+        self._m_occupancy = METRICS.gauge(
+            MetricName.SCHED_OCCUPANCY, "active decode slots")
+        self._m_evictions = METRICS.counter(
+            MetricName.SCHED_EVICTIONS, "slots released (request finished)")
+        self._m_deadline_sheds = METRICS.counter(
+            MetricName.SCHED_DEADLINE_SHEDS,
+            "requests shed at admission on an expired deadline")
+        self._m_handoffs = METRICS.counter(
+            MetricName.SCHED_HANDOFFS,
+            "prefill-tier requests handed off to the decode tier")
+        self._m_dispatch = METRICS.histogram(
+            MetricName.SCHED_DISPATCH,
+            "device dispatch wall per kind", labels=("kind",))
+        self._m_ttft = METRICS.histogram(
+            MetricName.SCHED_TTFT,
+            "engine-side TTFT (enqueue to first sampled token)")
 
         # Request-scoped tracing (dispatch granularity — never per token):
         # every device dispatch (prefill/chunk/decode block/verify) and
@@ -293,6 +323,7 @@ class Scheduler:
         if self._stopping.is_set():
             raise RuntimeError("scheduler is stopping")
         self.metrics["requests"] += 1
+        self._m_requests.inc()
         self._inbox.put(req)
 
     @property
@@ -548,6 +579,13 @@ class Scheduler:
         if self._last_sync_done is not None:
             self._interval_hist.observe(t1 - self._last_sync_done)
         self._last_sync_done = t1
+        if dispatched_at is not None:
+            self._m_dispatch.observe(time.monotonic() - dispatched_at,
+                                     kind="decode_block")
+        # Block-boundary gauges: same cadence as the tracer's counter
+        # tracks — a handful of registry ops per block, never per token.
+        self._m_occupancy.set(len(self._slots))
+        self._m_queue_depth.set(self._inbox.qsize() + len(self._deferred))
         if self.tracer.enabled:
             # Block span covers dispatch → device done (the device-side
             # wall the double buffer hides host work behind); the gauge
@@ -624,6 +662,8 @@ class Scheduler:
             else:
                 self._finish(slot, active, finish, last_tok, text)
         self.metrics["tokens"] += block_tokens
+        if block_tokens:
+            self._m_tokens.inc(block_tokens)
 
     def _spec_peek(self) -> bool:
         """Would any active slot propose a draft from its CURRENT
@@ -665,6 +705,7 @@ class Scheduler:
         accepted = int(np.sum(np.minimum(n_emit - 1, n_draft)))
         self.tracer.record("verify_dispatch", t0m, dt,
                            drafted=proposed, accepted=accepted)
+        self._m_dispatch.observe(dt, kind="verify")
         self.metrics["spec_verify_blocks"] += 1
         self.metrics["spec_verify_s"] += dt
         self.metrics["spec_drafted"] += proposed
@@ -757,6 +798,7 @@ class Scheduler:
                     # nobody reads. Covers inbox and deferred entries
                     # alike (both pop through here).
                     self.metrics["deadline_shed"] += 1
+                    self._m_deadline_sheds.inc()
                     late = time.monotonic() - item.deadline_at
                     self._emit_cb(item, TokenEvent(
                         text="", token_id=None, done=True,
@@ -961,12 +1003,14 @@ class Scheduler:
                 self.metrics["adopt_s"] += dt
                 self._adopt_hist.observe(dt)
                 self.tracer.record("adopt_dispatch", t0m, dt, n=len(sub))
+                self._m_dispatch.observe(dt, kind="adopt")
             else:
                 self.metrics["admit_dispatches"] += 1
                 self.metrics["admit_s"] += dt
                 self._admit_hist.observe(dt)
                 self.tracer.record("prefill_dispatch", t0m, dt, n=len(sub),
                                    cached=hit is not None)
+                self._m_dispatch.observe(dt, kind="prefill")
             for (slot, req), first in zip(sub, firsts):
                 self._activate(slot, req, first)
         return n_dispatches
@@ -1016,6 +1060,7 @@ class Scheduler:
             self._spent_this_block += dt
             self.tracer.record("chunk_dispatch", t0m, dt,
                                request_id=req.id, trace_id=req.trace_id)
+            self._m_dispatch.observe(dt, kind="chunk")
             progressed += 1
             budget -= 1
             if first is not None:
@@ -1036,6 +1081,7 @@ class Scheduler:
                              prompt_len=len(req.prompt_ids))
         active.first_token_at = time.monotonic()
         self._ttft_hist.observe(active.first_token_at - req.enqueued_at)
+        self._m_ttft.observe(active.first_token_at - req.enqueued_at)
         if self.tracer.enabled:
             # The request's admission phases as spans: scheduler-queue
             # wait (enqueue → placement pick) and prefill (pick → first
@@ -1058,6 +1104,7 @@ class Scheduler:
             return
         active.emitted = 1
         self.metrics["tokens"] += 1
+        self._m_tokens.inc()
         # Finish before the first decode block if (a) the request's token
         # budget is already spent by the prefill token, or (b) the prompt is
         # so long the cache can't absorb the TWO dispatches that may land
@@ -1100,6 +1147,7 @@ class Scheduler:
             dt = time.monotonic() - t0m
             self.metrics["handoffs"] += 1
             self.metrics["handoff_s"] += dt
+            self._m_handoffs.inc()
             if self.tracer.enabled:
                 # Same per-request spans a unified host records (queue,
                 # prefill), plus the handoff leg — the request's prefill-
@@ -1138,6 +1186,7 @@ class Scheduler:
             self._drafter.release(slot)
         self.engine.release_slot(slot)
         self.metrics["evictions"] += 1
+        self._m_evictions.inc()
 
     def _emit(self, active: _ActiveSlot, ev: TokenEvent) -> None:
         if not active.stages_sent:

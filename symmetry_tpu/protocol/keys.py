@@ -39,7 +39,12 @@ class MessageKey:
     INFERENCE_CANCEL = "inferenceCancel"      # client aborts one in-flight
                                               # request by its requestId
     DRAIN = "drain"                           # graceful shutdown: stop accepting, finish in-flight
-    METRICS = "metrics"                       # provider → server load metrics (tok/s, queue depth)
+    METRICS = "metrics"                       # provider → server load metrics (tok/s, queue
+                                              # depth); client ⇄ provider stats probe — the
+                                              # reply carries the stats snapshot plus a
+                                              # "metrics" block of tier-labeled registry
+                                              # snapshots (utils/metrics.py), so symtop and
+                                              # the swarm path scrape without an open port
     PROVIDER_LIST = "providerList"            # server → client available models
     TRACE = "trace"                           # client ⇄ provider: merged span-ring
                                               # snapshot (client, provider, host,
@@ -76,6 +81,12 @@ class HostOp:
     CLOCK = "clock"         # clock-offset handshake probe (echoed back)
     TRACE = "trace"         # span-ring snapshot request (echoed back)
     STATS = "stats"         # scheduler/emit counters probe (echoed back)
+    METRICS = "metrics"     # metrics-registry snapshot probe (echoed
+                            # back with the host process's registry
+                            # families + its tier role; the provider
+                            # merges them tier-labeled into its own
+                            # exposition and the MessageKey.METRICS
+                            # reply — the swarm path needs no open port)
     SHUTDOWN = "shutdown"   # graceful drain + exit
 
     # --- frames: host stdout → provider ---

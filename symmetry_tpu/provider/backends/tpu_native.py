@@ -87,6 +87,7 @@ class TpuNativeBackend(InferenceBackend):
         self._prefill_clock_offset: float = 0.0
         self._prefill_stats_waiters: list[asyncio.Future] = []
         self._prefill_trace_waiters: list[asyncio.Future] = []
+        self._prefill_metrics_waiters: list[asyncio.Future] = []
         # --- cross-machine handoff link (tpu.disagg.peer) -------------
         # NETWORK mode: the prefill tier is NOT a local subprocess but a
         # PrefillNode (engine/disagg/node.py) reached over the handoff
@@ -115,6 +116,7 @@ class TpuNativeBackend(InferenceBackend):
         self._engine_alive = True  # host-reported scheduler liveness
         self._stats_waiters: list[asyncio.Future] = []
         self._trace_waiters: list[asyncio.Future] = []
+        self._metrics_waiters: list[asyncio.Future] = []
         # --- engine-host supervision (process mode) -------------------
         sup = config.tpu.supervisor or {}
         self._sup_enabled = bool(sup.get("enabled", True))
@@ -173,11 +175,23 @@ class TpuNativeBackend(InferenceBackend):
         #   prefill  placement pick → first token sampled
         #   emit     first token → host pipe write (block-flush hold)
         #   relay    host pipe write → this process relays the event
+        from symmetry_tpu.utils.metrics import METRICS, MetricName
         from symmetry_tpu.utils.trace import Histogram
 
         self.stage_hists = {name: Histogram() for name in
                             ("submit", "pipe_in", "queue", "prefill",
                              "emit", "relay")}
+        # Registry twins of the per-stage TTFT and relay accounting
+        # (always-on time series in THIS process; the host's own
+        # families arrive via the HostOp.METRICS probe, tier-labeled).
+        self._m_stage = METRICS.histogram(
+            MetricName.TTFT_STAGE,
+            "per-stage TTFT attribution (submit/pipe_in/queue/prefill/"
+            "emit/relay)", labels=("stage",))
+        self._m_host_frames = METRICS.counter(
+            MetricName.RELAY_HOST_FRAMES, "host-pipe frames relayed")
+        self._m_host_events = METRICS.counter(
+            MetricName.RELAY_HOST_EVENTS, "token events relayed")
 
     @property
     def _process_mode(self) -> bool:
@@ -536,6 +550,12 @@ class TpuNativeBackend(InferenceBackend):
                     if not w.done():
                         w.set_result(msg)
                 continue
+            if op == HostOp.METRICS:
+                waiters, self._metrics_waiters = self._metrics_waiters, []
+                for w in waiters:
+                    if not w.done():
+                        w.set_result(msg)
+                continue
             if op == HostOp.EVENTS:
                 # Batched frame: one pipe line carries every slot's delta
                 # for a decode block. Fan out in frame order — per-request
@@ -546,6 +566,8 @@ class TpuNativeBackend(InferenceBackend):
                 self.relay_stats["host_frames"] += 1
                 self.relay_stats["host_batched_frames"] += 1
                 self.relay_stats["host_events"] += len(events)
+                self._m_host_frames.inc()
+                self._m_host_events.inc(len(events))
                 for ev in events:
                     if not isinstance(ev, dict):
                         continue
@@ -557,6 +579,8 @@ class TpuNativeBackend(InferenceBackend):
                 continue
             self.relay_stats["host_frames"] += 1
             self.relay_stats["host_events"] += 1
+            self._m_host_frames.inc()
+            self._m_host_events.inc()
             q = self._queues.get(str(msg.get("id", "")))
             if q is not None:
                 q.put_nowait(msg)
@@ -607,6 +631,13 @@ class TpuNativeBackend(InferenceBackend):
             if op == HostOp.TRACE:
                 waiters, self._prefill_trace_waiters = (
                     self._prefill_trace_waiters, [])
+                for w in waiters:
+                    if not w.done():
+                        w.set_result(msg)
+                continue
+            if op == HostOp.METRICS:
+                waiters, self._prefill_metrics_waiters = (
+                    self._prefill_metrics_waiters, [])
                 for w in waiters:
                     if not w.done():
                         w.set_result(msg)
@@ -664,14 +695,18 @@ class TpuNativeBackend(InferenceBackend):
                           "restarting": restarting,
                           "error": reason, "text": ""})
         for w in (self._stats_waiters + self._trace_waiters
+                  + self._metrics_waiters
                   + self._prefill_stats_waiters
-                  + self._prefill_trace_waiters):
+                  + self._prefill_trace_waiters
+                  + self._prefill_metrics_waiters):
             if not w.done():
                 w.set_result(None)
         self._stats_waiters.clear()
         self._trace_waiters.clear()
+        self._metrics_waiters.clear()
         self._prefill_stats_waiters.clear()
         self._prefill_trace_waiters.clear()
+        self._prefill_metrics_waiters.clear()
         if self._broker is not None:
             self._broker.fail_all()
 
@@ -981,11 +1016,24 @@ class TpuNativeBackend(InferenceBackend):
         return await self._probe(HostOp.TRACE, self._trace_waiters, None,
                                  timeout)
 
+    async def _probe_host_metrics(self, timeout: float = 10.0
+                                  ) -> dict | None:
+        return await self._probe(HostOp.METRICS, self._metrics_waiters,
+                                 None, timeout)
+
     async def _probe_prefill_stats(self, timeout: float = 10.0
                                    ) -> dict | None:
         if self._prefill_proc is None:
             return None
         return await self._probe(HostOp.STATS, self._prefill_stats_waiters,
+                                 self._prefill_proc, timeout)
+
+    async def _probe_prefill_metrics(self, timeout: float = 10.0
+                                     ) -> dict | None:
+        if self._prefill_proc is None:
+            return None
+        return await self._probe(HostOp.METRICS,
+                                 self._prefill_metrics_waiters,
                                  self._prefill_proc, timeout)
 
     async def _probe_prefill_trace(self, timeout: float = 10.0
@@ -1047,6 +1095,42 @@ class TpuNativeBackend(InferenceBackend):
             if trace_export is not None:
                 return [trace_export()]  # same process — offset 0
         return []
+
+    async def metrics_snapshots(self) -> list[dict]:
+        """The engine tier's metrics-registry snapshots, tier-labeled —
+        merged by the provider into its Prometheus exposition and the
+        peer-wire metrics reply (the per-tier labeling the disagg pair
+        needs: symtop and a scrape can tell prefill from decode).
+
+        inproc mode shares the provider's process registry, so the
+        provider's own snapshot already covers the scheduler families —
+        nothing extra to add. In network disagg mode the remote prefill
+        node's registry lives on its machine (scrape it there); the
+        link/broker families live in THIS process and ride the
+        provider snapshot."""
+        if not self._process_mode:
+            return []
+        if (self._proc is None or self._host_dead
+                or self._proc.returncode is not None):
+            return []
+        # Both tiers probed CONCURRENTLY with a short timeout: this
+        # rides the stats wire reply, and stacking sequential 10 s probe
+        # timeouts behind a wedged host would hold the peer loop far
+        # longer than a scrape is worth.
+        probes = [self._probe_host_metrics(timeout=5.0)]
+        if self._local_pair:
+            probes.append(self._probe_prefill_metrics(timeout=5.0))
+        replies = await asyncio.gather(*probes, return_exceptions=True)
+        out: list[dict] = []
+        for i, msg in enumerate(replies):
+            if not isinstance(msg, dict):
+                continue
+            role = str(msg.get("role")
+                       or ("prefill" if i == 1 else "unified"))
+            out.append({"snapshot": {k: v for k, v in msg.items()
+                                     if k not in ("op", "role")},
+                        "labels": {"tier": role}})
+        return out
 
     async def engine_stats(self) -> dict | None:
         """The scheduler's serving breakdown (counters, engine-side TTFT,
@@ -1242,6 +1326,7 @@ class TpuNativeBackend(InferenceBackend):
                  "relay": now - out}
         for name, span in spans.items():
             self.stage_hists[name].observe(span)
+            self._m_stage.observe(span, stage=name)
 
     def _restart_eta_s(self) -> float:
         """Rough time until the host is back — the retry_after hint on

@@ -43,6 +43,13 @@ from symmetry_tpu.server import tokens as session_tokens
 from symmetry_tpu.transport.base import Connection, Listener, Transport
 from symmetry_tpu.utils.faults import FAULTS, InjectedFault
 from symmetry_tpu.utils.logging import log_context, logger
+from symmetry_tpu.utils.metrics import (
+    METRICS,
+    MetricName,
+    MetricsServer,
+    SloMonitor,
+    render_prometheus,
+)
 from symmetry_tpu.utils.trace import FlightRecorder, Tracer
 
 RECONNECT_BASE_S = 1.0
@@ -170,6 +177,55 @@ class SymmetryProvider:
         # loads the same mapping from its config copy; SYMMETRY_FAULTS
         # env reaches both at import). No-op when absent.
         FAULTS.load(self.config.get("faults"))
+        # ---- always-on fleet telemetry (utils/metrics.py) ------------
+        # The registry families this provider emits. Registered HERE so
+        # the exposition endpoint shows every family from the first
+        # scrape (an empty counter is a statement; a missing one is a
+        # question). `metrics:` config block:
+        #   metrics: {enabled: true, port: 9100, host: "127.0.0.1"}
+        # port absent/None → no HTTP endpoint (the peer-wire metrics
+        # reply still carries the snapshots); port 0 → ephemeral.
+        m_cfg = self.config.get("metrics") or {}
+        METRICS.enabled = bool(m_cfg.get("enabled", True))
+        self._metrics_cfg = m_cfg
+        self.metrics_server: MetricsServer | None = None
+        self._m_requests = METRICS.counter(
+            MetricName.PROVIDER_REQUESTS, "inference requests accepted")
+        self._m_tokens_out = METRICS.counter(
+            MetricName.PROVIDER_TOKENS_OUT, "tokens streamed to clients")
+        self._m_errors = METRICS.counter(
+            MetricName.PROVIDER_ERRORS, "inference requests failed")
+        self._m_sheds = METRICS.counter(
+            MetricName.PROVIDER_SHEDS,
+            "requests shed before service", labels=("reason",))
+        self._m_in_flight = METRICS.gauge(
+            MetricName.PROVIDER_IN_FLIGHT, "requests currently in flight")
+        self._m_pending_first = METRICS.gauge(
+            MetricName.PROVIDER_PENDING_FIRST_TOKEN,
+            "accepted requests not yet streaming")
+        self._m_connections = METRICS.gauge(
+            MetricName.PROVIDER_CONNECTIONS, "connected client peers")
+        self._m_uptime = METRICS.gauge(
+            MetricName.PROVIDER_UPTIME, "seconds since provider start")
+        self._m_ttft = METRICS.histogram(
+            MetricName.PROVIDER_TTFT, "time to first streamed token")
+        self._m_e2e = METRICS.histogram(
+            MetricName.PROVIDER_E2E, "end-to-end request latency")
+        self._m_inter_chunk = METRICS.histogram(
+            MetricName.PROVIDER_INTER_CHUNK,
+            "gap between consecutive streamed chunks")
+        self._m_backend_restarts = METRICS.counter(
+            MetricName.PROVIDER_BACKEND_RESTARTS,
+            "engine-host deaths handled by the supervisor")
+        self._m_flight_dumps = METRICS.counter(
+            MetricName.PROVIDER_FLIGHT_DUMPS,
+            "flight-recorder dumps written", labels=("reason",))
+        # SLO burn-rate monitor (`slo:` config block, utils/metrics.py):
+        # continuous evaluation over the request stream; a budget burn
+        # triggers the flight recorder + a structured log event — SLO
+        # breach as a first-class signal, not a bench-time observation.
+        self.slo = SloMonitor(self.config.get("slo"),
+                              on_breach=self._on_slo_breach)
 
     # ----- lifecycle (reference: init(), src/provider.ts:37-81) -----
 
@@ -201,6 +257,76 @@ class SymmetryProvider:
         await self._join_dht()
         self._start_puncher()
         self._install_sigusr2()
+        self._start_metrics_server()
+
+    def _start_metrics_server(self) -> None:
+        """Prometheus exposition endpoint (`metrics.port`): a stdlib
+        http.server thread serving GET /metrics with this process's
+        registry merged with the engine host(s)' tier-labeled
+        snapshots. Best-effort: a bound-port failure must not take down
+        an otherwise healthy provider."""
+        port = self._metrics_cfg.get("port")
+        if port is None or not METRICS.enabled:
+            return
+        loop = asyncio.get_running_loop()
+
+        def render() -> str:
+            # Scrape threads bridge into the event loop: the engine
+            # host probe is async (pipe round-trip), and the loop owns
+            # every waiter list.
+            fut = asyncio.run_coroutine_threadsafe(
+                self._metrics_exposition(), loop)
+            return fut.result(timeout=10.0)
+
+        try:
+            server = MetricsServer(
+                render, host=self._metrics_cfg.get("host", "127.0.0.1"),
+                port=int(port))
+            server.start()
+        except OSError as exc:
+            logger.error(f"metrics endpoint disabled: {exc}")
+            return
+        self.metrics_server = server
+        logger.info(f"metrics: http://"
+                    f"{self._metrics_cfg.get('host', '127.0.0.1')}:"
+                    f"{server.port}/metrics")
+
+    async def metrics_snapshots(self) -> list[dict]:
+        """This process's registry snapshot plus the backend's
+        tier-labeled engine-host snapshots — the payload of the
+        peer-wire metrics reply and the HTTP exposition alike."""
+        self._m_uptime.set(round(time.monotonic() - self._started_at, 1))
+        snaps = [{"snapshot": METRICS.snapshot(compact=True),
+                  "labels": {}}]
+        fn = getattr(self.backend, "metrics_snapshots", None)
+        if fn is not None:
+            try:
+                snaps.extend(await fn() or [])
+            except Exception as exc:  # noqa: BLE001 — scrape is diagnostics
+                logger.warning(f"backend metrics snapshot failed: {exc}")
+        return snaps
+
+    async def _metrics_exposition(self) -> str:
+        return render_prometheus(await self.metrics_snapshots())
+
+    def _on_slo_breach(self, event: dict) -> None:
+        """SLO budget burn: one structured log event (JSON mode carries
+        component="slo", t_mono, and the ambient trace_id of the
+        request that tipped the budget) plus a flight-recorder dump —
+        the window that contains the burn, captured while it is still
+        in the rings."""
+        with log_context(component="slo"):
+            logger.error(
+                f"SLO burn: {event['slo']} target "
+                f"{event['target_s']}s objective {event['objective']} — "
+                f"burn fast {event['burn_fast']}x / slow "
+                f"{event['burn_slow']}x over threshold "
+                f"{event['burn_threshold']}x "
+                f"({event['samples_fast']} samples in "
+                f"{event['fast_window_s']:.0f}s)")
+        if self.flight is not None:
+            self._spawn(self._flight_dump(f"slo_burn_{event['slo']}",
+                                          force=True))
 
     def _install_sigusr2(self) -> None:
         """SIGUSR2 → flight-recorder dump (operator-triggered capture of
@@ -226,6 +352,7 @@ class SymmetryProvider:
         handled. Leave the debuggable artifact (forced flight dump — the
         window still holds the death) and say so loudly."""
         logger.error(f"engine host {reason}; supervisor restarting it")
+        self._m_backend_restarts.inc()
         if self.flight is not None:
             self._spawn(self._flight_dump(f"host_{reason}", force=True))
 
@@ -297,6 +424,11 @@ class SymmetryProvider:
     async def stop(self, drain_timeout_s: float = 30.0) -> None:
         """Graceful drain: stop accepting, finish in-flight, leave, close."""
         self._draining = True
+        if self.metrics_server is not None:
+            # First: a scrape against a draining provider should fail
+            # fast, not hold the drain window open.
+            await asyncio.to_thread(self.metrics_server.stop)
+            self.metrics_server = None
         if getattr(self, "_sigusr2_installed", False):
             import signal
 
@@ -511,6 +643,7 @@ class SymmetryProvider:
         except OSError as exc:
             logger.error(f"flight recorder write failed: {exc}")
             return None
+        self._m_flight_dumps.inc(reason=reason)
         logger.warning(f"flight recorder: {reason} → {path}")
         return path
 
@@ -546,6 +679,8 @@ class SymmetryProvider:
         provider (shutting down — never coming back), vs a busy/capacity
         shed that a backoff retry may legitimately revisit."""
         self.metrics["shed"] += 1
+        self._m_sheds.inc(
+            reason="draining" if draining else "connection_limit")
         try:
             # Short handshake hold on purpose: the refusal path runs
             # exactly when the provider is saturated (or leaving), and a
@@ -576,6 +711,7 @@ class SymmetryProvider:
             return
         peer = await Peer.connect(conn, self.identity, initiator=False)
         self._client_peers.add(peer)
+        self._m_connections.set(len(self._client_peers))
         await self._report_connections()
         peer_key = peer.remote_public_hex
         logger.debug(f"client peer connected: {peer_key[:12]}")
@@ -646,6 +782,16 @@ class SymmetryProvider:
                     if engine_stats is not None:
                         with contextlib.suppress(Exception):
                             payload["engine"] = await engine_stats()
+                    if METRICS.enabled:
+                        # The registry snapshots (this process + the
+                        # engine host(s), tier-labeled) ride the same
+                        # reply — the swarm path's scrape surface, no
+                        # open port required (symtop's wire mode,
+                        # bench --metrics-out).
+                        with contextlib.suppress(Exception):
+                            payload["metrics"] = {
+                                "snapshots":
+                                    await self.metrics_snapshots()}
                     await peer.send(MessageKey.METRICS, payload)
                 elif msg.key == MessageKey.TRACE:
                     # Merged span-ring snapshot (provider + backend/host/
@@ -657,6 +803,7 @@ class SymmetryProvider:
                     break
         finally:
             self._client_peers.discard(peer)
+            self._m_connections.set(len(self._client_peers))
             await peer.close()
             # Fold AFTER close: the cork's settle() may perform one last
             # write on the way down, and it must land in the totals.
@@ -733,6 +880,7 @@ class SymmetryProvider:
 
     async def _shed(self, peer: Peer, tag: dict, reason: dict) -> None:
         self.metrics["shed"] += 1
+        self._m_sheds.inc(reason="busy")
         logger.debug(f"shedding request: {reason['error']}")
         await peer.send(MessageKey.INFERENCE_ERROR,
                         {**reason, "busy": True, **tag})
@@ -746,6 +894,10 @@ class SymmetryProvider:
             with contextlib.suppress(ConnectionError, OSError):
                 await self._server_peer.send(MessageKey.METRICS,
                                              self.stats())
+
+    def _pending_gauges(self) -> None:
+        self._m_in_flight.set(self._in_flight)
+        self._m_pending_first.set(max(self._unstarted, 0))
 
     async def _handle_inference(self, peer: Peer, data: dict) -> None:
         start = time.monotonic()
@@ -788,6 +940,7 @@ class SymmetryProvider:
                 # caller stopped waiting, so failover would only burn
                 # another provider's admission slot.
                 self.metrics["shed"] += 1
+                self._m_sheds.inc(reason="expired")
                 await peer.send(MessageKey.INFERENCE_ERROR,
                                 {"error": "deadline_s already expired",
                                  "expired": True, **tag})
@@ -808,6 +961,8 @@ class SymmetryProvider:
         self._in_flight += 1
         self._unstarted += 1
         self.metrics["requests"] += 1
+        self._m_requests.inc()
+        self._pending_gauges()
         request_id = f"{peer.remote_public_hex[:12]}:{self.metrics['requests']}"
         completion_parts: list[str] = []
         first_token_s: float | None = None
@@ -832,6 +987,7 @@ class SymmetryProvider:
                  "model": self.config.model_name,
                  "tMono": time.monotonic(), **tag},
             )
+            last_chunk_at: float | None = None
             async for chunk in self.backend.stream(request):
                 if peer.closed:
                     # Mid-stream client death tolerated (src/provider.ts:242,253-254).
@@ -847,13 +1003,25 @@ class SymmetryProvider:
                     # reference's one-chunk≈one-token accounting.
                     n_tokens += (chunk.tokens if chunk.tokens is not None
                                  else 1)
+                    now_chunk = time.monotonic()
                     if first_token_s is None:
-                        first_token_s = time.monotonic() - start
+                        first_token_s = now_chunk - start
                         self.tracer.record("ttft", start, first_token_s,
                                            request_id=request_id,
                                            trace_id=trace_id)
                         self._unstarted -= 1
-                        self._first_token_stamps.append(time.monotonic())
+                        self._pending_gauges()
+                        self._first_token_stamps.append(now_chunk)
+                        self._m_ttft.observe(first_token_s)
+                        self.slo.observe("ttft", first_token_s)
+                    else:
+                        # Inter-chunk gap: the stall any live stream saw
+                        # between deltas — the r05 tail metric, now an
+                        # always-on series and an SLO input.
+                        gap = now_chunk - last_chunk_at
+                        self._m_inter_chunk.observe(gap)
+                        self.slo.observe("inter_chunk", gap)
+                    last_chunk_at = now_chunk
                 # Raw passthrough; Connection.send awaits drain = backpressure
                 # (reference's write/drain discipline, src/provider.ts:248-252).
                 await peer.send(MessageKey.TOKEN_CHUNK,
@@ -866,7 +1034,11 @@ class SymmetryProvider:
                     {"chunks": n_chunks, "tokens": n_tokens, **tag},
                 )
             self.metrics["tokens_out"] += n_tokens
+            if n_tokens:
+                self._m_tokens_out.inc(n_tokens)
             e2e_s = time.monotonic() - start
+            self._m_e2e.observe(e2e_s)
+            self.slo.observe("e2e", e2e_s)
             self.tracer.record("inference", start, e2e_s,
                                request_id=request_id, trace_id=trace_id,
                                tokens=n_tokens, chunks=n_chunks)
@@ -894,7 +1066,12 @@ class SymmetryProvider:
             # No per-stream flight dump: the supervisor's restart hook
             # already captured the death once, and N in-flight streams
             # must not race N dumps of the same window.
+            # Counted as an ERROR (matching the legacy stats counter) —
+            # not also a shed: the registry and stats() surfaces must
+            # agree, and double-booking every restarting request under
+            # sheds_total too would make shed+error sums double-count.
             self.metrics["errors"] += 1
+            self._m_errors.inc()
             logger.error(f"backend restarting: {exc}")
             if not peer.closed:
                 with contextlib.suppress(ConnectionError, OSError):
@@ -910,6 +1087,7 @@ class SymmetryProvider:
             # Deadline expired before service (scheduler admission shed):
             # terminal for this request, not a provider failure.
             self.metrics["shed"] += 1
+            self._m_sheds.inc(reason="expired")
             logger.debug(f"deadline shed: {exc}")
             if not peer.closed:
                 with contextlib.suppress(ConnectionError, OSError):
@@ -918,6 +1096,7 @@ class SymmetryProvider:
                                      **tag})
         except BackendError as exc:
             self.metrics["errors"] += 1
+            self._m_errors.inc()
             logger.error(f"backend error: {exc}")
             if self.flight is not None:
                 self._spawn(self._flight_dump("backend_error"))
@@ -930,6 +1109,7 @@ class SymmetryProvider:
             # crash it stands in for — drop the client cold (no error
             # frame), exactly what a dying provider process would do.
             self.metrics["errors"] += 1
+            self._m_errors.inc()
             logger.error(f"injected fault: {exc}; dropping peer")
             await peer.close()
         except asyncio.CancelledError:
@@ -948,6 +1128,7 @@ class SymmetryProvider:
                 # Never started streaming (error/cancel before the first
                 # token) — still waiting from the estimator's view.
                 self._unstarted -= 1
+            self._pending_gauges()
 
     async def _report_completion(self, data: dict, tokens: int) -> None:
         token = data.get("sessionToken") or {}

@@ -20,6 +20,8 @@ import time
 from dataclasses import dataclass
 from typing import Any
 
+from symmetry_tpu.utils.metrics import METRICS, MetricName
+
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS peers (
     peer_key        TEXT PRIMARY KEY,   -- hex Ed25519 public key
@@ -118,6 +120,22 @@ class Registry:
             "UPDATE peers SET online = 0, connections = 0, queued = 0,"
             " queued_at = 0")
         self._db.commit()
+        # Server-side fleet telemetry (utils/metrics.py): the router's
+        # steering inputs as always-on series — online count and each
+        # provider's reported engine backlog (the `queued` column the
+        # steering ORDER BY reads).
+        self._m_online = METRICS.gauge(
+            MetricName.SERVER_PROVIDERS_ONLINE,
+            "providers currently online")
+        self._m_queued = METRICS.gauge(
+            MetricName.SERVER_PROVIDER_QUEUED,
+            "per-provider reported engine backlog",
+            labels=("provider", "model"))
+
+    def _gauge_online(self) -> None:
+        row = self._db.execute(
+            "SELECT COUNT(*) AS n FROM peers WHERE online = 1").fetchone()
+        self._m_online.set(int(row["n"]))
 
     def _migrate(self) -> None:
         """Columns added after a release: CREATE TABLE IF NOT EXISTS is a
@@ -161,13 +179,22 @@ class Registry:
              json.dumps(config) if config else None, now, now),
         )
         self._db.commit()
+        self._gauge_online()
 
     def set_offline(self, peer_key: str) -> None:
+        row = self.get_provider(peer_key)
         self._db.execute(
             "UPDATE peers SET online = 0, connections = 0 WHERE peer_key = ?",
             (peer_key,),
         )
         self._db.commit()
+        self._gauge_online()
+        # Drop the departed provider's backlog series: a labeled gauge
+        # otherwise exports its last value forever, and churn of
+        # ephemeral providers would grow series without bound.
+        if row is not None:
+            self._m_queued.remove(provider=peer_key[:12],
+                                  model=row.model_name)
 
     def touch(self, peer_key: str) -> None:
         self._db.execute(
@@ -194,6 +221,14 @@ class Registry:
             (json.dumps(metrics), queued, now, now, peer_key),
         )
         self._db.commit()
+        # Gauge only for a LIVE provider: a straggler METRICS heartbeat
+        # processed after set_offline must not resurrect the series the
+        # offline path just removed (it would then export its last
+        # value forever — the churn leak the removal exists to stop).
+        row = self.get_provider(peer_key)
+        if row is not None and row.online:
+            self._m_queued.set(queued, provider=peer_key[:12],
+                               model=row.model_name)
 
     def set_connections(self, peer_key: str, count: int) -> None:
         """`conectionSize` reports (reference key, src/constants.ts:5)."""

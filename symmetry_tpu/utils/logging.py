@@ -7,12 +7,19 @@ always print (src/logger.ts:29-44) — every level here is gated consistently,
 and output is structured enough to grep.
 
 Structured JSON mode (SYMMETRY_LOG_JSON=1 or set_json_mode(True)): every
-record becomes one JSON line on stderr — `{"ts", "level", "msg"}` plus
-the ambient `trace_id`/`request_id` from log_context(), so log lines
-correlate with the request-tracing timeline (utils/trace.py) by the same
-ids. The context rides a contextvars.ContextVar: set once around a
-request's handling, stamped on every record logged inside it (async tasks
-inherit it across awaits; other requests' tasks never see it).
+record becomes one JSON line on stderr — `{"ts", "t_mono", "level",
+"msg"}` plus the ambient `trace_id`/`request_id`/`component` from
+log_context(), so log lines correlate with the request-tracing timeline
+(utils/trace.py) by the same ids AND the same monotonic clock (`t_mono`
+is CLOCK_MONOTONIC — the clock every span and metric ring stamps — so a
+log line lands on the merged timeline without wall-clock reconciliation).
+The context rides a contextvars.ContextVar: set once around a request's
+handling, stamped on every record logged inside it (async tasks inherit
+it across awaits; other requests' tasks never see it). `component` names
+the subsystem that logged (provider/host/scheduler/slo/...): set a
+process-wide default once with set_component(), override per block via
+log_context(component=...) — the SLO monitor's breach events log as
+component "slo" with the breaching request's trace_id already ambient.
 """
 
 from __future__ import annotations
@@ -31,19 +38,33 @@ _log_ctx: contextvars.ContextVar[dict[str, str]] = contextvars.ContextVar(
 
 
 @contextlib.contextmanager
-def log_context(trace_id: str = "", request_id: str = ""):
-    """Stamp trace_id/request_id on every record logged inside the block
-    (and inside anything it awaits/spawns via context inheritance)."""
+def log_context(trace_id: str = "", request_id: str = "",
+                component: str = ""):
+    """Stamp trace_id/request_id/component on every record logged inside
+    the block (and inside anything it awaits/spawns via context
+    inheritance)."""
     ctx = {**_log_ctx.get()}
     if trace_id:
         ctx["trace_id"] = trace_id
     if request_id:
         ctx["request_id"] = request_id
+    if component:
+        ctx["component"] = component
     token = _log_ctx.set(ctx)
     try:
         yield
     finally:
         _log_ctx.reset(token)
+
+
+# Process-wide default `component` (e.g. the engine host sets "host"
+# once at startup); log_context(component=...) overrides per block.
+_default_component = ""
+
+
+def set_component(name: str) -> None:
+    global _default_component
+    _default_component = name
 
 
 class LogLevel(enum.IntEnum):
@@ -118,7 +139,13 @@ class Logger:
         msg = " ".join(str(p) for p in parts)
         if self._json:
             record = {"ts": round(time.time(), 3),
+                      # Monotonic stamp: the clock spans/metrics use, so
+                      # a log line correlates with the timeline without
+                      # wall-clock reconciliation.
+                      "t_mono": round(time.monotonic(), 4),
                       "level": level.name.lower(), "msg": msg,
+                      **({"component": _default_component}
+                         if _default_component else {}),
                       **_log_ctx.get()}
             print(json.dumps(record, ensure_ascii=False), file=sys.stderr,
                   flush=True)

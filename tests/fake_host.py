@@ -113,6 +113,14 @@ class FakeHost:
             elif op == HostOp.TRACE:
                 self.write({"op": HostOp.TRACE, "clock": time.monotonic(),
                             "components": []})
+            elif op == HostOp.METRICS:
+                # Real registry snapshot (tiny here — no families were
+                # emitted) so the backend's tier-labeling merge path is
+                # exercised against the true wire shape.
+                from symmetry_tpu.utils.metrics import METRICS
+
+                self.write({"op": HostOp.METRICS, "role": "unified",
+                            **METRICS.snapshot(compact=True)})
             elif op == HostOp.SHUTDOWN:
                 return 0
         return 0

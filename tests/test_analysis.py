@@ -558,6 +558,89 @@ class TestFaultSeam:
         assert run(root) == []
 
 
+# -------------------------------------------------------- metric-names
+
+
+METRICS_PY = '''\
+class MetricName:
+    REQS = "sym_t_requests_total"
+    TOKS = "sym_t_tokens_total"
+    DEAD = "sym_t_never_emitted_total"
+'''
+
+
+class TestMetricNames:
+    def test_raw_literal_unregistered_and_dead_flag(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/utils/metrics.py": METRICS_PY,
+            "symmetry_tpu/provider/provider.py": (
+                'from symmetry_tpu.utils.metrics import METRICS, MetricName\n'
+                'def init():\n'
+                # registered name spelled raw (M101)…
+                '    METRICS.counter("sym_t_requests_total")\n'
+                # …a name the registry never heard of (M102)…
+                '    METRICS.gauge("sym_t_typo_total")\n'
+                # …a nonexistent registry attribute (M102)…
+                '    METRICS.histogram(MetricName.TYPO)\n'
+                # …and one clean emission
+                '    METRICS.counter(MetricName.TOKS)\n'),
+        })
+        fs = [f for f in run(root) if f.checker == "metric-names"]
+        got = codes(fs)
+        assert got == {"M101", "M102", "M103"}
+        assert {f.symbol for f in fs if f.code == "M101"} == \
+            {"sym_t_requests_total"}
+        assert {f.symbol for f in fs if f.code == "M102"} == \
+            {"sym_t_typo_total", "MetricName.TYPO"}
+        # DEAD registered but never emitted; REQS only emitted raw —
+        # raw emission still counts as emitted, so it is not M103.
+        assert {f.symbol for f in fs if f.code == "M103"} == \
+            {"sym_t_never_emitted_total"}
+        # M103 anchors at the registry assignment, not an emitter
+        (dead,) = [f for f in fs if f.code == "M103"]
+        assert dead.path == "symmetry_tpu/utils/metrics.py"
+
+    def test_constant_emissions_clean(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/utils/metrics.py": (
+                'class MetricName:\n'
+                '    REQS = "sym_t_requests_total"\n'
+                '    LAT = "sym_t_lat_seconds"\n'),
+            "symmetry_tpu/engine/scheduler.py": (
+                'from symmetry_tpu.utils.metrics import METRICS, MetricName\n'
+                'def init(self):\n'
+                '    self._m = METRICS.counter(MetricName.REQS, "reqs")\n'
+                '    METRICS.histogram(MetricName.LAT, labels=("kind",))\n'),
+        })
+        assert [f for f in run(root) if f.checker == "metric-names"] == []
+
+    def test_tests_and_other_receivers_out_of_scope(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/utils/metrics.py": (
+                'class MetricName:\n'
+                '    REQS = "sym_t_requests_total"\n'),
+            # tests pin names as raw literals deliberately — not scanned
+            "tests/test_metrics.py": (
+                'def test_x(METRICS):\n'
+                '    METRICS.counter("sym_t_whatever_total")\n'),
+            # a Tracer's .counter/.histogram is NOT a registry emission
+            "symmetry_tpu/engine/scheduler.py": (
+                'from symmetry_tpu.utils.metrics import METRICS, MetricName\n'
+                'def f(self):\n'
+                '    self.tracer.counter("occupancy", 1)\n'
+                '    self.tracer.histogram("x_s")\n'
+                '    METRICS.counter(MetricName.REQS)\n'),
+        })
+        assert [f for f in run(root) if f.checker == "metric-names"] == []
+
+    def test_real_registry_fully_emitted(self):
+        # The real repo: every MetricName constant must have a live
+        # emission site and no emitter may bypass the registry — the
+        # CI-gate contract, pinned here independently of the baseline.
+        fs = [f for f in run(REPO) if f.checker == "metric-names"]
+        assert fs == [], [f.render() for f in fs]
+
+
 # ----------------------------------------------------- baseline + runner
 
 

@@ -1,0 +1,218 @@
+"""CI metrics smoke: scrape a serving provider mid-request, end to end.
+
+Spins up the full client → server → provider path on the in-memory
+transport with an echo backend (no TPU, no subprocess), with the
+telemetry layer in its production shape: the Prometheus exposition
+endpoint on an ephemeral port, the SLO burn-rate monitor armed with an
+impossible TTFT target, and the flight recorder wired to it. Then:
+
+  1. starts a streamed chat and scrapes /metrics WHILE it is in
+     flight: >= 10 `sym_` metric families must parse, with sane
+     mid-request values (in_flight >= 1, requests_total >= 1);
+  2. finishes the chat, scrapes again: tokens flowed, TTFT histogram
+     filled, uptime advanced;
+  3. asserts the SLO monitor burned (every request misses the
+     impossible target) — breach counter up AND the flight recorder
+     dumped a `slo_burn_ttft` artifact;
+  4. fetches the same snapshots over the peer wire (the swarm path:
+     MessageKey.METRICS reply carries the registry snapshots — no
+     open port needed) and cross-checks them against the scrape;
+  5. renders the fleet table via `symtop --once --metrics-url ...` and
+     asserts the provider row shows real numbers;
+  6. asserts the disabled-mode overhead contract: with the registry
+     disabled, instrumented call sites cost one branch — 200k guarded
+     ops under 0.5 s, and per-op cost x a whole chunk's call count
+     under 1% of a 1 ms chunk budget (the echo-path overhead bound).
+
+Exit 0 on success; exit 1 with a reason otherwise.
+
+Run: python tools/metrics_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def run(tmp_dir: str) -> int:
+    import contextlib
+
+    from symmetry_tpu.client.client import SymmetryClient
+    from symmetry_tpu.identity import Identity
+    from symmetry_tpu.provider.backends.echo import EchoBackend
+    from symmetry_tpu.provider.config import ConfigManager
+    from symmetry_tpu.provider.provider import SymmetryProvider
+    from symmetry_tpu.server.broker import SymmetryServer
+    from symmetry_tpu.transport.memory import MemoryTransport
+    from symmetry_tpu.utils.metrics import METRICS, parse_prometheus_text
+
+    hub = MemoryTransport()
+    server_ident = Identity.from_name("metrics-smoke-server")
+    server = SymmetryServer(server_ident, hub, ping_interval_s=30.0)
+    await server.start("mem://metrics-server")
+
+    flight_dir = os.path.join(tmp_dir, "flight")
+    cfg = ConfigManager(config={
+        "name": "metrics-smoke-prov",
+        "public": True,
+        "serverKey": server_ident.public_hex,
+        "modelName": "echo:metrics",
+        "apiProvider": "echo",
+        "dataCollectionEnabled": False,
+        "metrics": {"port": 0},          # ephemeral exposition endpoint
+        "flightRecorder": {"enabled": True, "dir": flight_dir,
+                           "minIntervalS": 0.0},
+        # Impossible TTFT target: every request burns the budget, so the
+        # breach → flight-dump chain is exercised deterministically.
+        "slo": {"ttft_s": 1e-4, "objective": 0.99, "fast_window_s": 60.0,
+                "slow_window_s": 600.0, "burn_threshold": 5.0,
+                "min_samples": 1, "min_interval_s": 0.0},
+    })
+    provider = SymmetryProvider(
+        cfg, transport=hub,
+        identity=Identity.from_name("metrics-smoke-prov"),
+        backend=EchoBackend(delay_s=0.03),
+        server_address="mem://metrics-server")
+    await provider.start("mem://metrics-smoke-prov")
+    await provider.wait_registered()
+    assert provider.metrics_server is not None, "metrics endpoint not up"
+    url = f"http://127.0.0.1:{provider.metrics_server.port}/metrics"
+
+    def _scrape_blocking() -> dict:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return parse_prometheus_text(resp.read().decode())
+
+    async def scrape() -> dict:
+        # Off-loop on purpose: the exposition handler bridges INTO this
+        # event loop (the host-probe path), so a scrape blocking the
+        # loop would deadlock itself — exactly what a real Prometheus
+        # (its own process) never does.
+        return await asyncio.to_thread(_scrape_blocking)
+
+    client = SymmetryClient(Identity.from_name("metrics-smoke-cli"), hub)
+    details = await client.request_provider(
+        "mem://metrics-server", server_ident.public_key, "echo:metrics")
+    session = await client.connect(details)
+    try:
+        # ---- 1: scrape MID-REQUEST ------------------------------------
+        prompt = " ".join(f"w{i}" for i in range(40))  # ~1.2 s stream
+
+        async def chat() -> str:
+            return "".join([d async for d in session.chat(
+                [{"role": "user", "content": prompt}])])
+
+        task = asyncio.ensure_future(chat())
+        await asyncio.sleep(0.4)  # well inside the stream
+        assert not task.done(), "stream finished before the scrape"
+        fams = await scrape()
+        sym = {n for n in fams if n.startswith("sym_")}
+        print(f"metrics smoke: {len(sym)} sym_ families mid-request: "
+              f"{sorted(sym)}")
+        assert len(sym) >= 10, f"only {len(sym)} families: {sorted(sym)}"
+
+        def val(fams: dict, name: str, suffix: str = "") -> float:
+            fam = fams.get(name) or {"series": []}
+            return sum(s["value"] for s in fam["series"]
+                       if s.get("suffix", "") == suffix)
+
+        assert val(fams, "sym_provider_in_flight") >= 1, \
+            "in_flight must be >= 1 mid-request"
+        assert val(fams, "sym_provider_requests_total") >= 1
+        assert val(fams, "sym_provider_connections") >= 1
+
+        # ---- 2: finish, scrape again ----------------------------------
+        text = await task
+        assert text == prompt, f"echo mismatch: {text[:60]!r}"
+        fams = await scrape()
+        assert val(fams, "sym_provider_tokens_out_total") >= 40
+        assert val(fams, "sym_provider_ttft_seconds", "_count") >= 1
+        assert val(fams, "sym_provider_inter_chunk_seconds", "_count") >= 10
+        assert val(fams, "sym_provider_uptime_seconds") > 0
+        assert val(fams, "sym_provider_in_flight") == 0
+
+        # ---- 3: SLO burn → breach counter + flight-recorder dump ------
+        assert val(fams, "sym_slo_breaches_total") >= 1, \
+            "impossible TTFT target did not burn the SLO"
+        await asyncio.sleep(0.3)  # the dump task is spawned, let it land
+        dumps = [f for f in os.listdir(flight_dir)
+                 if "slo_burn_ttft" in f] if os.path.isdir(flight_dir) \
+            else []
+        assert dumps, "SLO burn produced no flight-recorder dump"
+        print(f"metrics smoke: SLO burn dumped {dumps[0]}")
+
+        # ---- 4: the swarm path (wire metrics block) -------------------
+        stats = await session.stats()
+        snaps = (stats.get("metrics") or {}).get("snapshots")
+        assert snaps, "METRICS reply carries no registry snapshots"
+        wire_fams = snaps[0]["snapshot"]["families"]
+        assert "sym_provider_tokens_out_total" in wire_fams
+        wire_tok = sum(s["value"] for s in
+                       wire_fams["sym_provider_tokens_out_total"]["series"])
+        assert wire_tok == val(fams, "sym_provider_tokens_out_total"), \
+            "wire snapshot disagrees with the HTTP scrape"
+    finally:
+        await session.close()
+
+    # ---- 5: symtop --once renders the fleet table ---------------------
+    import tools.symtop as symtop
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        # Off-loop like the scrapes: symtop's HTTP poll must not block
+        # the event loop its target renders on.
+        rc = await asyncio.to_thread(
+            symtop.main, ["--once", "--metrics-url", url])
+    table = buf.getvalue()
+    print("metrics smoke: symtop table:\n" + table)
+    assert rc == 0, "symtop --once failed"
+    assert "PROVIDER" in table and "TTFT p50" in table
+    row = table.splitlines()[1]
+    assert "127.0.0.1" in row and "ERR" not in row
+    tok_cell = row.split()[2]  # PROVIDER, TIER, TOK/S
+    assert float(tok_cell) > 0, f"provider row shows no tok/s: {row!r}"
+
+    await provider.stop()
+    await server.stop()
+
+    # ---- 6: disabled-mode overhead contract ---------------------------
+    METRICS.enabled = False
+    try:
+        c = METRICS.counter("sym_provider_requests_total")
+        t0 = time.perf_counter()
+        for _ in range(200_000):
+            c.inc()
+        dt = time.perf_counter() - t0
+    finally:
+        METRICS.enabled = True
+    per_op = dt / 200_000
+    print(f"metrics smoke: disabled per-op {per_op * 1e9:.0f} ns "
+          f"({dt:.3f}s / 200k)")
+    assert dt < 0.5, f"disabled-mode overhead {dt:.3f}s for 200k ops"
+    # Echo-path bound: a streamed chunk touches a handful of metric
+    # sites; even 5 of them must cost under 1% of a 1 ms chunk budget.
+    assert per_op * 5 < 0.01 * 1e-3, \
+        f"disabled per-op {per_op * 1e9:.0f} ns breaks the 1% echo bound"
+    return 0
+
+
+def main() -> int:
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="metrics_smoke_") as tmp:
+        try:
+            return asyncio.new_event_loop().run_until_complete(
+                asyncio.wait_for(run(tmp), 120))
+        except AssertionError as exc:
+            print(f"metrics smoke FAILED: {exc}", file=sys.stderr)
+            return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

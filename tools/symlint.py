@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """symlint — the project-invariant static-analysis gate.
 
-Runs the four AST checkers in symmetry_tpu/analysis/ over the repo and
+Runs the five AST checkers in symmetry_tpu/analysis/ over the repo and
 exits non-zero when any finding is not covered by the baseline file,
 so CI fails on protocol/concurrency/recompile/fault-seam drift before
 the test suite even starts (the whole run is ~4 s of `ast.parse` +

@@ -1,0 +1,315 @@
+#!/usr/bin/env python3
+"""symtop — live terminal fleet view over the telemetry layer.
+
+Polls one-or-many providers and renders a per-provider, per-tier table:
+tok/s, TTFT p50/p99, queue depth, in-flight, occupancy, shed count, and
+handoff-link health — the operator's answer to "is the fleet healthy
+RIGHT NOW", where bench.py answers "how fast was it over a run".
+
+Two poll paths, mixable in one invocation:
+
+  --metrics-url http://host:port/metrics     the Prometheus exposition
+        endpoint (`metrics.port` in provider.yaml) — no keys, no swarm
+        stack, works against anything that speaks the text format
+  --provider tcp://host:port [--key HEX]     the peer wire: one metrics
+        probe per poll (MessageKey.METRICS reply = stats snapshot + the
+        tier-labeled registry snapshots), Noise-encrypted like any
+        client — the swarm path, no open port required
+
+Rates (tok/s, shed/s) are counter deltas between polls; the first
+sample (and --once) falls back to lifetime averages over the provider's
+reported uptime. Disagg providers show one sub-row per engine tier
+(prefill / decode) from the `tier` label the telemetry layer carries
+end to end.
+
+Run:
+    python tools/symtop.py --metrics-url http://127.0.0.1:9100/metrics
+    python tools/symtop.py --provider tcp://127.0.0.1:4631 --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+import urllib.request
+from typing import Any
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from symmetry_tpu.utils.metrics import (  # noqa: E402
+    histogram_quantile,
+    parse_prometheus_text,
+)
+
+COLUMNS = ("PROVIDER", "TIER", "TOK/S", "TTFT p50", "TTFT p99",
+           "QUEUE", "INFL", "OCC", "SHED", "LINK")
+WIDTHS = (22, 9, 9, 9, 9, 7, 6, 5, 7, 6)
+
+
+# ----------------------------------------------------- family flattening
+
+
+def families_from_snapshots(snaps: list[dict]) -> dict[str, dict]:
+    """Registry snapshots (the wire `metrics.snapshots` shape) → the
+    same family dict parse_prometheus_text produces, extra labels
+    (tier) stamped — one row builder then serves both poll paths."""
+    fams: dict[str, dict] = {}
+    for item in snaps or []:
+        snap = item.get("snapshot") or {}
+        extra = dict(item.get("labels") or {})
+        for name, fam in (snap.get("families") or {}).items():
+            out = fams.setdefault(
+                name, {"kind": fam.get("kind", "untyped"), "series": []})
+            for s in fam.get("series") or []:
+                labels = {**(s.get("labels") or {}), **extra}
+                if fam.get("kind") == "histogram":
+                    for le, c in s.get("buckets") or []:
+                        out["series"].append(
+                            {"labels": {**labels, "le": str(le)},
+                             "value": float(c), "suffix": "_bucket"})
+                    out["series"].append({"labels": labels,
+                                          "value": float(s.get("sum", 0.0)),
+                                          "suffix": "_sum"})
+                    out["series"].append({"labels": labels,
+                                          "value": float(s.get("count", 0)),
+                                          "suffix": "_count"})
+                else:
+                    out["series"].append({"labels": labels,
+                                          "value": float(s.get("value", 0.0)),
+                                          "suffix": ""})
+    return fams
+
+
+def _value(fams: dict, name: str, default: float | None = None,
+           **labels: str) -> float | None:
+    """Sum of matching plain samples (counters sum across label sets)."""
+    fam = fams.get(name)
+    if fam is None:
+        return default
+    total, hit = 0.0, False
+    for s in fam["series"]:
+        if s.get("suffix"):
+            continue
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            total += s["value"]
+            hit = True
+    return total if hit else default
+
+
+def _quantile(fams: dict, name: str, q: float,
+              **labels: str) -> float | None:
+    fam = fams.get(name)
+    if fam is None:
+        return None
+    buckets: dict[float | str, float] = {}
+    for s in fam["series"]:
+        if s.get("suffix") != "_bucket":
+            continue
+        lab = dict(s["labels"])
+        le = lab.pop("le", None)
+        if le is None or not all(lab.get(k) == v
+                                 for k, v in labels.items()):
+            continue
+        buckets[le] = buckets.get(le, 0.0) + s["value"]
+
+    def _key(le: str) -> float:
+        return float("inf") if le == "+Inf" else float(le)
+
+    ordered = sorted(buckets.items(), key=lambda kv: _key(kv[0]))
+    return histogram_quantile([(le, c) for le, c in ordered], q)
+
+
+def _tiers(fams: dict) -> list[str]:
+    seen: list[str] = []
+    fam = fams.get("sym_sched_occupancy") or {"series": []}
+    for s in fam["series"]:
+        tier = s["labels"].get("tier", "")
+        if tier and tier not in seen:
+            seen.append(tier)
+    return seen
+
+
+# ------------------------------------------------------------- row model
+
+
+def build_rows(name: str, fams: dict,
+               prev: dict | None, now: float) -> list[dict[str, Any]]:
+    """One provider-level row plus one sub-row per engine tier. `prev`
+    is the previous poll's {"t", "tok", "shed"} for rate deltas."""
+    tok = _value(fams, "sym_provider_tokens_out_total", 0.0)
+    shed = _value(fams, "sym_provider_sheds_total", 0.0)
+    uptime = _value(fams, "sym_provider_uptime_seconds")
+    if prev and now > prev["t"]:
+        dt = now - prev["t"]
+        tok_s = max(tok - prev["tok"], 0.0) / dt
+        # SHED as a rate too (sheds since the last poll): a provider
+        # that shed 10k requests last week but is healthy now must not
+        # look like one actively shedding. --once / the first poll fall
+        # back to the lifetime total.
+        shed_disp = max(shed - prev["shed"], 0.0) / dt
+    else:
+        tok_s = tok / max(uptime, 1e-9) if uptime else None
+        shed_disp = shed
+    link = _value(fams, "sym_link_connected")
+    rows = [{
+        "provider": name, "tier": "",
+        "tok_s": tok_s,
+        "ttft_p50": _quantile(fams, "sym_provider_ttft_seconds", 0.50),
+        "ttft_p99": _quantile(fams, "sym_provider_ttft_seconds", 0.99),
+        "queue": _value(fams, "sym_provider_pending_first_token"),
+        "in_flight": _value(fams, "sym_provider_in_flight"),
+        "occupancy": None,
+        "shed": shed_disp,
+        "link": (None if link is None else ("up" if link else "DOWN")),
+        "_sample": {"t": now, "tok": tok, "shed": shed or 0.0},
+    }]
+    for tier in _tiers(fams):
+        rows.append({
+            "provider": name, "tier": tier,
+            "tok_s": None,
+            # True engine-side TTFT (enqueue → first sampled token),
+            # not dispatch wall — queue wait must show under overload.
+            "ttft_p50": _quantile(fams, "sym_sched_ttft_seconds", 0.50,
+                                  tier=tier),
+            "ttft_p99": _quantile(fams, "sym_sched_ttft_seconds", 0.99,
+                                  tier=tier),
+            "queue": _value(fams, "sym_sched_queue_depth", tier=tier),
+            "in_flight": None,
+            "occupancy": _value(fams, "sym_sched_occupancy", tier=tier),
+            "shed": _value(fams, "sym_sched_deadline_sheds_total",
+                           tier=tier),
+            "link": None,
+        })
+    return rows
+
+
+def _fmt_cell(v: Any, width: int) -> str:
+    if v is None:
+        s = "-"
+    elif isinstance(v, float):
+        s = f"{v:.2f}" if v < 100 else f"{v:.0f}"
+    else:
+        s = str(v)
+    return s[:width].ljust(width)
+
+
+def render_table(rows: list[dict[str, Any]]) -> str:
+    out = ["  ".join(c.ljust(w) for c, w in zip(COLUMNS, WIDTHS))]
+    for r in rows:
+        cells = (r["provider"], r["tier"] or "-", r["tok_s"],
+                 r["ttft_p50"], r["ttft_p99"], r["queue"], r["in_flight"],
+                 r["occupancy"], r["shed"], r["link"] or "-")
+        out.append("  ".join(_fmt_cell(c, w)
+                             for c, w in zip(cells, WIDTHS)))
+    return "\n".join(out)
+
+
+# ----------------------------------------------------------- poll sources
+
+
+def poll_http(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return parse_prometheus_text(resp.read().decode("utf-8"))
+
+
+async def poll_wire(address: str, key_hex: str | None) -> dict:
+    """One metrics probe over the peer wire (stats + tier-labeled
+    registry snapshots ride the same reply)."""
+    from symmetry_tpu.client.client import SymmetryClient
+
+    client = SymmetryClient()
+    key = bytes.fromhex(key_hex) if key_hex else None
+    session = await client.connect_direct(address, provider_key=key)
+    try:
+        stats = await session.stats()
+    finally:
+        await session.close()
+    return families_from_snapshots(
+        (stats.get("metrics") or {}).get("snapshots") or [])
+
+
+# ------------------------------------------------------------------ main
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="symtop", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--metrics-url", action="append", default=[],
+                    metavar="URL",
+                    help="Prometheus exposition endpoint to poll "
+                         "(repeatable)")
+    ap.add_argument("--provider", action="append", default=[],
+                    metavar="ADDR",
+                    help="provider address to poll over the peer wire "
+                         "(repeatable; tcp://host:port)")
+    ap.add_argument("--key", action="append", default=[], metavar="HEX",
+                    help="expected provider public key for the matching "
+                         "--provider (positional pairing; optional)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one table and exit (CI / scripts)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit rows as JSON lines instead of the table")
+    args = ap.parse_args(argv)
+    if not args.metrics_url and not args.provider:
+        ap.error("give at least one --metrics-url or --provider")
+
+    targets: list[tuple[str, str, str | None]] = []
+    for url in args.metrics_url:
+        targets.append(("http", url, None))
+    for i, addr in enumerate(args.provider):
+        targets.append(("wire", addr,
+                        args.key[i] if i < len(args.key) else None))
+
+    prev: dict[str, dict] = {}
+    loop = asyncio.new_event_loop()
+    try:
+        while True:
+            now = time.monotonic()
+            rows: list[dict[str, Any]] = []
+            for kind, where, key in targets:
+                short = where.split("//")[-1]
+                try:
+                    fams = (poll_http(where) if kind == "http"
+                            else loop.run_until_complete(
+                                asyncio.wait_for(poll_wire(where, key),
+                                                 10.0)))
+                except Exception as exc:  # noqa: BLE001 — show, keep polling
+                    rows.append({"provider": short, "tier": "",
+                                 "tok_s": None, "ttft_p50": None,
+                                 "ttft_p99": None, "queue": None,
+                                 "in_flight": None, "occupancy": None,
+                                 "shed": None,
+                                 "link": f"ERR:{type(exc).__name__}"})
+                    continue
+                target_rows = build_rows(short, fams, prev.get(where), now)
+                sample = target_rows[0].pop("_sample", None)
+                if sample:
+                    prev[where] = sample
+                rows.extend(target_rows)
+            if args.as_json:
+                print(json.dumps(rows))
+            else:
+                if not args.once:
+                    sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+                    print(f"symtop — {len(targets)} target(s), every "
+                          f"{args.interval:.0f}s — "
+                          f"{time.strftime('%H:%M:%S')}\n")
+                print(render_table(rows))
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        loop.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
