@@ -291,6 +291,7 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             fused_dequant: bool = False, trace_out: str | None = None,
             tracing: bool = True, disagg: bool = False,
             disagg_transport: str | None = None,
+            disagg_pool: tuple[int, int] | None = None,
             multi_turn: int = 1,
             metrics_out: str | None = None) -> dict:
     """The NORTH-STAR measurement (BASELINE.json metric): aggregate WIRE
@@ -366,12 +367,20 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 # inline prefill node inside the provider process,
                 # reached ONLY over the mem:// or tcp:// link).
                 **({"role": "disagg"} if disagg else {}),
+                # --disagg-pool MxN: the elastic pool (inline prefill
+                # members + N local decode hosts, engine/disagg/pool.py)
+                # instead of the fixed pair; --disagg-transport picks
+                # the member-link transport (memory default).
                 **({"disagg": {
                         "peer": ("tcp://127.0.0.1:0"
                                  if disagg_transport == "tcp"
                                  else "mem://bench-disagg"),
-                        "inline": True}}
-                   if disagg and disagg_transport else {}),
+                        **({"inline": True} if not disagg_pool else {}),
+                        **({"pool": {"prefill": disagg_pool[0],
+                                     "decode": disagg_pool[1]}}
+                           if disagg_pool else {})}}
+                   if disagg and (disagg_transport or disagg_pool)
+                   else {}),
                 # tracing=False empties the engine-side span rings — the
                 # A/B knob for proving the recorder's overhead stays
                 # under 1% of greedy decode tok/s (--no-trace vs default
@@ -1039,6 +1048,23 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                         "drops": link.get("drops"),
                         "partial_discards": link.get("partial_discards"),
                     }
+                # Elastic-pool block (--disagg-pool): per-node
+                # membership + placements and the churn ledger
+                # (re-placements after any node loss during the run) —
+                # the 2×2-vs-1×1 row schema of the pre-registered
+                # BASELINE.md pool protocol.
+                pool = dg.get("pool")
+                if pool:
+                    diag["disagg"]["pool"] = pool
+                    per_node = {mid: m.get("placements")
+                                for mid, m in
+                                (pool.get("members") or {}).items()}
+                    print(f"[bench] disagg pool: healthy "
+                          f"{pool.get('healthy')} | placements "
+                          f"{per_node} | re-placements "
+                          f"{pool.get('re_placements')} | losses "
+                          f"{pool.get('losses')} | drains "
+                          f"{pool.get('drains')}", file=sys.stderr)
                 print(f"[bench] disagg: {dg.get('handoff_frames')} "
                       f"handoffs / {dg.get('handoff_bytes')} bytes "
                       f"({dg.get('prefix_tokens')} prefix tokens, "
@@ -1187,7 +1213,9 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                          else "")
                       + (f", speculative wave (k={draft_k})" if speculative
                          else "")
-                      + ((", disagg prefill/decode tiers"
+                      + ((", disagg "
+                          + (f"{disagg_pool[0]}x{disagg_pool[1]} pool"
+                             if disagg_pool else "prefill/decode tiers")
                           + (f" over {disagg_transport} link"
                              if disagg_transport else ""))
                          if disagg else "")
@@ -1401,6 +1429,17 @@ def main() -> None:
                          "sockets). Adds handoff wire latency/bytes/"
                          "retries/credit-stalls to the JSON beside the "
                          "serialize wall (--disagg only)")
+    ap.add_argument("--disagg-pool", default=None, metavar="MxN",
+                    help="elastic M-prefill × N-decode pool (implies "
+                         "--disagg): M inline prefill members + N local "
+                         "decode hosts joined by per-member handoff "
+                         "links (engine/disagg/pool.py), least-loaded "
+                         "placement, per-node supervision. Per-node "
+                         "placements and churn re-placements land in "
+                         "the JSON's engine.disagg.pool block — the "
+                         "2x2-vs-1x1 row schema of the BASELINE.md "
+                         "pool protocol. Transport from "
+                         "--disagg-transport (memory default)")
     ap.add_argument("--multi-turn", type=int, default=1, metavar="N",
                     help="conversation workload (--e2e): every client "
                          "runs one N-turn session, re-submitting the "
@@ -1519,6 +1558,16 @@ def main() -> None:
     if args.disagg_transport and not args.disagg:
         ap.error("--disagg-transport selects the handoff link for the "
                  "disagg pair; it needs --disagg")
+    pool_mn = None
+    if args.disagg_pool:
+        try:
+            m, n = args.disagg_pool.lower().split("x")
+            pool_mn = (int(m), int(n))
+        except ValueError:
+            pool_mn = None
+        if pool_mn is None or pool_mn[0] < 1 or pool_mn[1] < 1:
+            ap.error("--disagg-pool wants MxN with M,N >= 1 (e.g. 2x2)")
+        args.disagg = True  # the pool IS a disagg topology
     if args.clients is None:
         args.clients = (32 if args.multi_turn > 1
                         else 96 if (args.shared_prefix or args.speculative)
@@ -1631,6 +1680,7 @@ def main() -> None:
                 trace_out=args.trace_out, tracing=not args.no_trace,
                 disagg=args.disagg,
                 disagg_transport=args.disagg_transport,
+                disagg_pool=pool_mn,
                 multi_turn=args.multi_turn,
                 metrics_out=args.metrics_out)
 

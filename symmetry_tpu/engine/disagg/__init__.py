@@ -33,6 +33,11 @@ from symmetry_tpu.engine.disagg.net import (
     LinkConfig,
     LinkError,
 )
+from symmetry_tpu.engine.disagg.pool import (
+    MemberState,
+    PoolConfig,
+    PoolRouter,
+)
 
 __all__ = [
     "DEFAULT_DECODE_PREFIX_MB",
@@ -42,6 +47,9 @@ __all__ = [
     "KVHandoff",
     "LinkConfig",
     "LinkError",
+    "MemberState",
+    "PoolConfig",
+    "PoolRouter",
     "decode_frame",
     "decode_kv_handoff",
     "derive_role_config",

@@ -77,8 +77,11 @@ class HandoffBroker:
 
     def __init__(self) -> None:
         # request id -> (submit fields the decode host will need,
-        #               submit monotonic stamp)
-        self._pending: dict[str, tuple[dict[str, Any], float]] = {}
+        #               submit monotonic stamp,
+        #               prefill member holding the migration — None for
+        #               the pair, a pool member id in pool mode)
+        self._pending: dict[str, tuple[dict[str, Any], float,
+                                       str | None]] = {}
         self.counters = {"submitted": 0, "handoff_frames": 0,
                          "handoff_bytes": 0, "prefix_tokens": 0,
                          "routing_only": 0, "dropped": 0,
@@ -125,16 +128,38 @@ class HandoffBroker:
 
     # ------------------------------------------------------------- state
 
-    def note_submit(self, request_id: str, submit: dict[str, Any]) -> None:
+    def note_submit(self, request_id: str,
+                    submit: dict[str, Any]) -> None:
         """Remember the request state that must survive the migration.
         `submit` is the host-pipe submit op; only the decode-relevant
-        fields are kept (messages stay behind — tokens ride the frame)."""
+        fields are kept (messages stay behind — tokens ride the frame).
+        The entry's member slot starts None (the fixed pair never sets
+        it); the elastic pool writes it through reassign() once the
+        placed submit is actually delivered."""
         keep = {k: submit[k] for k in
                 ("max_new", "sampling", "speculative", "trace", "deadline_s")
                 if k in submit}
-        self._pending[request_id] = (keep, time.monotonic())
+        self._pending[request_id] = (keep, time.monotonic(), None)
         self.counters["submitted"] += 1
         self._m_pending.set(len(self._pending))
+
+    def reassign(self, request_id: str, member: str | None) -> None:
+        """Re-placement: the migration moved to another member. The
+        submit stamp is PRESERVED — deadline rebasing stays anchored to
+        the provider submit, so churn never refunds a deadline."""
+        entry = self._pending.get(request_id)
+        if entry is not None:
+            self._pending[request_id] = (entry[0], entry[1], member)
+
+    def pending_on(self, member: str) -> list[str]:
+        """Request ids whose migration is pending on ONE member
+        (non-destructive — the re-placement path keeps the entries so
+        the eventual handoff still finds its state). The member-down
+        path unions this with the router's own view: the broker is
+        authoritative for 'submitted but not yet adopted', so a
+        migration the router lost track of still gets re-placed."""
+        return [rid for rid, (_, _, m) in self._pending.items()
+                if m == member]
 
     def forget(self, request_id: str) -> None:
         """The request ended on the prefill tier (tokenization error,
@@ -179,7 +204,7 @@ class HandoffBroker:
         entry = self._pending.pop(req_id, None)
         if entry is None:
             return None
-        keep, t_submit = entry
+        keep, t_submit, _member = entry
         now = time.monotonic()
         self.prefill_tier_hist.observe(now - t_submit)
         self._m_prefill_tier.observe(now - t_submit)

@@ -274,8 +274,17 @@ class LinkConfig:
 
     def __init__(self, disagg: dict[str, Any] | None) -> None:
         d = disagg or {}
+        self._raw: dict[str, Any] = dict(d)
         self.peer: str | None = d.get("peer")
         self.listen: str | None = d.get("listen")
+        # Stable per-link identity announced in the hello (pool
+        # membership); defaults to the bound/dialed address when unset.
+        self.node_id: str | None = d.get("node_id")
+        # Link keepalive (pool mode): the decode side pings every
+        # heartbeat_s and DROPS a link silent for ~2 periods — a wedged
+        # peer becomes a membership-churn event instead of a hang. 0
+        # (the pair default) disables it.
+        self.heartbeat_s: float = float(d.get("heartbeat_s", 0.0))
         # inline: the backend self-hosts the PrefillNode in-process and
         # dials it at `peer` — the full wire path (chunking, credit,
         # acks, reconnect) in one provider process. Benches, smokes, and
@@ -306,6 +315,12 @@ class LinkConfig:
     @property
     def network_mode(self) -> bool:
         return self.peer is not None
+
+    def for_peer(self, peer: str, **overrides: Any) -> "LinkConfig":
+        """A member-link config: this config with `peer` (and any
+        per-member overrides, e.g. heartbeat_s) replaced — how the pool
+        derives M per-member links from one `tpu.disagg` mapping."""
+        return LinkConfig({**self._raw, "peer": peer, **overrides})
 
 
 _MEM_HUB = None
@@ -656,6 +671,10 @@ class DecodeLink:
       on_down(reason)          the link just died; in-flight migrations
                                must shed (reconnect is automatic)
       on_up()                  link (re)connected and clock-synced
+      on_drain(node)           peer announced a deliberate drain: stop
+                               NEW placements; in-flight work finishes
+      on_leave(node)           peer announced departure (membership
+                               churn, not a fault)
     """
 
     def __init__(self, cfg: LinkConfig, *,
@@ -663,13 +682,22 @@ class DecodeLink:
                  on_event: Callable[[dict], None],
                  on_fail: Callable[[str, str], None],
                  on_down: Callable[[str], None],
-                 on_up: Callable[[], None] | None = None) -> None:
+                 on_up: Callable[[], None] | None = None,
+                 on_drain: Callable[[str], None] | None = None,
+                 on_leave: Callable[[str], None] | None = None) -> None:
         self.cfg = cfg
         self._on_handoff = on_handoff
         self._on_event = on_event
         self._on_fail = on_fail
         self._on_down = on_down
         self._on_up = on_up
+        self._on_drain = on_drain
+        self._on_leave = on_leave
+        # Peer-announced identity off the hello ("node") — the pool
+        # router's member naming; falls back to the dialed address.
+        self.peer_node: str | None = None
+        self._last_rx = 0.0
+        self._hb_task: asyncio.Task | None = None
         self._transport = link_transport(cfg.peer)
         self._link: HandoffLink | None = None
         self._reasm = Reassembler()
@@ -686,8 +714,12 @@ class DecodeLink:
             MetricName.LINK_CONNECTS, "handoff link connects")
         self._m_drops = METRICS.counter(
             MetricName.LINK_DROPS, "handoff link drops")
+        # Peer-labeled: a pool runs one DecodeLink per member, and an
+        # unlabeled gauge would be clobbered by whichever link moved
+        # last. The pair gets one series; symtop sums across peers.
         self._m_connected = METRICS.gauge(
-            MetricName.LINK_CONNECTED, "handoff link up (1) / down (0)")
+            MetricName.LINK_CONNECTED, "handoff link up (1) / down (0)",
+            labels=("peer",))
         self._m_wire_frames = METRICS.counter(
             MetricName.LINK_WIRE_FRAMES,
             "complete handoff frames received off the link")
@@ -714,6 +746,9 @@ class DecodeLink:
         import contextlib
 
         self._stopped = True
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            self._hb_task = None
         if self._task is not None:
             self._task.cancel()
             with contextlib.suppress(asyncio.CancelledError):
@@ -792,6 +827,7 @@ class DecodeLink:
                 await link.send({"op": LinkOp.HELLO,
                                  "version": LINK_VERSION,
                                  "role": "decode",
+                                 "node": self.cfg.node_id or "",
                                  "window": self.cfg.credit_bytes})
                 msg = await link.recv()
                 if msg is None or msg[0].get("op") != LinkOp.HELLO:
@@ -801,6 +837,8 @@ class DecodeLink:
                         f"link version mismatch: peer speaks "
                         f"{msg[0].get('version')}, this build "
                         f"{LINK_VERSION}")
+                self.peer_node = (str(msg[0].get("node") or "")
+                                  or self.cfg.peer)
                 self.clock_offset = await link_clock_handshake(link)
             except Exception as exc:  # noqa: BLE001 — handshake failure
                 await link.close()
@@ -814,11 +852,15 @@ class DecodeLink:
             self._connected.set()
             self.stats["connects"] += 1
             self._m_connects.inc()
-            self._m_connected.set(1)
+            self._m_connected.set(1, peer=self.cfg.peer or "")
             log.info(f"handoff link up: {link.remote_address} "
                      f"clock_offset={self.clock_offset * 1e6:+.0f}us")
             if self._on_up is not None:
                 self._on_up()
+            self._last_rx = time.monotonic()
+            if self.cfg.heartbeat_s > 0:
+                self._hb_task = asyncio.get_running_loop().create_task(
+                    self._heartbeat(link))
             try:
                 reason = await self._pump(link)
             except Exception as exc:  # noqa: BLE001 — a malformed header
@@ -826,11 +868,14 @@ class DecodeLink:
                 # reconnect, never silently kill this task while
                 # _connected stays set and every stream hangs.
                 reason = f"link pump error: {exc!r}"
+            if self._hb_task is not None:
+                self._hb_task.cancel()
+                self._hb_task = None
             self._connected.clear()
             self._link = None
             self.stats["drops"] += 1
             self._m_drops.inc()
-            self._m_connected.set(0)
+            self._m_connected.set(0, peer=self.cfg.peer or "")
             shed = self._reasm.abort_all()
             for lst in self._waiters.values():
                 for fut in lst:
@@ -844,6 +889,26 @@ class DecodeLink:
                         f"transfer(s) discarded; reconnecting")
             self._on_down(reason)
 
+    async def _heartbeat(self, link: HandoffLink) -> None:
+        """Keepalive pings (pool mode). ANY inbound traffic counts as
+        liveness (_last_rx is stamped by the pump); a link silent for
+        ~2 periods is cut here — the pump sees the close, the down path
+        sheds, and the reconnect loop owns recovery. A wedged-but-
+        connected peer thus becomes ordinary membership churn."""
+        period = self.cfg.heartbeat_s
+        while not link.closed:
+            await asyncio.sleep(period)
+            silent = time.monotonic() - self._last_rx
+            if silent > 2 * period:
+                await link.drop(
+                    f"keepalive: no traffic for {silent:.1f}s")
+                return
+            try:
+                await link.send({"op": LinkOp.PING,
+                                 "t": time.monotonic()})
+            except LinkError:
+                return  # pump is already tearing the link down
+
     async def _pump(self, link: HandoffLink) -> str:
         while True:
             try:
@@ -852,6 +917,7 @@ class DecodeLink:
                 return str(exc)
             if msg is None:
                 return "link EOF"
+            self._last_rx = time.monotonic()
             header, payload = msg
             op = header.get("op")
             try:
@@ -879,6 +945,14 @@ class DecodeLink:
                     for fut in waiters:
                         if not fut.done():
                             fut.set_result(reply)
+                elif op == LinkOp.DRAIN:
+                    if self._on_drain is not None:
+                        self._on_drain(str(header.get("node", "")))
+                elif op == LinkOp.LEAVE:
+                    if self._on_leave is not None:
+                        self._on_leave(str(header.get("node", "")))
+                elif op == LinkOp.PONG:
+                    pass  # liveness already stamped by _last_rx above
                 elif op == LinkOp.CLOCK:
                     # Stray post-handshake probe echo; ignore.
                     pass
@@ -948,12 +1022,16 @@ class PrefillLink:
 
     def __init__(self, link: HandoffLink, cfg: LinkConfig, *,
                  on_command: Callable[[bytes], Awaitable[None]],
-                 on_probe: Callable[[str], Awaitable[dict | None]]
-                 ) -> None:
+                 on_probe: Callable[[str], Awaitable[dict | None]],
+                 node_id: str | None = None) -> None:
         self._link = link
         self._cfg = cfg
         self._on_command = on_command
         self._on_probe = on_probe
+        # Identity announced in the hello reply — the pool router's
+        # member naming for this node.
+        self.node_id = node_id or cfg.node_id or ""
+        self.peer_node: str | None = None  # dialer's announced identity
         # Window starts at the peer's advertised hello value; replaced
         # in handshake().
         self._gate = CreditGate(cfg.credit_bytes)
@@ -982,12 +1060,14 @@ class PrefillLink:
                     f"link version mismatch: peer speaks "
                     f"{msg[0].get('version')}, this build {LINK_VERSION}")
             window = int(msg[0].get("window", self._cfg.credit_bytes))
+            self.peer_node = str(msg[0].get("node") or "") or None
             self._gate = CreditGate(window)
             self.sender = HandoffSender(self._link, self._gate,
                                         self._cfg, window=window)
             await self._link.send({"op": LinkOp.HELLO,
                                    "version": LINK_VERSION,
                                    "role": "prefill",
+                                   "node": self.node_id,
                                    "window": window})
 
         await asyncio.wait_for(_hello(), timeout)
@@ -1000,6 +1080,16 @@ class PrefillLink:
         await self._link.send(
             {"op": LinkOp.EVENT},
             json.dumps(ev, separators=(",", ":")).encode())
+
+    async def send_drain(self) -> None:
+        """Announce a deliberate drain: the decode side's pool router
+        stops placing NEW work here; in-flight requests finish."""
+        await self._link.send({"op": LinkOp.DRAIN, "node": self.node_id})
+
+    async def send_leave(self) -> None:
+        """Announce departure (drain complete / shutdown): membership
+        churn the router accounts, not a fault it recovers from."""
+        await self._link.send({"op": LinkOp.LEAVE, "node": self.node_id})
 
     async def serve(self) -> str:
         """Inbound pump until the link dies; returns the reason."""
@@ -1029,6 +1119,12 @@ class PrefillLink:
                     await link.send({"op": LinkOp.CLOCK,
                                      "t0": header.get("t0"),
                                      "t": time.monotonic()})
+                except LinkError as exc:
+                    return str(exc)
+            elif op == LinkOp.PING:
+                try:
+                    await link.send({"op": LinkOp.PONG,
+                                     "t": header.get("t")})
                 except LinkError as exc:
                     return str(exc)
             elif op in (LinkOp.STATS, LinkOp.TRACE):

@@ -70,6 +70,11 @@ class PrefillNode:
             raise ValueError(
                 "prefill node needs tpu.disagg.listen (or an explicit "
                 "listen address)")
+        # Pool identity: announced in the link hello so the decode
+        # side's router names this member stably across reconnects.
+        # Defaults to the (resolved) listen address.
+        self._node_id: str | None = self._link_cfg.node_id
+        self._draining = False
         sup = config.tpu.supervisor or {}
         self._backoff_base_s = float(sup.get("backoff_base_s", 0.5))
         self._backoff_max_s = float(sup.get("backoff_max_s", 15.0))
@@ -109,6 +114,14 @@ class PrefillNode:
             return self._listen
         return self._listener.address
 
+    @property
+    def node_id(self) -> str:
+        return self._node_id or self.address
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     # ---------------------------------------------------------- lifecycle
 
     def _host_argv(self, cfg_path: str) -> list[str]:
@@ -140,8 +153,56 @@ class PrefillNode:
         log.info(f"prefill node up: host pid {self._proc.pid}, "
                  f"listening {self.address}")
 
+    async def drain(self) -> None:
+        """Deliberate drain: announce over the live link (the decode
+        side's pool router excludes this member from NEW placements;
+        in-flight work finishes here). Sticky across reconnects — a
+        link that re-establishes mid-drain gets the announce again."""
+        self._draining = True
+        plink = self._plink
+        if plink is not None and not plink.closed:
+            with contextlib.suppress(LinkError):
+                await plink.send_drain()
+        log.info(f"prefill node {self.node_id}: draining")
+
+    async def kill(self) -> None:
+        """Chaos drill: die like a CRASHED node — no drain, no leave.
+        The listener closes, the link cuts mid-whatever, the host is
+        SIGKILLed. The decode side must account it as membership churn
+        (member lost, in-flight re-placed), never as a clean leave."""
+        self._stopped = True
+        for task in (self._supervisor_task, self._pump_task):
+            if task is not None:
+                task.cancel()
+        self._supervisor_task = self._pump_task = None
+        if self._listener is not None:
+            await self._listener.close()
+            self._listener = None
+        if self._plink is not None:
+            await self._plink.close()
+            self._plink = None
+        if self._proc is not None:
+            with contextlib.suppress(ProcessLookupError):
+                self._proc.kill()
+            with contextlib.suppress(Exception):
+                await self._proc.wait()
+            self._proc = None
+        if self._cfg_path:
+            import os
+
+            with contextlib.suppress(OSError):
+                os.unlink(self._cfg_path)
+            self._cfg_path = None
+
     async def stop(self) -> None:
         self._stopped = True
+        plink = self._plink
+        if plink is not None and not plink.closed:
+            # Departure is membership churn, not a fault: the leave
+            # announce lets the router account it as such (best-effort —
+            # a dead link already told the peer the louder way).
+            with contextlib.suppress(LinkError):
+                await plink.send_leave()
         for task in (self._supervisor_task, self._pump_task):
             if task is not None:
                 task.cancel()
@@ -331,7 +392,8 @@ class PrefillNode:
                                      initiator=False)
             plink = PrefillLink(link, self._link_cfg,
                                 on_command=self._forward_command,
-                                on_probe=self._link_probe)
+                                on_probe=self._link_probe,
+                                node_id=self.node_id)
             await plink.handshake()
         except Exception as exc:  # noqa: BLE001 — reject bad dialers
             log.warning(f"handoff link handshake rejected: {exc}")
@@ -343,6 +405,11 @@ class PrefillNode:
             await old.close()
         self.stats["links_accepted"] += 1
         log.info(f"handoff link accepted from {link.remote_address}")
+        if self._draining:
+            # Drain is sticky: a link re-established mid-drain must not
+            # silently rejoin the placement set.
+            with contextlib.suppress(LinkError):
+                await plink.send_drain()
         # Serve inline on the handler task: the transport layer keeps it
         # alive until serve() returns (EOF / link error). The finally
         # guarantees a pump killed by ANY exception (malformed header
@@ -443,15 +510,37 @@ class PrefillNode:
 async def _serve(config_path: str) -> int:
     from symmetry_tpu.provider.config import ConfigManager
 
-    node = PrefillNode(ConfigManager(config_path=config_path))
+    config = ConfigManager(config_path=config_path)
+    node = PrefillNode(config)
     await node.start()
     stop = asyncio.Event()
     import signal
 
     loop = asyncio.get_running_loop()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        with contextlib.suppress(NotImplementedError):
-            loop.add_signal_handler(sig, stop.set)
+    # SIGTERM = deliberate drain: announce (router stops placing here),
+    # let in-flight work finish for drain_grace_s, then leave + exit.
+    # A second SIGTERM — or SIGINT — stops immediately.
+    grace_s = float((getattr(config.tpu, "disagg", None) or {})
+                    .get("drain_grace_s", 30.0))
+
+    drain_started = False
+
+    def _on_term() -> None:
+        # Flag locally, not via node.draining: the drain() task may not
+        # have RUN yet when a rapid second SIGTERM arrives — that second
+        # signal must stop now, not arm another grace timer.
+        nonlocal drain_started
+        if drain_started:
+            stop.set()
+        else:
+            drain_started = True
+            asyncio.ensure_future(node.drain())
+            loop.call_later(grace_s, stop.set)
+
+    with contextlib.suppress(NotImplementedError):
+        loop.add_signal_handler(signal.SIGTERM, _on_term)
+    with contextlib.suppress(NotImplementedError):
+        loop.add_signal_handler(signal.SIGINT, stop.set)
     try:
         _, pending = await asyncio.wait(
             [asyncio.ensure_future(stop.wait()),

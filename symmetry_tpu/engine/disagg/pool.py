@@ -1,0 +1,401 @@
+"""Elastic disaggregated pools: M-prefill × N-decode membership + placement.
+
+PR 7/9 built ONE prefill × ONE decode pair; a pair makes each tier a
+single point of failure — one node death sheds every in-flight migration
+and the whole unit respawns. DistServe and Splitwise (PAPERS.md) both
+show the production win is phase-specific POOLS: independently sized
+tiers where a dead node is a capacity event, not an outage. This module
+is the membership/placement brain of that generalization:
+
+    clients ──▶ tpu_native backend (provider process)
+                    │ PoolRouter.place()            least-loaded healthy
+                    ▼                               prefill member
+    prefill-0  prefill-1 … prefill-M-1    (PrefillNode each, own machine
+      │ handoff frames over per-member     or inline; per-member
+      ▼ DecodeLinks                        DecodeLink + supervision)
+    PoolRouter.route_decode()             decode member by queue-depth /
+      │                                    burn-rate gauges
+      ▼
+    decode-0   decode-1 … decode-N-1      (local engine hosts, each its
+                                           own supervision domain)
+
+The router is deliberately PURE STATE — no asyncio, no sockets, no
+subprocesses. The backend owns the plumbing (links, host pipes, respawn
+loops) and drives the router through a narrow verb set, which is what
+makes every membership/placement rule unit-testable in microseconds:
+
+  add_member / mark_joining / mark_healthy    join + hot-join/rejoin
+  drain(member)                               no NEW placements; in-flight
+                                              finishes (deliberate drain)
+  on_lost(member) -> [request ids]            node death / link loss: the
+                                              in-flight work to RE-PLACE
+                                              on a survivor (never failed
+                                              outright; only when no
+                                              survivor exists does the
+                                              caller shed retryable)
+  place(request) / route_decode(request)      placement decisions
+  update_gauges(member, queue_depth, …)       telemetry feed (PR 10's
+                                              gauges as the control
+                                              signal)
+
+Placement policy: least-loaded healthy member — score is the member's
+live in-flight count plus its last-reported queue-depth gauge, burn rate
+as the tie-break (scale AWAY from the tier that is burning SLO budget),
+then lifetime placements (round-robin among idle equals). A pool of one
+degenerates exactly to the pair: the single member takes every placement
+while healthy, and its loss leaves nothing to re-place onto — the caller
+sheds structured-retryable, the PR 7/9 behavior.
+
+Membership states (one-way transitions except rejoin):
+
+    joining ──▶ healthy ──▶ draining ──▶ lost
+                   ▲  └──────────────────▶ lost
+                   └── hot-(re)join ◀──────┘
+
+All state changes land in the always-on metrics registry
+(utils/metrics.py) so symtop and any Prometheus scrape see the pool:
+member counts, per-node state, per-node placements, re-placements and
+drains — churn is accounted, never silent.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from symmetry_tpu.utils.metrics import METRICS, MetricName
+
+PREFILL = "prefill"
+DECODE = "decode"
+
+
+class MemberState:
+    """Pool-membership lifecycle states (wire-visible in stats/symtop)."""
+
+    JOINING = "joining"    # link up / spawn started, not yet serving
+    HEALTHY = "healthy"    # taking placements
+    DRAINING = "draining"  # no new placements; in-flight finishes
+    LOST = "lost"          # node death / link loss / left — capacity gone
+
+
+# Gauge encoding for sym_pool_member_state (symtop decodes it back).
+STATE_CODES = {MemberState.JOINING: 0, MemberState.HEALTHY: 1,
+               MemberState.DRAINING: 2, MemberState.LOST: 3}
+
+
+class PoolConfig:
+    """The `tpu.disagg.pool` mapping. Present ⇒ pool mode; absent ⇒ the
+    backend keeps the PR 7/9 pair semantics untouched.
+
+    Keys:
+      prefill     int M (inline/self-addressed members) or a list of
+                  peer addresses to dial (one member per address)
+      decode      int N — local decode engine hosts (default 1)
+      heartbeat_s link keepalive period (ping/pong; 0 disables); also
+                  the decode-member stats-probe/gauge-refresh period
+    """
+
+    def __init__(self, disagg: dict[str, Any] | None) -> None:
+        d = (disagg or {}).get("pool") or {}
+        self.enabled: bool = bool(d)
+        prefill = d.get("prefill", 1)
+        if isinstance(prefill, (list, tuple)):
+            self.prefill_peers: list[str] | None = [str(p) for p in prefill]
+            self.prefill_count: int = len(self.prefill_peers)
+        else:
+            self.prefill_peers = None
+            self.prefill_count = max(int(prefill), 1)
+        self.decode_count: int = max(int(d.get("decode", 1)), 1)
+        self.heartbeat_s: float = float(d.get("heartbeat_s", 5.0))
+
+
+class PoolMember:
+    """One tier member's membership + load state (router-owned)."""
+
+    __slots__ = ("member_id", "tier", "state", "in_flight", "placements",
+                 "queue_depth", "burn_rate", "node_id", "joined_at",
+                 "state_since", "losses", "restarts")
+
+    def __init__(self, member_id: str, tier: str) -> None:
+        self.member_id = member_id
+        self.tier = tier
+        self.state = MemberState.JOINING
+        self.in_flight: set[str] = set()   # request ids placed/adopted here
+        self.placements = 0                # lifetime placements
+        self.queue_depth = 0.0             # last gauge feed
+        self.burn_rate = 0.0
+        self.node_id: str | None = None    # peer-announced identity
+        self.joined_at = time.monotonic()
+        self.state_since = self.joined_at
+        self.losses = 0                    # times this member went lost
+        self.restarts = 0                  # per-member respawns (decode)
+
+    @property
+    def placeable(self) -> bool:
+        return self.state == MemberState.HEALTHY
+
+    def score(self) -> tuple:
+        """Lower places first: live load + reported backlog, SLO burn as
+        the tie-break, lifetime placements as round-robin among idle
+        equals, member id for determinism."""
+        return (len(self.in_flight) + self.queue_depth, self.burn_rate,
+                self.placements, self.member_id)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"tier": self.tier, "state": self.state,
+                "node": self.node_id, "in_flight": len(self.in_flight),
+                "placements": self.placements,
+                "queue_depth": self.queue_depth,
+                "burn_rate": round(self.burn_rate, 4),
+                "losses": self.losses, "restarts": self.restarts,
+                "state_age_s": round(
+                    time.monotonic() - self.state_since, 3)}
+
+
+class PoolRouter:
+    """Membership registry + placement for one elastic disagg pool.
+
+    Thread contract: all calls happen on the backend's event loop (the
+    link callbacks, the readers, and stream() all live there) — same
+    no-locking contract as the broker."""
+
+    def __init__(self) -> None:
+        self._members: dict[str, PoolMember] = {}
+        # request id -> member id, per tier (a request is assigned to at
+        # most one prefill member pre-handoff, one decode member after).
+        self._assigned: dict[str, str] = {}
+        self._adopted: dict[str, str] = {}
+        self.counters = {"placements": 0, "re_placements": 0,
+                         "drains": 0, "losses": 0, "joins": 0,
+                         "rejoins": 0}
+        self._m_members = METRICS.gauge(
+            MetricName.POOL_MEMBERS, "pool members known (any state)",
+            labels=("tier",))
+        self._m_healthy = METRICS.gauge(
+            MetricName.POOL_HEALTHY, "pool members taking placements",
+            labels=("tier",))
+        self._m_state = METRICS.gauge(
+            MetricName.POOL_MEMBER_STATE,
+            "per-member state (0 joining, 1 healthy, 2 draining, 3 lost)",
+            labels=("tier", "node"))
+        self._m_placements = METRICS.counter(
+            MetricName.POOL_PLACEMENTS, "requests placed on a member",
+            labels=("tier", "node"))
+        self._m_replacements = METRICS.counter(
+            MetricName.POOL_REPLACEMENTS,
+            "in-flight requests re-placed off a lost/drained member")
+        self._m_drains = METRICS.counter(
+            MetricName.POOL_DRAINS, "members drained (deliberate)")
+
+    # --------------------------------------------------------- membership
+
+    def members(self, tier: str | None = None) -> list[PoolMember]:
+        return [m for m in self._members.values()
+                if tier is None or m.tier == tier]
+
+    def get(self, member_id: str) -> PoolMember | None:
+        return self._members.get(member_id)
+
+    def add_member(self, member_id: str, tier: str,
+                   node_id: str | None = None) -> PoolMember:
+        if tier not in (PREFILL, DECODE):
+            raise ValueError(f"pool member tier must be prefill|decode, "
+                             f"got {tier!r}")
+        m = self._members.get(member_id)
+        if m is None:
+            m = PoolMember(member_id, tier)
+            self._members[member_id] = m
+        if node_id:
+            m.node_id = node_id
+        self._refresh_gauges(m)
+        return m
+
+    def _set_state(self, m: PoolMember, state: str) -> None:
+        if m.state != state:
+            m.state = state
+            m.state_since = time.monotonic()
+        self._refresh_gauges(m)
+
+    def mark_joining(self, member_id: str) -> None:
+        m = self._members[member_id]
+        self._set_state(m, MemberState.JOINING)
+
+    def mark_healthy(self, member_id: str,
+                     node_id: str | None = None) -> None:
+        """Member is serving: first join, hot-join, or rejoin after a
+        loss — churn in, not a special case."""
+        m = self._members[member_id]
+        if node_id:
+            m.node_id = node_id
+        if m.state == MemberState.LOST:
+            self.counters["rejoins"] += 1
+        elif m.state == MemberState.JOINING:
+            self.counters["joins"] += 1
+        self._set_state(m, MemberState.HEALTHY)
+
+    def drain(self, member_id: str) -> None:
+        """Deliberate drain: excluded from NEW placements immediately;
+        whatever is in flight finishes (or is re-placed by on_lost if
+        the node dies mid-drain)."""
+        m = self._members[member_id]
+        if m.state not in (MemberState.DRAINING, MemberState.LOST):
+            self.counters["drains"] += 1
+            self._m_drains.inc()
+            self._set_state(m, MemberState.DRAINING)
+
+    def on_lost(self, member_id: str) -> list[str]:
+        """Node death / link loss / leave: capacity is gone NOW. Returns
+        the request ids that were in flight there — the caller re-places
+        each on a survivor (or sheds structured-retryable when none
+        exists). Idempotent: a second loss signal returns []."""
+        m = self._members.get(member_id)
+        if m is None:
+            return []
+        if m.state != MemberState.LOST:
+            m.losses += 1
+            self.counters["losses"] += 1
+        self._set_state(m, MemberState.LOST)
+        ids = sorted(m.in_flight)
+        m.in_flight.clear()
+        for req_id in ids:
+            if self._assigned.get(req_id) == member_id:
+                self._assigned.pop(req_id, None)
+            if self._adopted.get(req_id) == member_id:
+                self._adopted.pop(req_id, None)
+        return ids
+
+    # --------------------------------------------------------- placement
+
+    def _pick(self, tier: str,
+              exclude: set[str] | frozenset = frozenset()
+              ) -> PoolMember | None:
+        live = [m for m in self._members.values()
+                if m.tier == tier and m.placeable
+                and m.member_id not in exclude]
+        if not live:
+            return None
+        return min(live, key=PoolMember.score)
+
+    def place(self, request_id: str, *,
+              exclude: set[str] | frozenset = frozenset()) -> str | None:
+        """Least-loaded healthy PREFILL member for one request; None
+        when no member is placeable (caller sheds retryable). ASSIGNS
+        only — the caller confirms with record_placement() once the
+        submit actually reached the member, so a refused send (walked
+        past via `exclude` + release()) never inflates the ledger or
+        skews the round-robin tie-break."""
+        m = self._pick(PREFILL, exclude)
+        if m is None:
+            return None
+        old = self._assigned.get(request_id)
+        if old is not None and old != m.member_id:
+            prev = self._members.get(old)
+            if prev is not None:
+                prev.in_flight.discard(request_id)
+        self._assigned[request_id] = m.member_id
+        m.in_flight.add(request_id)
+        self._refresh_gauges(m)
+        return m.member_id
+
+    def record_placement(self, request_id: str, *,
+                         replacement: bool = False) -> None:
+        """The placed submit reached its member: book the placement
+        (and the re-placement, when this was churn recovery) in the
+        counters, the per-node metric, and the tie-break state."""
+        member_id = self._assigned.get(request_id)
+        m = self._members.get(member_id) if member_id else None
+        if m is None:
+            return
+        m.placements += 1
+        self.counters["placements"] += 1
+        self._m_placements.inc(tier=PREFILL, node=m.member_id)
+        if replacement:
+            self.counters["re_placements"] += 1
+            self._m_replacements.inc()
+
+    def route_decode(self, request_id: str) -> str | None:
+        """DECODE member for one handed-off request, chosen by the
+        queue-depth/burn-rate gauges; releases the prefill assignment
+        (the migration left that tier). None when no decode member is
+        placeable."""
+        self._release_assigned(request_id)
+        m = self._pick(DECODE)
+        if m is None:
+            return None
+        self._adopted[request_id] = m.member_id
+        m.in_flight.add(request_id)
+        m.placements += 1
+        self.counters["placements"] += 1
+        self._m_placements.inc(tier=DECODE, node=m.member_id)
+        self._refresh_gauges(m)
+        return m.member_id
+
+    def assigned_to(self, request_id: str) -> str | None:
+        return self._assigned.get(request_id)
+
+    def adopted_on(self, request_id: str) -> str | None:
+        return self._adopted.get(request_id)
+
+    def release(self, request_id: str) -> None:
+        """Undo a placement that never reached the member (send
+        failed): the assignment is dropped without counting a loss."""
+        self._release_assigned(request_id)
+
+    def _release_assigned(self, request_id: str) -> None:
+        member_id = self._assigned.pop(request_id, None)
+        if member_id is not None:
+            m = self._members.get(member_id)
+            if m is not None:
+                m.in_flight.discard(request_id)
+                self._refresh_gauges(m)
+
+    def note_done(self, request_id: str) -> None:
+        """Request ended (any outcome): release whatever it held."""
+        self._release_assigned(request_id)
+        member_id = self._adopted.pop(request_id, None)
+        if member_id is not None:
+            m = self._members.get(member_id)
+            if m is not None:
+                m.in_flight.discard(request_id)
+                self._refresh_gauges(m)
+
+    # ---------------------------------------------------------- telemetry
+
+    def update_gauges(self, member_id: str, *,
+                      queue_depth: float | None = None,
+                      burn_rate: float | None = None) -> None:
+        """Feed one member's load gauges (scheduler queue depth off its
+        stats probe; SLO burn rate from the provider's monitor) — the
+        placement signal beyond the router's own in-flight counts."""
+        m = self._members.get(member_id)
+        if m is None:
+            return
+        if queue_depth is not None:
+            m.queue_depth = max(float(queue_depth), 0.0)
+        if burn_rate is not None:
+            m.burn_rate = max(float(burn_rate), 0.0)
+
+    def _refresh_gauges(self, m: PoolMember) -> None:
+        self._m_state.set(STATE_CODES[m.state], tier=m.tier,
+                          node=m.member_id)
+        for tier in (PREFILL, DECODE):
+            members = self.members(tier)
+            self._m_members.set(len(members), tier=tier)
+            self._m_healthy.set(
+                sum(1 for x in members if x.placeable), tier=tier)
+
+    # -------------------------------------------------------------- stats
+
+    def healthy_count(self, tier: str) -> int:
+        return sum(1 for m in self.members(tier) if m.placeable)
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            **self.counters,
+            "members": {mid: m.to_dict()
+                        for mid, m in sorted(self._members.items())},
+            "healthy": {PREFILL: self.healthy_count(PREFILL),
+                        DECODE: self.healthy_count(DECODE)},
+            "in_flight": {PREFILL: len(self._assigned),
+                          DECODE: len(self._adopted)},
+        }

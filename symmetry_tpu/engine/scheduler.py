@@ -342,6 +342,11 @@ class Scheduler:
         # chunked-prefill jobs still building their prefixes.
         out["deferred_depth"] = len(self._deferred)
         out["prefill_jobs_active"] = len(self._prefill_jobs)
+        # Total admission backlog (inbox + deferred) — the same number
+        # the sym_sched_queue_depth gauge tracks, surfaced in the stats
+        # reply so the pool router's heartbeat can feed placement with
+        # REAL backlog instead of only its own in-flight counts.
+        out["queue_depth"] = self._inbox.qsize() + len(self._deferred)
         out["engine_ttft_s"] = self._ttft_hist.to_dict()
         out["admit_dispatch_s"] = self._admit_hist.to_dict()
         out["block_interval_s"] = self._interval_hist.to_dict()

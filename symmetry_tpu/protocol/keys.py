@@ -117,9 +117,15 @@ class LinkOp:
     the node can splice the payload straight onto the host pipe."""
 
     # --- control (both directions) ---
-    HELLO = "hello"         # link handshake: version, role, credit window
+    HELLO = "hello"         # link handshake: version, role, credit
+                            # window, node identity ("node") — the pool
+                            # router's join/announce signal
     CLOCK = "clock"         # clock-offset probe (echoed with "t"), same
                             # NTP-midpoint protocol as the host pipe
+    PING = "ping"           # link keepalive probe (pool heartbeat; the
+                            # decode side drops a silent link and lets
+                            # the reconnect loop own recovery)
+    PONG = "pong"           # keepalive reply (echoes the ping's "t")
 
     # --- decode node → prefill node ---
     SUBMIT = "submit"       # forwarded host submit op (payload = JSON line)
@@ -138,6 +144,10 @@ class LinkOp:
                             # death) — the decode node sheds the request
     EVENT = "event"         # prefill-tier terminal event (tokenization /
                             # admission error, deadline shed) forwarded
+    DRAIN = "drain"         # node announces deliberate drain: no new
+                            # placements; in-flight work finishes
+    LEAVE = "leave"         # node announces departure (drain complete /
+                            # shutdown) — membership churn, not a fault
 
 
 LINK_OPS = frozenset(

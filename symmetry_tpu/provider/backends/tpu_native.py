@@ -33,6 +33,37 @@ from symmetry_tpu.utils.logging import logger as log
 DEFAULT_MAX_NEW_TOKENS = 512
 
 
+class _DecodeMember:
+    """One decode-tier pool member: a local engine host with its own
+    reader, probe waiters, clock offset, and supervision accounting —
+    the per-member failure domain that replaces the pair's
+    respawn-both-as-a-unit rule in pool mode."""
+
+    __slots__ = ("id", "proc", "reader", "clock_offset", "waiters",
+                 "down", "dead", "engine_alive", "spawned_at",
+                 "respawn_failures", "circuit_open", "restarts")
+
+    def __init__(self, member_id: str) -> None:
+        self.id = member_id
+        self.proc: asyncio.subprocess.Process | None = None
+        self.reader: asyncio.Task | None = None
+        self.clock_offset = 0.0
+        self.waiters: dict[str, list[asyncio.Future]] = {
+            HostOp.STATS: [], HostOp.TRACE: [], HostOp.METRICS: []}
+        self.down = asyncio.Event()
+        self.dead = False
+        self.engine_alive = True
+        self.spawned_at: float | None = None
+        self.respawn_failures = 0
+        self.circuit_open = False
+        self.restarts = 0
+
+    @property
+    def alive(self) -> bool:
+        return (self.proc is not None and not self.dead
+                and self.proc.returncode is None)
+
+
 class TpuNativeBackend(InferenceBackend):
     """Two isolation modes (tpu.engine_isolation):
 
@@ -101,9 +132,34 @@ class TpuNativeBackend(InferenceBackend):
         self._link_cfg = None
         self._inline_node = None     # in-process PrefillNode
         self._net_mode = False
+        # --- elastic pool (tpu.disagg.pool) ---------------------------
+        # POOL mode generalizes the pair into M prefill members × N
+        # decode members (engine/disagg/pool.py): each prefill member is
+        # a PrefillNode reached over its OWN DecodeLink (inline or
+        # remote), each decode member a local engine host with its OWN
+        # supervision domain. The PoolRouter places each request on the
+        # least-loaded healthy prefill member and routes its KV handoff
+        # to a decode member by queue-depth gauges; node death, link
+        # loss, and deliberate drain are membership churn — in-flight
+        # migrations on a lost member are RE-PLACED on a survivor (the
+        # structured-retryable shed only fires when no survivor exists).
+        self._pool_mode = False
+        self._pool_cfg = None
+        self._pool = None                  # PoolRouter
+        self._plinks: dict[str, Any] = {}  # prefill member id -> DecodeLink
+        self._inline_nodes: list[Any] = []
+        self._pool_submits: dict[str, dict] = {}  # full submit ops for
+                                                  # re-placement
+        self._decode_members: dict[str, _DecodeMember] = {}
+        self._pool_tasks: list[asyncio.Task] = []
+        self._replace_tasks: set[asyncio.Task] = set()
+        # Gates the pool's supervision/heartbeat tasks: set before the
+        # first member spawns (they must not bail while start() is
+        # still assembling the pool) and cleared first thing in stop().
+        self._pool_active = False
         if self._disagg:
             from symmetry_tpu.engine.disagg import (
-                HandoffBroker, LinkConfig)
+                HandoffBroker, LinkConfig, PoolConfig)
 
             self._broker = HandoffBroker()
             self._broker.tracer.enabled = bool(
@@ -111,6 +167,9 @@ class TpuNativeBackend(InferenceBackend):
             self._link_cfg = LinkConfig(
                 getattr(config.tpu, "disagg", None))
             self._net_mode = self._link_cfg.network_mode
+            self._pool_cfg = PoolConfig(
+                getattr(config.tpu, "disagg", None))
+            self._pool_mode = self._pool_cfg.enabled
         self._started = False
         self._host_dead = False
         self._engine_alive = True  # host-reported scheduler liveness
@@ -201,8 +260,9 @@ class TpuNativeBackend(InferenceBackend):
     @property
     def _local_pair(self) -> bool:
         """Disagg with BOTH tiers as local subprocesses (PR 7's shape);
-        network mode replaces the prefill side with the handoff link."""
-        return self._disagg and not self._net_mode
+        network mode replaces the prefill side with the handoff link,
+        pool mode replaces BOTH sides with member sets."""
+        return self._disagg and not self._net_mode and not self._pool_mode
 
     async def start(self) -> None:
         """Load weights and start the engine (may take minutes for large
@@ -300,6 +360,12 @@ class TpuNativeBackend(InferenceBackend):
         else:
             self._cfg_path = write_cfg(cfg)
         self._host_down = asyncio.Event()
+        if self._pool_mode:
+            # Elastic pool: per-member readers and per-member
+            # supervision replace the pair's single supervisor — a dead
+            # member is a capacity event handled in its own domain.
+            await self._start_pool()
+            return
         await self._spawn_host()
         if self._net_mode:
             await self._start_link()
@@ -457,6 +523,9 @@ class TpuNativeBackend(InferenceBackend):
             req_id = str(ev.get("id", ""))
             if ev.get("done"):
                 self._broker.forget(req_id)
+                if self._pool is not None:
+                    self._pool.note_done(req_id)
+                    self._pool_submits.pop(req_id, None)
             q = self._queues.get(req_id)
             if q is not None:
                 q.put_nowait(ev)
@@ -466,6 +535,9 @@ class TpuNativeBackend(InferenceBackend):
         shed (clients fail over / retry; the link or tier that failed
         is already recovering)."""
         self._broker.forget(req_id)
+        if self._pool is not None:
+            self._pool.note_done(req_id)
+            self._pool_submits.pop(req_id, None)
         q = self._queues.get(req_id)
         if q is not None:
             q.put_nowait({"op": HostOp.EVENT, "id": req_id, "text": "",
@@ -483,6 +555,479 @@ class TpuNativeBackend(InferenceBackend):
         the DecodeLink reconnects with backoff."""
         for req_id in self._broker.shed_pending():
             self._shed_request(req_id, f"handoff link lost: {reason}")
+
+    # ------------------------------------------------- elastic pool (M×N)
+
+    def _node_factory(self, config: Any, listen: str):
+        """Inline prefill-member constructor. A seam on purpose
+        (mirrors _host_argv): tests substitute a PrefillNode subclass
+        whose engine host is the protocol-faithful fake, so pool churn
+        drills cost milliseconds instead of an engine build per node."""
+        from symmetry_tpu.engine.disagg.node import PrefillNode
+
+        return PrefillNode(config, listen=listen)
+
+    @staticmethod
+    def _member_listen_addr(base: str, index: int, count: int) -> str:
+        """Per-member listen address for inline nodes. mem:// gets a
+        suffix per member; tcp:// with more than one member rebinds to
+        port 0 (each node resolves its real port at start)."""
+        if base.startswith("mem://"):
+            return f"{base}-p{index}"
+        if base.startswith("tcp://") and count > 1:
+            host = base[len("tcp://"):].rsplit(":", 1)[0]
+            return f"tcp://{host}:0"
+        return base
+
+    async def _start_pool(self) -> None:
+        """Pool-mode startup: N local decode members (each its own
+        reader + supervision task), then M prefill members — inline
+        self-hosted PrefillNodes and/or remote peers — each behind its
+        own DecodeLink. A member that is not up yet is NOT fatal: it
+        joins when it connects (hot-join), and until at least one
+        prefill member is healthy submits shed retryable."""
+        import functools
+
+        from symmetry_tpu.engine.disagg.net import DecodeLink
+        from symmetry_tpu.engine.disagg.pool import PoolRouter
+
+        self._pool = PoolRouter()
+        self._pool_active = True
+        members = [_DecodeMember(f"decode-{i}")
+                   for i in range(self._pool_cfg.decode_count)]
+        for m in members:
+            self._decode_members[m.id] = m
+            self._pool.add_member(m.id, "decode")
+        # All member engine builds OVERLAP (a real host's weight load +
+        # warmup takes minutes; N of them back-to-back would multiply
+        # start() wall-clock by the pool size).
+        await asyncio.gather(*[self._spawn_decode_member(m)
+                               for m in members])
+        for m in members:
+            self._pool.mark_healthy(m.id)
+            self._pool_tasks.append(
+                asyncio.get_running_loop().create_task(
+                    self._supervise_decode_member(m)))
+        peers = self._pool_cfg.prefill_peers
+        if peers is None:
+            base = self._link_cfg.peer or "mem://disagg-pool"
+            self._inline_nodes = [
+                self._node_factory(self._config, self._member_listen_addr(
+                    base, i, self._pool_cfg.prefill_count))
+                for i in range(self._pool_cfg.prefill_count)]
+            await asyncio.gather(*[node.start()
+                                   for node in self._inline_nodes])
+            peers = [node.address for node in self._inline_nodes]
+        for i, addr in enumerate(peers):
+            member_id = f"prefill-{i}"
+            self._pool.add_member(member_id, "prefill", node_id=addr)
+            link = DecodeLink(
+                self._link_cfg.for_peer(
+                    addr, heartbeat_s=self._pool_cfg.heartbeat_s),
+                on_handoff=functools.partial(self._pool_handoff,
+                                             member_id),
+                on_event=self._link_event,
+                on_fail=self._link_fail,
+                on_down=functools.partial(self._pool_member_down,
+                                          member_id),
+                on_up=functools.partial(self._pool_member_up, member_id),
+                on_drain=functools.partial(self._pool_member_drain,
+                                           member_id),
+                on_leave=functools.partial(self._pool_member_leave,
+                                           member_id))
+            self._plinks[member_id] = link
+            await link.start()
+        deadline = time.monotonic() + min(self._spawn_timeout_s, 120.0)
+        while (self._pool.healthy_count("prefill") == 0
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.05)
+        if self._pool.healthy_count("prefill") == 0:
+            log.warning("pool: no prefill member connected yet; submits "
+                        "shed retryable until one joins")
+        self._pool_tasks.append(
+            asyncio.get_running_loop().create_task(
+                self._pool_heartbeat()))
+        log.info(f"tpu_native pool up: "
+                 f"{len(peers)}×prefill {self._pool_cfg.decode_count}"
+                 f"×decode (inline nodes: {len(self._inline_nodes)})")
+
+    async def _spawn_decode_member(self, m: _DecodeMember) -> None:
+        """One decode member life: spawn, ready, clock offset, reader —
+        the member-scoped twin of _spawn_host."""
+        m.dead = False
+        m.engine_alive = True
+        m.proc = await self._spawn_one(self._cfg_path)
+        await self._await_ready(m.proc, f"decode member {m.id}")
+        m.clock_offset = await self._clock_handshake(m.proc)
+        m.reader = asyncio.get_running_loop().create_task(
+            self._read_member_events(m))
+        m.spawned_at = time.monotonic()
+        log.info(f"pool: decode member {m.id} up (pid {m.proc.pid}, "
+                 f"clock_offset={m.clock_offset * 1e6:+.0f}us)")
+
+    async def _read_member_events(self, m: _DecodeMember) -> None:
+        """One decode member's pipe pump: same dispatch as _read_events
+        but member-scoped — probe replies land in the MEMBER's waiters
+        and EOF runs the MEMBER's death path, never the pool's."""
+        proc = m.proc
+        assert proc is not None and proc.stdout is not None
+        while True:
+            line = await proc.stdout.readline()
+            if not line:
+                break  # member host exited
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(msg, dict):
+                continue
+            op = msg.get("op")
+            if op in (HostOp.STATS, HostOp.TRACE, HostOp.METRICS):
+                if op == HostOp.STATS:
+                    m.engine_alive = bool(msg.get("engine_alive", True))
+                waiters, m.waiters[op] = m.waiters[op], []
+                for w in waiters:
+                    if not w.done():
+                        w.set_result(msg)
+                continue
+            if op == HostOp.EVENTS:
+                events = msg.get("events")
+                if not isinstance(events, list):
+                    continue
+                self.relay_stats["host_frames"] += 1
+                self.relay_stats["host_batched_frames"] += 1
+                self.relay_stats["host_events"] += len(events)
+                self._m_host_frames.inc()
+                self._m_host_events.inc(len(events))
+                for ev in events:
+                    if not isinstance(ev, dict):
+                        continue
+                    q = self._queues.get(str(ev.get("id", "")))
+                    if q is not None:
+                        q.put_nowait(ev)
+                continue
+            if op != HostOp.EVENT:
+                continue
+            self.relay_stats["host_frames"] += 1
+            self.relay_stats["host_events"] += 1
+            self._m_host_frames.inc()
+            self._m_host_events.inc()
+            q = self._queues.get(str(msg.get("id", "")))
+            if q is not None:
+                q.put_nowait(msg)
+        if not m.dead:  # natural EOF (a cancelled reader skips this)
+            self._decode_member_lost(m, "decode member host exited")
+
+    def _decode_member_lost(self, m: _DecodeMember, reason: str) -> None:
+        """One decode member died: fail ONLY the streams adopted there
+        (structured retryable — clients fail over while the member
+        respawns), release its probe waiters, wake its supervisor. The
+        other members keep serving untouched."""
+        if m.dead:
+            return
+        m.dead = True
+        for req_id in self._pool.on_lost(m.id):
+            self._shed_request(req_id, f"{reason} ({m.id})")
+        for lst in m.waiters.values():
+            for w in lst:
+                if not w.done():
+                    w.set_result(None)
+            lst.clear()
+        hook = self.on_host_restart
+        if hook is not None:
+            try:
+                hook("crash")
+            except Exception as exc:  # noqa: BLE001 — diagnostics only
+                log.warning(f"on_host_restart hook failed: {exc}")
+        m.down.set()
+
+    async def _supervise_decode_member(self, m: _DecodeMember) -> None:
+        """Per-member respawn loop: same backoff/stability/circuit
+        rules as the pair supervisor, scoped to ONE member — its death
+        never restarts a sibling."""
+        import contextlib
+
+        while self._pool_active and not m.circuit_open:
+            await m.down.wait()
+            m.down.clear()
+            if not self._pool_active:
+                return
+            if (m.spawned_at is not None
+                    and time.monotonic() - m.spawned_at
+                    >= self._min_stable_s):
+                m.respawn_failures = 0
+            else:
+                m.respawn_failures += 1
+            if m.reader is not None:
+                m.reader.cancel()
+                m.reader = None
+            if m.proc is not None:
+                if m.proc.returncode is None:
+                    with contextlib.suppress(ProcessLookupError):
+                        m.proc.kill()
+                with contextlib.suppress(Exception):
+                    await m.proc.wait()
+                m.proc = None
+            while self._pool_active:
+                if m.respawn_failures >= self._max_respawns:
+                    m.circuit_open = True
+                    log.error(f"pool: decode member {m.id} circuit "
+                              f"breaker OPEN after "
+                              f"{m.respawn_failures} consecutive "
+                              f"failed lives")
+                    return
+                backoff = min(self._backoff_max_s,
+                              self._backoff_base_s
+                              * (2 ** min(m.respawn_failures, 8)))
+                log.warning(f"pool: respawning decode member {m.id} in "
+                            f"{backoff:.2f}s")
+                await asyncio.sleep(backoff)
+                if not self._pool_active:
+                    return
+                try:
+                    await asyncio.wait_for(self._spawn_decode_member(m),
+                                           self._spawn_timeout_s)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:  # noqa: BLE001 — spawn failed
+                    m.respawn_failures += 1
+                    if m.proc is not None:
+                        if m.proc.returncode is None:
+                            with contextlib.suppress(ProcessLookupError):
+                                m.proc.kill()
+                        with contextlib.suppress(Exception):
+                            await m.proc.wait()
+                        m.proc = None
+                    log.error(f"pool: decode member {m.id} respawn "
+                              f"failed: {exc}")
+                    continue
+                m.restarts += 1
+                pm = self._pool.get(m.id)
+                if pm is not None:
+                    pm.restarts = m.restarts
+                self._pool.mark_healthy(m.id)
+                log.warning(f"pool: decode member {m.id} respawned "
+                            f"(restart #{m.restarts})")
+                break
+
+    async def _probe_member(self, m: _DecodeMember, op: str,
+                            timeout: float = 10.0) -> dict | None:
+        if m.proc is None or m.dead:
+            return None
+        return await self._probe(op, m.waiters[op], m.proc, timeout)
+
+    async def _pool_heartbeat(self) -> None:
+        """Pool watchdog + gauge feed: probe each decode member's stats
+        (wedge detection per member; queue-depth gauge for routing) and
+        each connected prefill member's node stats (its host's queue
+        depth as the placement signal). Link liveness itself is the
+        DecodeLink ping/pong keepalive."""
+        import contextlib
+
+        period = (self._pool_cfg.heartbeat_s
+                  if self._pool_cfg.heartbeat_s > 0 else self._heartbeat_s)
+        while self._pool_active:
+            await asyncio.sleep(period)
+            if not self._pool_active:
+                return
+            # All probes CONCURRENT: one wedged member must not delay
+            # the others' wedge detection (or stale their gauges) by a
+            # full probe timeout each — per-member failure domains
+            # apply to the watchdog too.
+            decode = [m for m in self._decode_members.values() if m.alive]
+            plinks = [(mid, link) for mid, link in self._plinks.items()
+                      if link.connected]
+            replies = await asyncio.gather(
+                *[self._probe_member(m, HostOp.STATS,
+                                     timeout=self._wedge_timeout_s)
+                  for m in decode],
+                *[link.probe(LinkOp.STATS,
+                             timeout=self._wedge_timeout_s)
+                  for _, link in plinks],
+                return_exceptions=True)
+            if not self._pool_active:
+                return
+            for m, msg in zip(decode, replies[:len(decode)]):
+                if not isinstance(msg, dict) or not m.engine_alive:
+                    if m.dead:
+                        continue  # death path already ran
+                    log.error(f"pool: decode member {m.id} wedged "
+                              f"(no healthy stats reply); killing it")
+                    if m.proc is not None and m.proc.returncode is None:
+                        # Racing a self-exit between the check and the
+                        # kill must not kill the WATCHDOG task.
+                        with contextlib.suppress(ProcessLookupError):
+                            m.proc.kill()  # reader EOF runs death path
+                    continue
+                self._pool.update_gauges(
+                    m.id, queue_depth=msg.get("queue_depth"))
+            for (member_id, _), reply in zip(plinks,
+                                             replies[len(decode):]):
+                host = (reply.get("host")
+                        if isinstance(reply, dict) else None) or {}
+                if isinstance(host, dict) \
+                        and host.get("queue_depth") is not None:
+                    self._pool.update_gauges(
+                        member_id, queue_depth=host["queue_depth"])
+
+    # --- pool membership callbacks (link-driven) ----------------------
+
+    def _pool_member_up(self, member_id: str) -> None:
+        link = self._plinks.get(member_id)
+        self._pool.mark_healthy(
+            member_id,
+            node_id=link.peer_node if link is not None else None)
+
+    def _member_lost_ids(self, member_id: str) -> list[str]:
+        """In-flight migrations on a lost member: the router's
+        placement view unioned with the broker's pending-migration
+        view (authoritative for submitted-but-not-adopted), so neither
+        side's bookkeeping gap strands a request."""
+        ids = set(self._pool.on_lost(member_id))
+        ids.update(self._broker.pending_on(member_id))
+        return sorted(ids)
+
+    def _pool_member_down(self, member_id: str, reason: str) -> None:
+        """Prefill member's link died (node death, cable pull, wedge):
+        its in-flight migrations are RE-PLACED on a survivor — the shed
+        only reaches the client when no survivor exists. The link keeps
+        reconnecting; a successful reconnect is a rejoin."""
+        ids = self._member_lost_ids(member_id)
+        if ids:
+            self._spawn_replace(ids, f"prefill member {member_id} lost: "
+                                     f"{reason}")
+
+    def _pool_member_drain(self, member_id: str, node: str) -> None:
+        self._pool.drain(member_id)
+        log.info(f"pool: prefill member {member_id} "
+                 f"({node or 'unnamed'}) draining")
+
+    def _pool_member_leave(self, member_id: str, node: str) -> None:
+        """Deliberate departure: account as churn; any straggler still
+        in flight there is re-placed like a loss."""
+        ids = self._member_lost_ids(member_id)
+        log.info(f"pool: prefill member {member_id} "
+                 f"({node or 'unnamed'}) left")
+        if ids:
+            self._spawn_replace(ids, f"prefill member {member_id} left")
+
+    def _spawn_replace(self, ids: list[str], reason: str) -> None:
+        task = asyncio.get_running_loop().create_task(
+            self._pool_replace(ids, reason))
+        self._replace_tasks.add(task)
+        task.add_done_callback(self._replace_tasks.discard)
+
+    async def _pool_replace(self, ids: list[str], reason: str) -> None:
+        """Re-place lost in-flight migrations on survivors. Deadlines
+        are NOT refunded (the broker keeps the original submit stamp);
+        a request that cannot be re-placed sheds structured-retryable —
+        the client fails over, nothing hangs, nothing fails outright."""
+        for req_id in ids:
+            if req_id not in self._queues:
+                # Client already gone: just drop the migration state.
+                self._pool_submits.pop(req_id, None)
+                self._broker.forget(req_id)
+                continue
+            submit = self._pool_submits.get(req_id)
+            placed = None
+            if submit is not None:
+                placed = await self._pool_send_submit(req_id, submit,
+                                                      replacement=True)
+            if placed is None:
+                self._shed_request(req_id, reason)
+            else:
+                log.info(f"pool: re-placed {req_id} on {placed} "
+                         f"after: {reason}")
+
+    async def _pool_send_submit(self, req_id: str, submit: dict,
+                                *, replacement: bool = False
+                                ) -> str | None:
+        """Place + send one submit over a healthy member's link; walks
+        the member set on send failure (each failed member excluded for
+        this request — its own down path re-places the REST of its
+        load). None when no healthy member accepted it."""
+        from symmetry_tpu.engine.disagg.net import LinkError
+
+        exclude: set[str] = set()
+        while True:
+            member_id = self._pool.place(req_id, exclude=exclude)
+            if member_id is None:
+                return None
+            link = self._plinks.get(member_id)
+            if link is None or not link.connected:
+                exclude.add(member_id)
+                self._pool.release(req_id)
+                continue
+            try:
+                await link.submit(submit)
+            except (LinkError, ConnectionError, OSError):
+                exclude.add(member_id)
+                self._pool.release(req_id)
+                continue
+            # Only a DELIVERED submit counts as a placement (refused
+            # members above must not inflate the ledger).
+            self._pool.record_placement(req_id, replacement=replacement)
+            self._broker.reassign(req_id, member_id)
+            return member_id
+
+    async def _pool_handoff(self, member_id: str, meta: dict,
+                            frame: bytes) -> None:
+        """A verified handoff frame off ONE member's link → the decode
+        member the router picks by queue depth. Same ack semantics as
+        the pair's _link_handoff: a local adoption failure sheds the
+        request rather than nak the wire."""
+        import base64
+
+        req_id = str(meta.get("id", ""))
+        handoff = {"id": meta.get("id"), "p": int(meta.get("p", 0)),
+                   "prompt_len": meta.get("prompt_len"),
+                   "nbytes": len(frame),
+                   "frame": base64.b64encode(frame).decode("ascii")}
+        if "wire_s" in meta:
+            handoff["wire_s"] = meta["wire_s"]
+        adopt = self._broker.adopt_op(handoff)
+        if adopt is None:
+            # No pending migration: cancelled/failed — or a STALE
+            # duplicate from a member that kept prefilling through a
+            # link blip while the request was re-placed (and possibly
+            # already adopted elsewhere). Only release THIS member's
+            # placement, never the request's live decode adoption.
+            if self._pool.assigned_to(req_id) == member_id:
+                self._pool.release(req_id)
+            return
+        self._pool_submits.pop(req_id, None)
+        decode_id = self._pool.route_decode(req_id)
+        m = self._decode_members.get(decode_id) if decode_id else None
+        if m is None or not m.alive:
+            self._shed_request(
+                req_id, "no decode member available for adoption")
+            return
+        try:
+            await self._host_send(adopt, proc=m.proc)
+        except (ConnectionError, OSError):
+            self._shed_request(
+                req_id, f"decode member {m.id} unavailable for adoption")
+
+    def _pool_status(self) -> dict:
+        """The pool block for engine_stats(): router membership +
+        per-link wire state + per-decode-host supervision."""
+        st = self._pool.stats()
+        st["links"] = {
+            member_id: {"connected": link.connected,
+                        "node": link.peer_node,
+                        "connects": link.stats["connects"],
+                        "drops": link.stats["drops"],
+                        "wire_frames": link.stats["wire_frames"],
+                        "wire_bytes": link.stats["wire_bytes"],
+                        "clock_offset_s": round(link.clock_offset, 6)}
+            for member_id, link in sorted(self._plinks.items())}
+        st["decode_hosts"] = {
+            m.id: {"alive": m.alive, "restarts": m.restarts,
+                   "circuit_open": m.circuit_open,
+                   "clock_offset_s": round(m.clock_offset, 6)}
+            for m in self._decode_members.values()}
+        st["inline_nodes"] = len(self._inline_nodes)
+        return st
 
     async def _clock_handshake(self, proc: asyncio.subprocess.Process,
                                rounds: int = 5) -> float:
@@ -738,6 +1283,39 @@ class TpuNativeBackend(InferenceBackend):
                 await self._supervisor
             self._supervisor = None
         self._restarting = False
+        self._pool_active = False
+        # Pool teardown first: member supervision and replace tasks
+        # must not race the shutdown, and no handoff may land on a
+        # decode member that is draining away.
+        for task in self._pool_tasks + list(self._replace_tasks):
+            task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await task
+        self._pool_tasks.clear()
+        self._replace_tasks.clear()
+        for link in self._plinks.values():
+            await link.stop()
+        self._plinks.clear()
+        for node in self._inline_nodes:
+            await node.stop()
+        self._inline_nodes.clear()
+        for m in self._decode_members.values():
+            m.dead = True  # fence the reader's death path: this is a stop
+            if m.reader is not None:
+                m.reader.cancel()
+                m.reader = None
+            if m.proc is not None:
+                with contextlib.suppress(ConnectionError, OSError):
+                    await self._host_send({"op": HostOp.SHUTDOWN},
+                                          proc=m.proc)
+                try:
+                    await asyncio.wait_for(m.proc.wait(),
+                                           self._stop_grace_s)
+                except asyncio.TimeoutError:
+                    m.proc.kill()
+                    await m.proc.wait()  # reap — no zombie
+                m.proc = None
+        self._decode_members.clear()
         # Handoff link first (network mode): no new handoff may land on
         # a decode host that is about to drain. The inline node owns
         # its own prefill host shutdown.
@@ -1048,6 +1626,20 @@ class TpuNativeBackend(InferenceBackend):
         clock: each component's clock_offset_s gains the measured
         host-pipe offset, so the provider's merge needs no knowledge of
         which process a span came from."""
+        if self._process_mode and self._pool_mode:
+            comps: list[dict] = []
+            m0 = next((m for m in self._decode_members.values()
+                       if m.alive), None)
+            if m0 is not None:
+                msg = await self._probe_member(m0, HostOp.TRACE)
+                for comp in (msg or {}).get("components") or []:
+                    if isinstance(comp, dict):
+                        comps.append({
+                            **comp, "clock_offset_s":
+                                float(comp.get("clock_offset_s", 0.0))
+                                + m0.clock_offset})
+            comps.append(self._broker.tracer.component("handoff_link"))
+            return comps
         if self._process_mode:
             if (self._proc is None or self._host_dead
                     or self._proc.returncode is not None):
@@ -1110,6 +1702,20 @@ class TpuNativeBackend(InferenceBackend):
         provider snapshot."""
         if not self._process_mode:
             return []
+        if self._pool_mode:
+            # Every live decode member, node-labeled — the per-member
+            # series symtop's pool columns and a scrape read.
+            members = [m for m in self._decode_members.values()
+                       if m.alive]
+            replies = await asyncio.gather(
+                *[self._probe_member(m, HostOp.METRICS, timeout=5.0)
+                  for m in members],
+                return_exceptions=True)
+            return [{"snapshot": {k: v for k, v in msg.items()
+                                  if k not in ("op", "role")},
+                     "labels": {"tier": "decode", "node": m.id}}
+                    for m, msg in zip(members, replies)
+                    if isinstance(msg, dict)]
         if (self._proc is None or self._host_dead
                 or self._proc.returncode is not None):
             return []
@@ -1137,6 +1743,8 @@ class TpuNativeBackend(InferenceBackend):
         admission dispatch and block-interval percentiles) — surfaced
         through provider METRICS so a benchmark capture can attribute
         stalls to engine vs relay/wire (round-3 verdict #1/#3)."""
+        if self._process_mode and self._pool_mode:
+            return await self._pool_engine_stats()
         if self._process_mode:
             sup = self._supervisor_stats()
             if (self._proc is None or self._host_dead
@@ -1197,6 +1805,34 @@ class TpuNativeBackend(InferenceBackend):
         stats = getattr(self._scheduler, "stats", None)
         return stats() if stats is not None else dict(self._scheduler.metrics)
 
+    async def _pool_engine_stats(self) -> dict:
+        """Pool-mode serving breakdown: the first live decode member's
+        scheduler stats as the base (the familiar shape), the handoff
+        ledger, and the pool block (membership, per-link wire state,
+        per-member supervision) nested under disagg.pool."""
+        members = list(self._decode_members.values())
+        out: dict = {}
+        m0 = next((m for m in members if m.alive), None)
+        if m0 is not None:
+            msg = await self._probe_member(m0, HostOp.STATS)
+            if msg is not None:
+                out = {k: v for k, v in msg.items() if k != "op"}
+        out["relay"] = dict(self.relay_stats)
+        out["stages"] = {name: h.to_dict()
+                         for name, h in self.stage_hists.items()
+                         if h.count}
+        out["supervisor"] = {
+            "restarts": sum(m.restarts for m in members),
+            "respawn_failures": sum(m.respawn_failures for m in members),
+            "restarting": any(not m.alive and not m.circuit_open
+                              for m in members),
+            "circuit_open": bool(members) and all(m.circuit_open
+                                                  for m in members)}
+        disagg: dict = self._broker.stats()
+        disagg["pool"] = self._pool_status()
+        out["disagg"] = disagg
+        return out
+
     async def healthy(self) -> bool:
         """Engine liveness: a wedged decode loop must fail this (SURVEY §5.3
         — an engine wedge unregisters the provider). In SUPERVISED process
@@ -1207,6 +1843,13 @@ class TpuNativeBackend(InferenceBackend):
         the provider. Unsupervised process mode keeps the old semantics:
         a dead host, a dead engine thread, or a silent stats op all fail."""
         if self._process_mode:
+            if self._pool_mode:
+                # A pool is healthy while ANY decode member can still
+                # come back: only every member's breaker opening (the
+                # pool's capacity is permanently gone) deregisters.
+                members = list(self._decode_members.values())
+                return (self._started and bool(members)
+                        and not all(m.circuit_open for m in members))
             if not self._started or self._circuit_open:
                 return False
             if self._sup_enabled:
@@ -1300,7 +1943,8 @@ class TpuNativeBackend(InferenceBackend):
             session.cancel()  # no-op if complete; frees the slot if client left
 
     def _observe_stages(self, t_recv: float, t_submit: float,
-                        t: dict) -> None:
+                        t: dict, clock_offset: float | None = None
+                        ) -> None:
         """Fold one request's first-event stage stamps into the per-stage
         TTFT histograms.
 
@@ -1313,7 +1957,8 @@ class TpuNativeBackend(InferenceBackend):
         microsecond-negative value, and hiding it would misstate the
         distribution the same way the clamp did."""
         now = time.monotonic()
-        off = self._clock_offset
+        off = (self._clock_offset if clock_offset is None
+               else clock_offset)
         recv = t["recv"] - off if "recv" in t else t_submit
         picked = t["picked"] - off if "picked" in t else recv
         first = t["first"] - off if "first" in t else picked
@@ -1339,6 +1984,18 @@ class TpuNativeBackend(InferenceBackend):
         """Fence for new work against a down host: circuit-open is
         permanent (plain BackendError → provider error path), a
         supervised death/respawn window is the retryable restarting shed."""
+        if self._pool_mode:
+            members = list(self._decode_members.values())
+            if members and all(m.circuit_open for m in members):
+                raise BackendError(
+                    "every decode pool member's circuit breaker is open")
+            if not any(m.alive for m in members):
+                raise BackendRestartingError(
+                    "decode pool members restarting",
+                    retry_after_s=self._restart_eta_s())
+            # Prefill availability is a PLACEMENT decision — the submit
+            # path sheds retryable when no member is placeable.
+            return
         if self._circuit_open:
             raise BackendError(
                 "engine host unavailable (circuit breaker open)")
@@ -1396,9 +2053,22 @@ class TpuNativeBackend(InferenceBackend):
                     # need when the handoff frame comes back. Network
                     # mode sends the submit over the handoff link (a
                     # LinkError is a ConnectionError — the handler
-                    # below turns it into the retryable shed).
+                    # below turns it into the retryable shed). Pool
+                    # mode PLACES it on the least-loaded healthy
+                    # member and keeps the full op for re-placement.
                     self._broker.note_submit(request_id, submit)
-                    if self._net_mode:
+                    if self._pool_mode:
+                        self._pool_submits[request_id] = submit
+                        member = await self._pool_send_submit(
+                            request_id, submit)
+                        if member is None:
+                            self._pool_submits.pop(request_id, None)
+                            self._broker.forget(request_id)
+                            raise BackendRestartingError(
+                                "no healthy prefill pool member",
+                                retry_after_s=(
+                                    self._link_cfg.reconnect_base_s * 2))
+                    elif self._net_mode:
                         await self._link.submit(submit)
                     else:
                         await self._host_send(submit,
@@ -1433,7 +2103,17 @@ class TpuNativeBackend(InferenceBackend):
                         "engine host produced no event for 600s") from None
                 stamps = ev.get("t")
                 if isinstance(stamps, dict):
-                    self._observe_stages(t_recv, t_submit, stamps)
+                    off = None
+                    if self._pool_mode:
+                        # Host stamps came from whichever decode member
+                        # adopted this request — reconcile through ITS
+                        # measured clock offset.
+                        dm = self._decode_members.get(
+                            self._pool.adopted_on(request_id) or "")
+                        if dm is not None:
+                            off = dm.clock_offset
+                    self._observe_stages(t_recv, t_submit, stamps,
+                                         clock_offset=off)
                 err = ev.get("error")
                 if ev.get("restarting"):
                     # Host crash/wedge mid-stream: the structured
@@ -1465,7 +2145,31 @@ class TpuNativeBackend(InferenceBackend):
                     return
         finally:
             self._queues.pop(request_id, None)
-            if not completed:
+            if self._pool_mode:
+                placed = self._pool.assigned_to(request_id)
+                adopted = self._pool.adopted_on(request_id)
+                self._pool.note_done(request_id)
+                self._pool_submits.pop(request_id, None)
+                if not completed:
+                    import contextlib
+
+                    self._broker.forget(request_id)
+                    # Cancel wherever the request may still live: the
+                    # prefill member it was placed on (over its link)
+                    # and the decode member that adopted it.
+                    link = self._plinks.get(placed) if placed else None
+                    if link is not None:
+                        with contextlib.suppress(ConnectionError, OSError):
+                            await link.cancel(
+                                {"op": HostOp.CANCEL, "id": request_id})
+                    dm = (self._decode_members.get(adopted)
+                          if adopted else None)
+                    if dm is not None and dm.alive:
+                        with contextlib.suppress(ConnectionError, OSError):
+                            await self._host_send(
+                                {"op": HostOp.CANCEL, "id": request_id},
+                                proc=dm.proc)
+            elif not completed:
                 # client abandoned the stream: free the slot host-side.
                 # In disagg the request may be on EITHER tier (queued or
                 # prefilling on one, decoding on the other) — cancel on

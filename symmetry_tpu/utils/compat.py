@@ -1,0 +1,24 @@
+"""Version-compat shims for the JAX surface this project rides.
+
+One module, one job: paper over the API moves between the JAX versions
+the toolchain images carry, so the rest of the codebase imports ONE
+spelling and never version-sniffs inline.
+
+`shard_map`: promoted from `jax.experimental.shard_map.shard_map` to
+`jax.shard_map` in newer releases (and the experimental module is slated
+for removal). Older trees (e.g. 0.4.x) only have the experimental
+spelling; newer ones may only have the top-level one. Resolved ONCE at
+import; call sites (`parallel/ring.py`, `parallel/ulysses.py`,
+`parallel/pipeline.py`) take it from here.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pre-promotion JAX: the experimental module is the only home
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+__all__ = ["shard_map"]

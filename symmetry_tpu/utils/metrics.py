@@ -116,6 +116,14 @@ class MetricName:
     LINK_CREDIT_STALLS = "sym_link_credit_stalls_total"
     LINK_PARTIAL_DISCARDS = "sym_link_partial_discards_total"
 
+    # --- elastic disagg pool (engine/disagg/pool.py, provider process)
+    POOL_MEMBERS = "sym_pool_members"                        # {tier}
+    POOL_HEALTHY = "sym_pool_healthy"                        # {tier}
+    POOL_MEMBER_STATE = "sym_pool_member_state"              # {tier,node}
+    POOL_PLACEMENTS = "sym_pool_placements_total"            # {tier,node}
+    POOL_REPLACEMENTS = "sym_pool_replacements_total"
+    POOL_DRAINS = "sym_pool_drains_total"
+
     # --- server registry (server/registry.py)
     SERVER_PROVIDERS_ONLINE = "sym_server_providers_online"
     SERVER_PROVIDER_QUEUED = "sym_server_provider_queued"    # {provider,model}

@@ -53,6 +53,11 @@ class FakeHost:
         self._fail_path = fh.get("failFile")
         self._delay = float(fh.get("tokenDelayS", 0.02))
         self._die_after = fh.get("dieAfterS")
+        # Tier role (tpu.role, pinned by derive_role_config): a
+        # "prefill" fake emits routing-only handoff frames instead of
+        # token events; a "decode" fake adopts and streams — the
+        # protocol shapes the pool/disagg chaos drills exercise.
+        self._role = str((cfg.get("tpu") or {}).get("role") or "unified")
         FAULTS.load(cfg.get("faults"))
 
     def write(self, obj: dict) -> None:
@@ -61,6 +66,25 @@ class FakeHost:
         with self._wlock:
             sys.stdout.write(json.dumps(obj, separators=(",", ":")) + "\n")
             sys.stdout.flush()
+
+    def _handoff(self, msg: dict) -> None:
+        """Prefill role: one submit → one routing-only handoff frame
+        (p=0 — the decode tier full-prefills; real KV extraction needs
+        the real engine). Same wire shape engine/host.py emits."""
+        import base64
+
+        from symmetry_tpu.engine.disagg.frames import encode_kv_handoff
+
+        req_id = str(msg.get("id", ""))
+        if FAULTS.enabled and FAULTS.point("disagg.handoff"):
+            return  # injected drop/crash at the handoff seam
+        tokens = list(range(8))
+        frame = encode_kv_handoff(req_id, tokens, 0, None)
+        time.sleep(self._delay)  # prefill "work" — churn lands mid-flight
+        self.write({"op": HostOp.HANDOFF, "id": req_id, "p": 0,
+                    "prompt_len": len(tokens), "nbytes": len(frame),
+                    "t": time.monotonic(),
+                    "frame": base64.b64encode(frame).decode("ascii")})
 
     def _stream(self, msg: dict) -> None:
         req_id = str(msg.get("id", ""))
@@ -103,9 +127,18 @@ class FakeHost:
             elif op == HostOp.STATS:
                 self.write({"op": HostOp.STATS, "engine_alive": True,
                             "requests": 0, "tokens": 0,
+                            "queue_depth": 0, "role": self._role,
                             **({"faults": FAULTS.counters()}
                                if FAULTS.enabled else {})})
             elif op == HostOp.SUBMIT:
+                target = (self._handoff if self._role == "prefill"
+                          else self._stream)
+                threading.Thread(target=target, args=(msg,),
+                                 daemon=True).start()
+            elif op == HostOp.ADOPT:
+                # Decode role: a migrated request streams exactly like a
+                # submit (the real host parses the frame on the engine
+                # thread; the fake has no engine to seed).
                 threading.Thread(target=self._stream, args=(msg,),
                                  daemon=True).start()
             elif op == HostOp.CANCEL:

@@ -580,6 +580,46 @@ class TestBackendDisaggIdentity:
         assert dg.get("handoff_bytes", 0) > 0
         assert (dg.get("prefill_host") or {}).get("role") == "prefill"
 
+    def test_pool_1x1_memory_greedy_identity(self):
+        """Acceptance pin: a pool of 1×1 is behaviorally identical to
+        the pair — greedy output matches unified token-for-token and
+        the handoff ledger carries both requests, with zero churn."""
+        unified, _ = self._collect_all("unified")
+        pooled, stats = self._collect_all(
+            "disagg", disagg_net={"peer": "mem://pool-id-1x1",
+                                  "pool": {"prefill": 1, "decode": 1}})
+        assert pooled == unified, \
+            "greedy 1×1 pool diverged from unified"
+        dg = stats.get("disagg") or {}
+        assert dg.get("handoff_frames") == 2
+        pb = dg.get("pool") or {}
+        assert pb["healthy"] == {"prefill": 1, "decode": 1}
+        assert pb["re_placements"] == 0 and pb["losses"] == 0
+        assert pb["members"]["prefill-0"]["placements"] == 2
+        assert pb["members"]["decode-0"]["placements"] == 2
+
+    def test_pool_2x2_memory_greedy_identity(self):
+        """Acceptance pin: greedy decode is token-identical between a
+        2×2 memory-transport pool and unified — adoption through ANY
+        member must not change a single token — and sequential
+        least-loaded placement spreads work across every member."""
+        unified, _ = self._collect_all("unified")
+        pooled, stats = self._collect_all(
+            "disagg", disagg_net={"peer": "mem://pool-id-2x2",
+                                  "pool": {"prefill": 2, "decode": 2}})
+        assert pooled == unified, \
+            "greedy 2×2 pool diverged from unified"
+        dg = stats.get("disagg") or {}
+        assert dg.get("handoff_frames") == 2
+        pb = dg.get("pool") or {}
+        assert pb["healthy"] == {"prefill": 2, "decode": 2}
+        assert pb["re_placements"] == 0 and pb["losses"] == 0
+        # two sequential requests, four members: each tier spread one
+        # request per member (lifetime placements break the idle tie)
+        for member_id in ("prefill-0", "prefill-1",
+                          "decode-0", "decode-1"):
+            assert pb["members"][member_id]["placements"] == 1, pb
+
     def test_network_mode_tcp_greedy_identity(self):
         """THE cross-machine acceptance contract: both tiers as real
         engine hosts connected ONLY through the TCP handoff link
@@ -870,9 +910,12 @@ class TestLinkEnvelope:
         # wire-contract checker pivots on this set (no raw literals
         # outside tests), and the deliberate HostOp value reuse (a link
         # `submit` forwards a host `submit`) is pinned as intentional.
+        # ping/pong/drain/leave are the pool-membership extensions
+        # (keepalive + deliberate-churn announces).
         assert LINK_OPS == {"hello", "clock", "submit", "cancel",
                             "stats", "trace", "credit", "ack", "nak",
-                            "begin", "chunk", "end", "fail", "event"}
+                            "begin", "chunk", "end", "fail", "event",
+                            "ping", "pong", "drain", "leave"}
         assert LINK_OPS & HOST_OPS == {"clock", "submit", "cancel",
                                        "stats", "trace", "event"}
 
@@ -1093,6 +1136,473 @@ class TestLinkTransfer:
                 assert h["n"] == 4
             finally:
                 FAULTS.clear()
+
+        run_async(main())
+
+
+# ---------------------------------------------------------------------
+# Elastic pool: router unit suite (pure state — no sockets, no procs)
+
+
+from symmetry_tpu.engine.disagg.pool import (  # noqa: E402
+    MemberState,
+    PoolConfig,
+    PoolRouter,
+)
+
+
+def healthy_pool(m_prefill=2, n_decode=2):
+    r = PoolRouter()
+    for i in range(m_prefill):
+        r.add_member(f"p{i}", "prefill")
+        r.mark_healthy(f"p{i}")
+    for i in range(n_decode):
+        r.add_member(f"d{i}", "decode")
+        r.mark_healthy(f"d{i}")
+    return r
+
+
+class TestPoolRouter:
+    def test_least_loaded_placement(self):
+        r = healthy_pool()
+        a = r.place("r1")
+        b = r.place("r2")
+        assert {a, b} == {"p0", "p1"}  # spread, not pile-up
+        # p0 and p1 each hold one; a third goes wherever load frees
+        r.note_done("r1")
+        assert r.place("r3") == a  # the emptied member wins
+
+    def test_queue_depth_gauge_steers_placement(self):
+        r = healthy_pool()
+        r.update_gauges("p0", queue_depth=5)
+        assert r.place("r1") == "p1"
+        r.update_gauges("p1", queue_depth=9)
+        assert r.place("r2") == "p0"  # 5+0 beats 9+1
+
+    def test_burn_rate_breaks_ties(self):
+        r = healthy_pool()
+        r.update_gauges("p0", burn_rate=2.0)
+        assert r.place("r1") == "p1"  # equal load, p0 burning budget
+
+    def test_route_decode_releases_prefill_and_balances(self):
+        r = healthy_pool()
+        p = r.place("r1")
+        d1 = r.route_decode("r1")
+        assert r.assigned_to("r1") is None  # migration left the tier
+        assert r.get(p).in_flight == set()
+        r.place("r2")
+        d2 = r.route_decode("r2")
+        assert {d1, d2} == {"d0", "d1"}
+
+    def test_drain_excludes_new_but_keeps_in_flight(self):
+        r = healthy_pool()
+        first = r.place("r1")
+        r.drain(first)
+        assert r.get(first).state == MemberState.DRAINING
+        # in-flight work stays on the draining member...
+        assert "r1" in r.get(first).in_flight
+        # ...but every new placement avoids it
+        for i in range(4):
+            assert r.place(f"n{i}") != first
+        assert r.counters["drains"] == 1
+        # completion drains it naturally
+        r.note_done("r1")
+        assert r.get(first).in_flight == set()
+
+    def test_dead_node_re_placement(self):
+        r = healthy_pool()
+        victim = r.place("r1")
+        r.place("r2")  # lands on the other member
+        ids = r.on_lost(victim)
+        assert ids == ["r1"]
+        assert r.get(victim).state == MemberState.LOST
+        survivor = r.place("r1")
+        assert survivor is not None and survivor != victim
+        r.record_placement("r1", replacement=True)
+        assert r.counters["re_placements"] == 1
+        assert r.counters["losses"] == 1
+        # second loss signal is idempotent — no double-shed
+        assert r.on_lost(victim) == []
+
+    def test_hot_join_and_rejoin(self):
+        r = healthy_pool(m_prefill=1)
+        lost = r.place("r1")
+        r.on_lost(lost)
+        assert r.place("r2") is None  # no survivor: caller sheds
+        # hot-join: a brand-new member becomes placeable immediately
+        r.add_member("p9", "prefill")
+        r.mark_healthy("p9")
+        assert r.place("r2") == "p9"
+        # rejoin: the lost member reconnects and serves again
+        r.mark_healthy(lost, node_id="node-a")
+        assert r.counters["rejoins"] == 1
+        assert r.get(lost).node_id == "node-a"
+        assert r.place("r3") == lost  # least-loaded again
+
+    def test_pool_of_one_degenerates_to_pair_semantics(self):
+        r = healthy_pool(m_prefill=1, n_decode=1)
+        # the single member takes every placement while healthy
+        assert [r.place(f"r{i}") for i in range(3)] == ["p0"] * 3
+        assert all(r.route_decode(f"r{i}") == "d0" for i in range(3))
+        # its loss leaves nothing to re-place onto — the caller sheds
+        # structured-retryable, exactly the pair's link-down behavior
+        ids = r.on_lost("d0")
+        assert sorted(ids) == ["r0", "r1", "r2"]
+        assert r.place("r9") == "p0"  # prefill tier untouched
+        assert r.route_decode("r9") is None
+
+    def test_exclude_walks_past_refusing_members(self):
+        r = healthy_pool(m_prefill=3)
+        got = set()
+        exclude = set()
+        for _ in range(3):
+            m = r.place("r1", exclude=exclude)
+            got.add(m)
+            r.release("r1")
+            exclude.add(m)
+        assert got == {"p0", "p1", "p2"}
+        assert r.place("r1", exclude=exclude) is None
+
+    def test_release_undoes_unsent_placement(self):
+        r = healthy_pool(m_prefill=1)
+        r.place("r1")
+        r.release("r1")
+        assert r.get("p0").in_flight == set()
+        assert r.assigned_to("r1") is None
+        # an unconfirmed placement never reaches the ledger — refused
+        # sends must not inflate SHARE or skew the round-robin
+        assert r.get("p0").placements == 0
+        assert r.counters["placements"] == 0
+        r.place("r1")
+        r.record_placement("r1")
+        assert r.get("p0").placements == 1
+        assert r.counters["placements"] == 1
+
+    def test_joining_and_lost_members_never_placed(self):
+        r = PoolRouter()
+        r.add_member("p0", "prefill")  # joining — not yet serving
+        assert r.place("r1") is None
+        r.mark_healthy("p0")
+        assert r.place("r1") == "p0"
+
+    def test_stats_shape(self):
+        r = healthy_pool()
+        r.place("r1")
+        st = r.stats()
+        assert st["healthy"] == {"prefill": 2, "decode": 2}
+        assert st["in_flight"] == {"prefill": 1, "decode": 0}
+        assert set(st["members"]) == {"p0", "p1", "d0", "d1"}
+        m = st["members"]["p0"]
+        assert {"tier", "state", "in_flight", "placements",
+                "queue_depth"} <= set(m)
+
+
+class TestPoolConfig:
+    def test_absent_means_pair_mode(self):
+        assert not PoolConfig(None).enabled
+        assert not PoolConfig({}).enabled
+        assert not PoolConfig({"peer": "tcp://x:1"}).enabled
+
+    def test_counts(self):
+        cfg = PoolConfig({"pool": {"prefill": 3, "decode": 2,
+                                   "heartbeat_s": 1.5}})
+        assert cfg.enabled and cfg.prefill_count == 3
+        assert cfg.decode_count == 2 and cfg.heartbeat_s == 1.5
+        assert cfg.prefill_peers is None
+
+    def test_peer_list(self):
+        cfg = PoolConfig({"pool": {"prefill": ["tcp://a:1", "tcp://b:2"]}})
+        assert cfg.prefill_peers == ["tcp://a:1", "tcp://b:2"]
+        assert cfg.prefill_count == 2 and cfg.decode_count == 1
+
+    def test_link_config_for_peer(self):
+        base = LinkConfig({"peer": "tcp://x:1", "chunk_kb": 8,
+                           "node_id": "me"})
+        per = base.for_peer("tcp://y:2", heartbeat_s=2.0)
+        assert per.peer == "tcp://y:2"
+        assert per.chunk_bytes == base.chunk_bytes
+        assert per.heartbeat_s == 2.0 and base.heartbeat_s == 0.0
+
+    def test_member_listen_addr(self):
+        from symmetry_tpu.provider.backends.tpu_native import (
+            TpuNativeBackend)
+
+        f = TpuNativeBackend._member_listen_addr
+        assert f("mem://pool", 1, 3) == "mem://pool-p1"
+        assert f("tcp://127.0.0.1:0", 2, 3) == "tcp://127.0.0.1:0"
+        assert f("tcp://10.0.0.1:4631", 0, 2) == "tcp://10.0.0.1:0"
+        assert f("tcp://10.0.0.1:4631", 0, 1) == "tcp://10.0.0.1:4631"
+
+
+# ---------------------------------------------------------------------
+# Elastic pool through the real backend plumbing, against fake hosts:
+# the full placement → link → node → handoff → adopt → stream path plus
+# churn drills, in milliseconds (no JAX engine per member).
+
+
+import os  # noqa: E402
+import sys  # noqa: E402
+import uuid  # noqa: E402
+
+FAKE_HOST = os.path.join(os.path.dirname(__file__), "fake_host.py")
+
+
+def _fake_pool_backend(pool, *, peer=None, link_extra=None,
+                       token_delay_s=0.15):
+    from symmetry_tpu.engine.disagg.node import PrefillNode
+    from symmetry_tpu.provider.backends.tpu_native import TpuNativeBackend
+    from symmetry_tpu.provider.config import ConfigManager
+
+    class FakePoolBackend(TpuNativeBackend):
+        def _host_argv(self, cfg_path):
+            return [sys.executable, FAKE_HOST, cfg_path]
+
+        def _node_factory(self, config, listen):
+            node = PrefillNode(config, listen=listen)
+            node._host_argv = lambda p: [sys.executable, FAKE_HOST, p]
+            return node
+
+    cfg = ConfigManager(config={
+        "name": "pool-fake", "public": False, "serverKey": "00" * 32,
+        "modelName": "fake:pool", "apiProvider": "tpu_native",
+        "dataCollectionEnabled": False,
+        "fakeHost": {"tokenDelayS": token_delay_s},
+        "tpu": {"engine_isolation": "process", "max_batch_size": 4,
+                "role": "disagg",
+                "supervisor": {"heartbeat_s": 30.0, "wedge_timeout_s": 5.0,
+                               "backoff_base_s": 0.05, "backoff_max_s": 0.2,
+                               "max_respawns": 2, "spawn_timeout_s": 15.0,
+                               "stop_grace_s": 0.5, "min_stable_s": 0.2},
+                "disagg": {"peer": peer or f"mem://pool-{uuid.uuid4().hex[:8]}",
+                           "reconnect_base_s": 0.05,
+                           "pool": pool,
+                           **(link_extra or {})}},
+    })
+    return FakePoolBackend(cfg)
+
+
+async def _collect_stream(backend, content, max_tokens=4):
+    from symmetry_tpu.provider.backends.base import InferenceRequest
+
+    text = []
+    async for chunk in backend.stream(InferenceRequest(
+            messages=[{"role": "user", "content": content}],
+            max_tokens=max_tokens, temperature=0.0)):
+        if chunk.text:
+            text.append(chunk.text)
+    return "".join(text)
+
+
+class TestPoolBackendFake:
+    def test_2x2_serves_and_spreads_placements(self):
+        async def main():
+            backend = _fake_pool_backend({"prefill": 2, "decode": 2})
+            await backend.start()
+            try:
+                texts = await asyncio.gather(
+                    *[_collect_stream(backend, f"req {i}")
+                      for i in range(4)])
+                assert all(texts)
+                stats = await backend.engine_stats()
+                pool = stats["disagg"]["pool"]
+                assert pool["healthy"] == {"prefill": 2, "decode": 2}
+                assert pool["re_placements"] == 0
+                # placements spread: every member served at least once
+                # (4 concurrent requests, least-loaded placement)
+                per_node = {mid: m["placements"]
+                            for mid, m in pool["members"].items()}
+                assert all(per_node[f"prefill-{i}"] >= 1
+                           for i in range(2)), per_node
+                assert all(per_node[f"decode-{i}"] >= 1
+                           for i in range(2)), per_node
+                # handoff ledger rode the member links
+                assert stats["disagg"]["handoff_frames"] == 4
+                links = pool["links"]
+                assert all(l["connected"] for l in links.values())
+                assert sum(l["wire_frames"]
+                           for l in links.values()) == 4
+            finally:
+                await backend.stop()
+
+        run_async(main())
+
+    def test_node_death_re_places_in_flight_on_survivor(self):
+        """THE churn contract: killing one prefill member of a 2×1 pool
+        mid-traffic completes every in-flight request via re-placement
+        — zero failed client outcomes, zero decode-host restarts."""
+        async def main():
+            backend = _fake_pool_backend({"prefill": 2, "decode": 1})
+            await backend.start()
+            try:
+                tasks = [asyncio.ensure_future(
+                    _collect_stream(backend, f"req {i}"))
+                    for i in range(4)]
+                await asyncio.sleep(0.05)  # inside the prefill window
+                await backend._inline_nodes[0].kill()  # crash, no leave
+                done = await asyncio.gather(*tasks,
+                                            return_exceptions=True)
+                errs = [d for d in done if isinstance(d, Exception)]
+                assert not errs, f"client-visible failures: {errs}"
+                assert all(done)
+                stats = await backend.engine_stats()
+                pool = stats["disagg"]["pool"]
+                states = {mid: m["state"]
+                          for mid, m in pool["members"].items()}
+                assert states["prefill-0"] == "lost"
+                assert states["prefill-1"] == "healthy"
+                assert states["decode-0"] == "healthy"
+                assert pool["re_placements"] >= 1
+                assert stats["supervisor"]["restarts"] == 0
+            finally:
+                await backend.stop()
+
+        run_async(main())
+
+    def test_link_cut_sheds_then_hot_rejoins(self):
+        """A cable pull (link drop, node alive) re-places in-flight
+        work; the reconnect loop re-establishes the link and the member
+        REJOINS the placement set."""
+        async def main():
+            backend = _fake_pool_backend({"prefill": 2, "decode": 1})
+            await backend.start()
+            try:
+                t = asyncio.ensure_future(
+                    _collect_stream(backend, "req"))
+                await asyncio.sleep(0.05)
+                # hard-cut the LOADED member's link mid-flight (the
+                # node survives — this is a cable pull, not a death)
+                held = next(iter(backend._pool._assigned.values()),
+                            "prefill-0")
+                await backend._plinks[held]._link.drop("test cable pull")
+                text = await asyncio.wait_for(t, 30)
+                assert text  # completed through re-place or reconnect
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    if backend._pool.healthy_count("prefill") == 2:
+                        break
+                    await asyncio.sleep(0.05)
+                stats = await backend.engine_stats()
+                pool = stats["disagg"]["pool"]
+                assert pool["healthy"]["prefill"] == 2, pool["members"]
+                assert pool["rejoins"] >= 1
+            finally:
+                await backend.stop()
+
+        run_async(main())
+
+    def test_drain_excludes_node_and_finishes_in_flight(self):
+        async def main():
+            backend = _fake_pool_backend({"prefill": 2, "decode": 1})
+            await backend.start()
+            try:
+                # one request in flight on whichever member won it
+                t = asyncio.ensure_future(
+                    _collect_stream(backend, "inflight"))
+                await asyncio.sleep(0.05)
+                held = next(iter(backend._pool._assigned.values()), None)
+                idx = 0 if held == "prefill-0" else 1
+                await backend._inline_nodes[idx].drain()
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    m = backend._pool.get(f"prefill-{idx}")
+                    if m.state == "draining":
+                        break
+                    await asyncio.sleep(0.02)
+                assert backend._pool.get(
+                    f"prefill-{idx}").state == "draining"
+                # the in-flight request still completes on the drainer
+                assert await asyncio.wait_for(t, 30)
+                # every NEW request avoids the draining member
+                texts = await asyncio.gather(
+                    *[_collect_stream(backend, f"post {i}")
+                      for i in range(3)])
+                assert all(texts)
+                stats = await backend.engine_stats()
+                pool = stats["disagg"]["pool"]
+                drained = pool["members"][f"prefill-{idx}"]
+                other = pool["members"][f"prefill-{1 - idx}"]
+                assert drained["state"] == "draining"
+                assert other["placements"] >= 3
+                assert pool["drains"] == 1
+            finally:
+                await backend.stop()
+
+        run_async(main())
+
+    def test_pool_of_1x1_serves_and_total_loss_sheds_retryable(self):
+        """Degenerate pool: one member per tier serves like the pair;
+        losing the ONLY prefill member has no survivor, so the shed is
+        the structured retryable — the PR 7/9 link-down behavior."""
+        from symmetry_tpu.provider.backends.base import (
+            BackendRestartingError)
+
+        async def main():
+            backend = _fake_pool_backend({"prefill": 1, "decode": 1})
+            await backend.start()
+            try:
+                assert await _collect_stream(backend, "warm")
+                t = asyncio.ensure_future(
+                    _collect_stream(backend, "doomed"))
+                await asyncio.sleep(0.05)
+                await backend._inline_nodes[0].stop()
+                with pytest.raises(BackendRestartingError):
+                    await asyncio.wait_for(t, 30)
+                # new submits shed retryable too (no healthy member)
+                with pytest.raises(BackendRestartingError):
+                    await _collect_stream(backend, "after")
+                stats = await backend.engine_stats()
+                pool = stats["disagg"]["pool"]
+                assert pool["members"]["prefill-0"]["state"] == "lost"
+                assert pool["healthy"]["prefill"] == 0
+            finally:
+                await backend.stop()
+
+        run_async(main())
+
+    def test_decode_member_death_sheds_only_its_streams(self):
+        """Per-member supervision: a decode member's death fails only
+        the streams adopted THERE (retryable), and the member respawns
+        alone — its sibling keeps serving throughout."""
+        async def main():
+            backend = _fake_pool_backend({"prefill": 1, "decode": 2},
+                                         token_delay_s=0.3)
+            await backend.start()
+            try:
+                tasks = [asyncio.ensure_future(
+                    _collect_stream(backend, f"req {i}", max_tokens=8))
+                    for i in range(2)]
+                # wait until both are adopted (one per decode member)
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    if len(backend._pool._adopted) == 2:
+                        break
+                    await asyncio.sleep(0.02)
+                adopted = dict(backend._pool._adopted)
+                assert set(adopted.values()) == {"decode-0", "decode-1"}
+                victim = backend._decode_members["decode-0"]
+                victim.proc.kill()
+                done = await asyncio.gather(*tasks,
+                                            return_exceptions=True)
+                from symmetry_tpu.provider.backends.base import (
+                    BackendRestartingError)
+
+                sheds = [d for d in done
+                         if isinstance(d, BackendRestartingError)]
+                texts = [d for d in done if isinstance(d, str)]
+                assert len(sheds) == 1, done  # only the victim's stream
+                assert len(texts) == 1 and texts[0]
+                # the victim respawns alone
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    if victim.alive and victim.restarts >= 1:
+                        break
+                    await asyncio.sleep(0.05)
+                assert victim.restarts == 1
+                sibling = backend._decode_members["decode-1"]
+                assert sibling.restarts == 0 and sibling.alive
+                assert await _collect_stream(backend, "after")
+            finally:
+                await backend.stop()
 
         run_async(main())
 

@@ -361,6 +361,87 @@ async def run_link_chaos() -> int:
     return 0
 
 
+async def run_pool_chaos() -> int:
+    """Phase 4: the ELASTIC POOL churn contract. A 2×1 pool (two inline
+    prefill nodes over the memory link, one decode host) takes sustained
+    traffic; one prefill node is KILLED mid-traffic (crash — no drain,
+    no leave). Every in-flight request must complete via the retryable
+    shed + re-placement path on the survivor: zero non-retryable client
+    outcomes, zero partial adoptions (decode adopt errors stay 0), zero
+    decode-host restarts, and the pool metrics account the churn
+    (member lost, re-placements counted)."""
+    from symmetry_tpu.provider.backends.base import (
+        BackendRestartingError, InferenceRequest)
+    from symmetry_tpu.provider.backends.tpu_native import TpuNativeBackend
+    from symmetry_tpu.provider.config import ConfigManager
+
+    cfg = provider_config_dict()
+    cfg["name"] = "disagg-pool-prov"
+    # Every prefill host's FIRST handoff stalls 2 s (delay seam on the
+    # engine thread) — the deterministic window in which the node kill
+    # lands with migrations genuinely in flight. No crash faults here.
+    cfg["tpu"]["disagg"] = {
+        "peer": "mem://pool-smoke", "reconnect_base_s": 0.2,
+        "pool": {"prefill": 2, "decode": 1, "heartbeat_s": 1.0},
+        "prefill": {"faults": {"disagg.handoff": "delay(2.0)@once"}},
+    }
+
+    async def collect(backend, content):
+        text = []
+        async for chunk in backend.stream(InferenceRequest(
+                messages=[{"role": "user", "content": content}],
+                max_tokens=8, temperature=0.0)):
+            if chunk.text:
+                text.append(chunk.text)
+        return "".join(text)
+
+    async def collect_retrying(backend, content):
+        # The retryable shed is an ALLOWED outcome (client failover
+        # retries through it); anything non-retryable fails the smoke.
+        for _ in range(200):
+            try:
+                return await collect(backend, content)
+            except BackendRestartingError:
+                await asyncio.sleep(0.25)
+        raise AssertionError(f"{content!r} never completed")
+
+    backend = TpuNativeBackend(ConfigManager(config=cfg))
+    try:
+        await backend.start()
+        tasks = [asyncio.ensure_future(
+            collect_retrying(backend, f"{PROMPT} #{i}"))
+            for i in range(4)]
+        await asyncio.sleep(0.7)  # placements made; handoffs mid-delay
+        pending_before = backend._broker.pending
+        await backend._inline_nodes[0].kill()  # node death mid-traffic
+        texts = await asyncio.gather(*tasks)
+        assert all(texts), f"incomplete streams: {[len(t) for t in texts]}"
+        stats = await backend.engine_stats()
+        pool = (stats.get("disagg") or {}).get("pool") or {}
+        members = pool.get("members") or {}
+        assert members.get("prefill-0", {}).get("state") == "lost", members
+        assert members.get("prefill-1", {}).get("state") == "healthy", \
+            members
+        assert pool.get("losses", 0) >= 1, pool
+        assert pool.get("re_placements", 0) >= 1, \
+            f"no re-placement counted (pending at kill: " \
+            f"{pending_before}): {pool}"
+        sup = stats.get("supervisor") or {}
+        assert sup.get("restarts", 0) == 0, \
+            f"node death must not restart a decode host: {sup}"
+        ad = stats.get("adopt") or {}
+        assert ad.get("errors", 0) == 0, \
+            f"partial/garbage adoption on the decode host: {ad}"
+        print(f"disagg smoke: pool phase — killed prefill-0 of 2×1 "
+              f"under load ({pending_before} migrations in flight); "
+              f"all 4 requests completed, re_placements="
+              f"{pool.get('re_placements')}, losses="
+              f"{pool.get('losses')}, decode restarts 0, adopt errors 0")
+    finally:
+        await backend.stop()
+    return 0
+
+
 def main() -> int:
     try:
         import cryptography  # noqa: F401 — wire-path dependency probe
@@ -377,6 +458,9 @@ def main() -> int:
         if rc == 0:
             rc = loop.run_until_complete(
                 asyncio.wait_for(run_link_chaos(), 900))
+        if rc == 0:
+            rc = loop.run_until_complete(
+                asyncio.wait_for(run_pool_chaos(), 900))
         return rc
     except AssertionError as exc:
         print(f"disagg smoke FAILED: {exc}", file=sys.stderr)

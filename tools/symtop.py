@@ -46,8 +46,12 @@ from symmetry_tpu.utils.metrics import (  # noqa: E402
 )
 
 COLUMNS = ("PROVIDER", "TIER", "TOK/S", "TTFT p50", "TTFT p99",
-           "QUEUE", "INFL", "OCC", "SHED", "LINK")
-WIDTHS = (22, 9, 9, 9, 9, 7, 6, 5, 7, 6)
+           "QUEUE", "INFL", "OCC", "SHED", "LINK", "STATE", "SHARE")
+WIDTHS = (22, 10, 9, 9, 9, 7, 6, 5, 7, 6, 9, 6)
+
+# sym_pool_member_state gauge encoding (engine/disagg/pool.py
+# STATE_CODES) rendered back to the membership lifecycle names.
+POOL_STATE_NAMES = {0: "joining", 1: "healthy", 2: "draining", 3: "lost"}
 
 
 # ----------------------------------------------------- family flattening
@@ -133,6 +137,55 @@ def _tiers(fams: dict) -> list[str]:
     return seen
 
 
+def _pool_rows(name: str, fams: dict) -> list[dict[str, Any]]:
+    """One sub-row per elastic-pool member (disagg M×N providers):
+    membership state (joining/healthy/draining/lost), link health
+    derived from it, and the member's share of its tier's lifetime
+    placements — the live answer to 'who is taking the traffic and who
+    just churned'."""
+    fam = fams.get("sym_pool_member_state")
+    if fam is None:
+        return []
+    states: dict[tuple[str, str], float] = {}
+    for s in fam["series"]:
+        if s.get("suffix"):
+            continue
+        lab = s["labels"]
+        node = lab.get("node", "")
+        if node:
+            states[(lab.get("tier", ""), node)] = s["value"]
+    if not states:
+        return []
+    placements: dict[tuple[str, str], float] = {}
+    totals: dict[str, float] = {}
+    pfam = fams.get("sym_pool_placements_total") or {"series": []}
+    for s in pfam["series"]:
+        if s.get("suffix"):
+            continue
+        lab = s["labels"]
+        key = (lab.get("tier", ""), lab.get("node", ""))
+        placements[key] = placements.get(key, 0.0) + s["value"]
+        totals[key[0]] = totals.get(key[0], 0.0) + s["value"]
+    rows: list[dict[str, Any]] = []
+    for (tier, node), code in sorted(states.items()):
+        total = totals.get(tier, 0.0)
+        share = (placements.get((tier, node), 0.0) / total
+                 if total else None)
+        state = POOL_STATE_NAMES.get(int(code), "?")
+        rows.append({
+            "provider": name, "tier": node, "tok_s": None,
+            "ttft_p50": None, "ttft_p99": None, "queue": None,
+            "in_flight": None, "occupancy": None, "shed": None,
+            # membership IS link health: healthy/draining members hold
+            # a live link; lost means the link (or node) is gone.
+            "link": ("up" if state in ("healthy", "draining")
+                     else "DOWN" if state == "lost" else "-"),
+            "state": state,
+            "share": f"{share * 100:.0f}%" if share is not None else None,
+        })
+    return rows
+
+
 # ------------------------------------------------------------- row model
 
 
@@ -165,11 +218,13 @@ def build_rows(name: str, fams: dict,
         "occupancy": None,
         "shed": shed_disp,
         "link": (None if link is None else ("up" if link else "DOWN")),
+        "state": None, "share": None,
         "_sample": {"t": now, "tok": tok, "shed": shed or 0.0},
     }]
     for tier in _tiers(fams):
         rows.append({
             "provider": name, "tier": tier,
+            "state": None, "share": None,
             "tok_s": None,
             # True engine-side TTFT (enqueue → first sampled token),
             # not dispatch wall — queue wait must show under overload.
@@ -184,6 +239,7 @@ def build_rows(name: str, fams: dict,
                            tier=tier),
             "link": None,
         })
+    rows.extend(_pool_rows(name, fams))
     return rows
 
 
@@ -202,7 +258,8 @@ def render_table(rows: list[dict[str, Any]]) -> str:
     for r in rows:
         cells = (r["provider"], r["tier"] or "-", r["tok_s"],
                  r["ttft_p50"], r["ttft_p99"], r["queue"], r["in_flight"],
-                 r["occupancy"], r["shed"], r["link"] or "-")
+                 r["occupancy"], r["shed"], r["link"] or "-",
+                 r.get("state") or "-", r.get("share") or "-")
         out.append("  ".join(_fmt_cell(c, w)
                              for c, w in zip(cells, WIDTHS)))
     return "\n".join(out)
