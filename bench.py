@@ -1179,6 +1179,17 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             later = sorted(t for r in results
                            for t in r.get("turn_ttfts", [])[1:])
             if first and later:
+                # The per-turn TTFT CURVE vs history length — the radix
+                # cache's "done" evidence (ROADMAP item 3): every turn's
+                # prompt is strictly longer than the last, so a flat or
+                # falling curve means admission cost tracks the NEW
+                # tokens, not the history.
+                by_turn = []
+                for t in range(multi_turn):
+                    vals = sorted(r["turn_ttfts"][t] for r in results
+                                  if len(r.get("turn_ttfts", [])) > t)
+                    by_turn.append(round(pct(vals, 0.50), 3)
+                                   if vals else None)
                 multi_turn_block = {
                     "turns": multi_turn,
                     "sessions": len(results),
@@ -1186,6 +1197,7 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                     "ttft_turn1_p99_s": round(pct(first, 0.99), 3),
                     "ttft_turn2plus_p50_s": round(pct(later, 0.50), 3),
                     "ttft_turn2plus_p99_s": round(pct(later, 0.99), 3),
+                    "ttft_by_turn_p50_s": by_turn,
                     # > 1 means later turns admit faster than turn 1
                     # even though their prompts are LONGER — the session
                     # cache (and, disaggregated, the prefix handoff)
@@ -1194,6 +1206,19 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                         round(pct(first, 0.50) / pct(later, 0.50), 3)
                         if pct(later, 0.50) else None),
                 }
+                pc = (diag or {}).get("prefix_cache") or {}
+                if pc.get("blocks_total"):
+                    # Session-cache memory economics: peak pool
+                    # occupancy and blocks in use at run end, per the
+                    # paged-KV accounting in engine/prefix_cache.py.
+                    multi_turn_block["prefix"] = {
+                        "block_tokens": pc.get("block_tokens"),
+                        "blocks_in_use": pc.get("blocks_in_use"),
+                        "blocks_total": pc.get("blocks_total"),
+                        "hbm_high_water_bytes": pc.get(
+                            "hbm_high_water_bytes"),
+                        "hit_rate": pc.get("hit_rate"),
+                    }
                 print(f"[bench] multi-turn: TTFT p50 turn-1 "
                       f"{multi_turn_block['ttft_turn1_p50_s']}s → "
                       f"turn-2+ "
@@ -1203,6 +1228,16 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                       f"{multi_turn_block['ttft_turn1_p99_s']} → "
                       f"{multi_turn_block['ttft_turn2plus_p99_s']})",
                       file=sys.stderr)
+                print(f"[bench] multi-turn TTFT p50 by turn: "
+                      f"{multi_turn_block['ttft_by_turn_p50_s']}",
+                      file=sys.stderr)
+                if "prefix" in multi_turn_block:
+                    px = multi_turn_block["prefix"]
+                    print(f"[bench] prefix pool: "
+                          f"{px['blocks_in_use']}/{px['blocks_total']} "
+                          f"blocks x {px['block_tokens']} tok, HBM "
+                          f"high-water {px['hbm_high_water_bytes']} B, "
+                          f"hit rate {px['hit_rate']}", file=sys.stderr)
 
         return {
             "metric": f"e2e serving tok/s ({preset_name} {dtype_label}, "

@@ -85,6 +85,12 @@ class HandoffBroker:
         self.counters = {"submitted": 0, "handoff_frames": 0,
                          "handoff_bytes": 0, "prefix_tokens": 0,
                          "routing_only": 0, "dropped": 0,
+                         # Block-manifest ledger (frames v2): blocks the
+                         # manifests covered vs blocks whose payload
+                         # actually rode the wire — manifest-only blocks
+                         # were adopted by reference on the decode tier
+                         # (the incremental-handoff savings).
+                         "blocks": 0, "blocks_shipped": 0,
                          # The WIRE leg of the handoff (serialize time
                          # lives host-side in handoff_stats): pipe hop
                          # for the local pair, chunked link transfer in
@@ -234,6 +240,8 @@ class HandoffBroker:
                                    request_id=req_id, bytes=nbytes)
         p = int(handoff.get("p", 0))
         self.counters["prefix_tokens"] += p
+        self.counters["blocks"] += int(handoff.get("blocks", 0))
+        self.counters["blocks_shipped"] += int(handoff.get("shipped", 0))
         if p == 0:
             self.counters["routing_only"] += 1
         op: dict[str, Any] = {"op": HostOp.ADOPT, "id": req_id,

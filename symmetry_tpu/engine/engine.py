@@ -44,7 +44,7 @@ from symmetry_tpu.models.llama import (
 from symmetry_tpu.ops.sampling import sample_tokens, verify_tokens
 from symmetry_tpu.parallel.mesh import MeshSpec, build_mesh
 from symmetry_tpu.parallel.sharding import shardings_for
-from symmetry_tpu.engine.prefix_cache import PrefixHit, PrefixStore
+from symmetry_tpu.engine.prefix_cache import BlockPool, RadixHit, RadixIndex
 from symmetry_tpu.engine.spec import SpecConfig
 from symmetry_tpu.engine.tokenizer import Tokenizer, get_tokenizer
 
@@ -154,6 +154,7 @@ class InferenceEngine:
         prefill_chunk: int | None = 256,
         prefill_token_budget: int | None = None,
         prefix_cache_bytes: int = 0,
+        prefix_block_tokens: int = 16,
         speculative: SpecConfig | None = None,
         fused_dequant: bool = False,
         role: str = "unified",
@@ -304,50 +305,67 @@ class InferenceEngine:
         # _prefill_scratch_for.
         self._prefill_scratch: dict[tuple[int, int], Any] = {}
 
-        # Shared-prefix KV cache (prefix_cache.py): boundaries align to
-        # min(prefill_chunk, smallest bucket) so (a) every hit's suffix
-        # fits the ONE compiled continuation shape per (batch, bucket)
-        # and (b) prompts at the smallest bucket can still hit. Off by
+        # Radix-tree prefix cache over a paged KV block pool
+        # (prefix_cache.py). `prefix_align` is the compiled SUFFIX width
+        # of the one-dispatch hit path (min(prefill_chunk, smallest
+        # bucket), unchanged from the aligned-store days); MATCHING now
+        # happens at `prefix_block` granularity — any whole-block shared
+        # prefix hits, bucket boundaries no longer matter. Off by
         # default (budget 0): the default serving path then performs
-        # literally zero extra work — no lookups, no store dispatches,
+        # literally zero extra work — no lookups, no pool allocation,
         # no extra warmup compiles.
         self.prefix_align = (min(self.prefill_chunk, self.prefill_buckets[0])
                              if self.prefill_chunk is not None else None)
+        self.prefix_block = int(prefix_block_tokens)
+        if self.prefix_block < 1:
+            raise EngineError("prefix_block_tokens must be >= 1")
+        self.block_pool: BlockPool | None = None
+        self.prefix_index: RadixIndex | None = None
+        self._pool_kv = None
         if prefix_cache_bytes > 0 and self.prefix_align:
-            self.prefix_store: PrefixStore | None = PrefixStore(
-                budget_bytes=prefix_cache_bytes, align=self.prefix_align)
-        else:
-            self.prefix_store = None
-        if self.role == "decode" and self.prefix_store is None:
-            # Adoption lands handed-off KV through PrefixStore.insert;
-            # without a store every migrated request would silently
+            # Only a BUILT pool constrains the bucket grid (the gather/
+            # scatter programs index buckets in whole blocks); with the
+            # cache off, prefix_block is only the handoff slicing unit
+            # and any bucket set that worked before keeps working.
+            for b in self.prefill_buckets:
+                if b % self.prefix_block:
+                    raise EngineError(
+                        f"prefix_block_tokens {self.prefix_block} must "
+                        f"divide every prefill bucket (bucket {b} does "
+                        f"not) — the block gather/scatter programs "
+                        f"index buckets in whole blocks")
+            block_bytes = self.prefix_block * self.kv_bytes_per_token()
+            n_blocks = int(prefix_cache_bytes) // block_bytes
+            if self.role == "decode":
+                # Geometry-derived floor, not a fixed MB knob: adoption
+                # of a largest-bucket prompt must never be rejected by a
+                # default budget too small for the model at hand — the
+                # prefill tier's work would ship across the pipe and be
+                # thrown away, strictly worse than unified mode. Two
+                # largest prefixes' worth keeps one pinned mid-copy
+                # while the next adopts.
+                n_blocks = max(n_blocks, 2 * (self.prefill_buckets[-1]
+                                              // self.prefix_block))
+            # The pool must at least hold one smallest-bucket prefix or
+            # every insert is a guaranteed rejection.
+            n_blocks = max(n_blocks,
+                           self.prefill_buckets[0] // self.prefix_block)
+            self.block_pool = BlockPool(n_blocks, self.prefix_block,
+                                        block_bytes)
+            self.prefix_index = RadixIndex(self.block_pool)
+        if self.role == "decode" and self.prefix_index is None:
+            # Adoption lands handed-off KV through the radix index;
+            # without it every migrated request would silently
             # re-prefill from scratch — the exact work the prefill tier
             # already did.
             raise EngineError(
                 "role: decode requires the prefix cache "
                 "(tpu.prefix_cache_mb > 0 and a prefill_chunk) — "
                 "handoff frames are adopted through it")
-        if self.role == "decode":
-            # Budget floor derived from THIS engine's geometry, not a
-            # fixed MB knob: adopted entries are padded to bucket
-            # capacity, so a budget smaller than one largest-bucket
-            # entry would reject EVERY adoption of a big prompt — the
-            # prefill tier's work shipped across the pipe and thrown
-            # away, strictly worse than unified mode. Two entries'
-            # worth keeps one pinned mid-copy while the next adopts.
-            # +1 KiB/entry slack: a store entry's nbytes includes small
-            # metadata leaves (the lengths array) beyond the KV planes,
-            # and the floor must hold with one entry PINNED mid-copy —
-            # exactly two largest entries must genuinely fit.
-            floor = 2 * (self.prefill_buckets[-1]
-                         * self.kv_bytes_per_token() + 1024)
-            if self.prefix_store.budget_bytes < floor:
-                self.prefix_store.budget_bytes = floor
         if self.role == "prefill" and not self.prefix_align:
             raise EngineError(
-                "role: prefill requires tpu.prefill_chunk — handoff "
-                "prefixes align to it (the decode tier's suffix "
-                "dispatch needs a compiled shape)")
+                "role: prefill requires tpu.prefill_chunk — the decode "
+                "tier's suffix dispatch needs a compiled shape")
 
         # Speculative decoding (engine/spec/): None keeps the serving path
         # byte-identical — no verify jit is ever built or compiled, the
@@ -359,6 +377,27 @@ class InferenceEngine:
                 f"max_seq_len {max_seq_len}")
 
         self._build_jits()
+
+        if self.block_pool is not None:
+            # The device half of the pool: one KVCache whose "batch" axis
+            # is block ids and whose position capacity is one block —
+            # [L, n_blocks + 1, block_tokens, K, D] (+1 for the trash
+            # block scatter pads write to). Allocated ONCE here; every
+            # insert/evict/adopt thereafter is pointer bookkeeping plus
+            # at most one fixed-shape gather or scatter.
+            self._pool_kv = self._new_pool_kv()
+
+    def _new_pool_kv(self):
+        c = self.config
+        slots = self.block_pool.n_blocks + 1  # id 0 is the trash block
+
+        def make():
+            return init_cache(c, slots, self.prefix_block,
+                              self.cache_dtype, quantized=self.kv_quant)
+
+        if self.mesh is not None:
+            return jax.jit(make, out_shardings=self._prefix_shard)()
+        return jax.jit(make)()
 
     # ------------------------------------------------------------------
     # Jitted primitives
@@ -472,32 +511,71 @@ class InferenceEngine:
 
             return jax.lax.fori_loop(0, slots.shape[0], body, state)
 
-        def insert_from_prefix(scratch: KVCache, src: KVCache, p):
-            """Seed a donated (batch, bucket) working prefix buffer from a
-            stored batch-1 prefix-cache entry: every row's first positions
-            become the entry's KV and lengths become `p` (the aligned
-            prefix length in use — may be SHORTER than the entry, which
-            is sound because KV at position i depends only on tokens
-            <= i). Capacities may differ in either direction; the copy
-            covers min(entry, scratch) positions and only the first p
-            are ever attended. The suffix continuation (chunk_step/
-            chunk_final) then runs from these lengths exactly like a
-            chunked prefill that had already built p tokens."""
+        def insert_from_blocks(scratch: KVCache, pool: KVCache, ids, p):
+            """Seed a donated (batch, bucket) working prefix buffer from
+            pool blocks: `ids` [bucket // prefix_block] names the block
+            covering each bucket position span (pad lanes carry the
+            trash block — their gathered garbage lands at positions >= p
+            which the suffix continuation never attends), `p` is the
+            matched prefix length every row's lengths become. ONE
+            compiled program per (batch, bucket) — the ids vector's
+            shape is fixed by the bucket, the block ids are data. The
+            suffix continuation (chunk_step/chunk_final) then runs from
+            these lengths exactly like a chunked prefill that had
+            already built p tokens."""
+            B = scratch.k.shape[1]
 
-            def place(big, small, t_axis):
-                width = min(big.shape[t_axis], small.shape[t_axis])
-                sl = jax.lax.slice_in_dim(small, 0, width, axis=t_axis)
-                tiled = jnp.broadcast_to(
-                    sl, sl.shape[:1] + (big.shape[1],) + sl.shape[2:])
-                return jax.lax.dynamic_update_slice(
-                    big, tiled.astype(big.dtype), (0,) * big.ndim)
+            def gather(parr, big):
+                sel = jnp.take(parr, ids, axis=1)      # [L, nb, PB, K, D]
+                seq = sel.reshape(
+                    (sel.shape[0], 1, sel.shape[1] * sel.shape[2])
+                    + sel.shape[3:])
+                return jnp.broadcast_to(
+                    seq, (seq.shape[0], B) + seq.shape[2:]).astype(big.dtype)
+
+            def gather_scale(parr, big):
+                sel = jnp.take(parr, ids, axis=1)      # [L, nb, K, PB]
+                sel = jnp.moveaxis(sel, 1, 2)          # [L, K, nb, PB]
+                seq = sel.reshape(sel.shape[0], 1, sel.shape[1],
+                                  sel.shape[2] * sel.shape[3])
+                return jnp.broadcast_to(
+                    seq, (seq.shape[0], B) + seq.shape[2:]).astype(big.dtype)
 
             return scratch._replace(
-                k=place(scratch.k, src.k, 2),
-                v=place(scratch.v, src.v, 2),
+                k=gather(pool.k, scratch.k),
+                v=gather(pool.v, scratch.v),
                 lengths=jnp.full_like(scratch.lengths, p),
-                **({"k_scale": place(scratch.k_scale, src.k_scale, 3),
-                    "v_scale": place(scratch.v_scale, src.v_scale, 3)}
+                **({"k_scale": gather_scale(pool.k_scale, scratch.k_scale),
+                    "v_scale": gather_scale(pool.v_scale, scratch.v_scale)}
+                   if self.kv_quant else {}),
+            )
+
+        def write_blocks(pool: KVCache, row: KVCache, ids):
+            """Scatter a batch-1 row buffer (capacity = one bucket) into
+            pool blocks: bucket span j lands in pool block ids[j]. Spans
+            that should NOT be stored (already-resident prefix blocks,
+            positions past the prefix) point their lane at the trash
+            block — the scatter stays one fixed shape per bucket and
+            unwanted writes go where nobody reads. The pool is donated:
+            membership changes in place, never by copy."""
+            PB = self.prefix_block
+
+            def put(parr, rarr):
+                src = rarr[:, 0].reshape(
+                    (rarr.shape[0], ids.shape[0], PB) + rarr.shape[3:])
+                return parr.at[:, ids].set(src.astype(parr.dtype))
+
+            def put_scale(parr, rarr):
+                src = rarr[:, 0].reshape(rarr.shape[0], rarr.shape[2],
+                                         ids.shape[0], PB)
+                src = jnp.moveaxis(src, 2, 1)          # [L, nb, K, PB]
+                return parr.at[:, ids].set(src.astype(parr.dtype))
+
+            return pool._replace(
+                k=put(pool.k, row.k),
+                v=put(pool.v, row.v),
+                **({"k_scale": put_scale(pool.k_scale, row.k_scale),
+                    "v_scale": put_scale(pool.v_scale, row.v_scale)}
                    if self.kv_quant else {}),
             )
 
@@ -636,8 +714,11 @@ class InferenceEngine:
                                        out_shardings=prefix_shard)
             self._chunk_final = jax.jit(chunk_final, donate_argnums=(2,),
                                         out_shardings=(rep, prefix_shard))
-            self._insert_from_prefix = jax.jit(
-                insert_from_prefix, donate_argnums=(0,),
+            self._insert_from_blocks = jax.jit(
+                insert_from_blocks, donate_argnums=(0,),
+                out_shardings=prefix_shard)
+            self._write_blocks = jax.jit(
+                write_blocks, donate_argnums=(0,),
                 out_shardings=prefix_shard)
             self._extract_prefix_row = jax.jit(
                 extract_prefix_row, out_shardings=prefix_shard)
@@ -648,8 +729,9 @@ class InferenceEngine:
                 self._verify = jax.jit(verify_block, donate_argnums=(1,))
             self._chunk_step = jax.jit(chunk_step, donate_argnums=(2,))
             self._chunk_final = jax.jit(chunk_final, donate_argnums=(2,))
-            self._insert_from_prefix = jax.jit(insert_from_prefix,
+            self._insert_from_blocks = jax.jit(insert_from_blocks,
                                                donate_argnums=(0,))
+            self._write_blocks = jax.jit(write_blocks, donate_argnums=(0,))
             self._extract_prefix_row = jax.jit(extract_prefix_row)
         self._insert_all = jax.jit(
             insert_all, donate_argnums=(0,),
@@ -780,8 +862,8 @@ class InferenceEngine:
         # Populate the prefix cache from this batch BEFORE the buffer goes
         # back to the pool (the extract reads it; the next same-shape
         # prefill would overwrite it).
-        if self.prefix_store is not None:
-            self.prefix_store.note_miss(n_req)  # admitted uncached
+        if self.prefix_index is not None:
+            self.prefix_index.note_miss(n_req)  # admitted uncached
             self._maybe_store_prefix(assignments[:n_req], prefix)
         # insert_all READS prefix (no donation): the buffer is free for
         # the next same-shape prefill the moment the insert executes —
@@ -793,15 +875,26 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     # Shared-prefix KV cache (engine side; bookkeeping in prefix_cache.py)
 
-    def prefix_lookup(self, prompt_ids: list[int]) -> PrefixHit | None:
-        """Pinned longest-aligned-prefix hit for this prompt, or None.
-        The scheduler partitions admission groups by the hit identity
-        (hit/miss requests become separate dispatch units) and must
-        release() hits it ends up not dispatching; the engine releases
-        hits it consumes."""
-        if self.prefix_store is None:
+    def prefix_lookup(self, prompt_ids: list[int]) -> RadixHit | None:
+        """Pinned longest block-aligned prefix hit for this prompt, or
+        None. The scheduler partitions admission groups by the hit's
+        (node, matched_len) group key (hit/miss requests become separate
+        dispatch units) and must release() hits it ends up not
+        dispatching; the engine releases hits it consumes."""
+        if self.prefix_index is None:
             return None
-        return self.prefix_store.lookup(prompt_ids)
+        return self.prefix_index.lookup(prompt_ids)
+
+    def _bucket_ids(self, bucket: int, blocks=(), at: int = 0):
+        """Padded block-id lane vector for one bucket's gather/scatter:
+        lane j covers bucket positions [j*PB, (j+1)*PB). Lanes outside
+        `blocks` (placed starting at block lane `at`) carry the trash
+        block — gathers from it are never attended, scatters to it are
+        never read. Fixed shape per bucket: ids are data, not shape."""
+        ids = np.zeros((bucket // self.prefix_block,), np.int32)
+        if len(blocks):
+            ids[at:at + len(blocks)] = blocks
+        return jnp.asarray(ids)
 
     def seeded_chunk_ok(self, prompt_len: int) -> bool:
         """True when a LONG-suffix hit (suffix > prefix_align) can run as
@@ -814,15 +907,18 @@ class InferenceEngine:
 
     def prefill_and_insert_cached(
         self, assignments: list[tuple[int, list[int], SamplingParams]],
-        hit: PrefixHit,
+        hit: RadixHit,
     ) -> list[int]:
-        """Admit a group of requests that SHARE a cached prefix: one seed
-        copy broadcasts the entry into every row of the (batch, bucket)
-        working buffer, one continuation dispatch prefills only the
-        uncached suffixes (<= prefix_align tokens each, the compiled
-        suffix shape) and samples first tokens, one insert installs every
-        slot — three dispatches for the whole group regardless of how
-        long the shared prefix is. Releases `hit` in all paths."""
+        """Admit a group of requests that SHARE a cached prefix: one
+        block gather seeds every row of the (batch, bucket) working
+        buffer straight from the pool, one continuation dispatch
+        prefills only the uncached suffixes (<= prefix_align tokens
+        each, the compiled suffix shape) and samples first tokens, one
+        insert installs every slot — three dispatches for the whole
+        group regardless of how long the shared prefix is. The finished
+        rows then extend the radix tree with their NEW tail blocks, so
+        the next turn of the same session hits at its full history.
+        Releases `hit` in all paths."""
         try:
             if not assignments:
                 return []
@@ -841,7 +937,7 @@ class InferenceEngine:
                     raise EngineError(
                         f"cached-prefill suffix out of range: prompt "
                         f"{len(ids)} vs prefix {p} (suffix cap {A})")
-                if tuple(ids[:p]) != hit.entry.tokens[:p]:
+                if tuple(ids[:p]) != hit.tokens:
                     raise EngineError("prompt diverges from cached prefix")
             batch = next(b for b in allowed if b >= n_req)
 
@@ -876,10 +972,12 @@ class InferenceEngine:
                 decode_keys.append(dk)
 
             scratch = self._prefill_scratch_for(batch, bucket)
-            scratch = self._insert_from_prefix(scratch, hit.entry.cache,
-                                               jnp.int32(p))
-            # The copy out of the entry is dispatched (its buffer is held
-            # by the runtime until it executes): safe to unpin now.
+            scratch = self._insert_from_blocks(
+                scratch, self._pool_kv, self._bucket_ids(bucket, hit.blocks),
+                jnp.int32(p))
+            # The gather out of the pool is dispatched (device order is
+            # FIFO, so any later scatter into a since-freed block runs
+            # after this read): safe to unpin now.
             hit.release()
             sfx_arr = jnp.asarray(sfx_lens)
             temps_arr = jnp.asarray(temps)
@@ -894,34 +992,52 @@ class InferenceEngine:
                 self.state, prefix, jnp.asarray(slots_arr),
                 jnp.asarray(full_lens), toks, temps_arr, top_ps_arr,
                 top_ks_arr, decode_keys_arr)
+            # The finished rows hold prefix + suffix KV: extend the tree
+            # with the new tail blocks BEFORE the buffer goes back to
+            # the scratch pool — this is what makes turn N+1 of a
+            # session hit at its FULL history instead of re-prefilling
+            # the part turn N added.
+            self._maybe_store_prefix(assignments[:n_req], prefix)
             self._store_prefill_scratch(batch, bucket, prefix)
-            self.prefix_store.note_reuse(n_req, p)
+            self.prefix_index.note_reuse(n_req, p)
             host_toks = np.asarray(toks)
             return [int(host_toks[i]) for i in range(n_req)]
         finally:
             hit.release()
 
     def _maybe_store_prefix(self, assignments, prefix) -> None:
-        """Adopt ONE newly-built prefix from a prefill batch into the
-        store (at most one extract dispatch per admission dispatch, so
-        cache population cannot balloon admission latency). The entry is
-        the first row whose aligned prefix is new; unique-prompt traffic
-        churns through LRU eviction, shared-prefix traffic converges
-        after a single store."""
-        A = self.prefix_align
+        """Store ONE newly-built prefix from a prefill batch into the
+        pool (at most one extract + one scatter dispatch per admission
+        dispatch, so cache population cannot balloon admission latency).
+        The stored row is the first whose whole-block prefix has an
+        unresident tail; only the NEW blocks are scattered — blocks the
+        radix tree already holds stay shared by reference, and their
+        scatter lanes point at the trash block."""
+        PB = self.prefix_block
         for row, (_slot, ids, _sampling) in enumerate(assignments):
-            p = A * (len(ids) // A)
-            if p < A or self.prefix_store.has(ids[:p]):
+            p = PB * (len(ids) // PB)
+            if p < PB:
                 continue
-            entry_cache = self._extract_prefix_row(prefix, jnp.int32(row),
-                                                   jnp.int32(p))
-            nbytes = sum(x.nbytes for x in jax.tree.leaves(entry_cache))
-            self.prefix_store.insert(ids[:p], entry_cache, nbytes)
+            plan = self.prefix_index.plan_insert(ids[:p])
+            if plan is None:
+                continue  # fully resident, or rejected even after LRU
+            row_cache = self._extract_prefix_row(prefix, jnp.int32(row),
+                                                 jnp.int32(p))
+            try:
+                bucket = row_cache.k.shape[2]
+                lane0 = plan.matched_len // PB
+                self._pool_kv = self._write_blocks(
+                    self._pool_kv, row_cache,
+                    self._bucket_ids(bucket, plan.new_ids, at=lane0))
+            except Exception:
+                plan.abort()
+                raise
+            plan.commit()
             return
 
     def prefix_cache_stats(self) -> dict | None:
-        return (self.prefix_store.stats()
-                if self.prefix_store is not None else None)
+        return (self.prefix_index.stats()
+                if self.prefix_index is not None else None)
 
     # ------------------------------------------------------------------
     # Disaggregated prefill/decode (engine side; wire format and broker
@@ -971,73 +1087,119 @@ class InferenceEngine:
 
     def adopt_prefix(self, handoff) -> bool:
         """Decode-tier adoption: a deserialized KV handoff (engine/
-        disagg/frames.py KVHandoff) becomes a prefix-store entry, so the
-        migrated request admits through the ordinary cached path — ONE
-        seed copy + ONE suffix dispatch, the same programs a local
-        prefix hit uses (zero-copy where layouts match: the frame's
-        buffers go to the device once and become the entry directly).
+        disagg/frames.py KVHandoff, block-manifest format) lands in the
+        radix tree, so the migrated request admits through the ordinary
+        cached path — ONE block gather + ONE suffix dispatch, the same
+        programs a local prefix hit uses.
 
-        Returns True when the entry landed (or an identical one already
-        covers it), False when the store rejected it (budget) — the
-        request then admits through a full prefill, which is slower but
-        still token-identical for greedy. Structural mismatches between
-        the frame and THIS engine's model/cache geometry raise: adopting
-        wrong-shaped or wrong-dtype KV would stream garbage."""
-        if self.prefix_store is None:
+        The frame carries per-block payloads plus a digest manifest;
+        blocks the sender skipped (already shipped once) OR that this
+        tree already holds adopt BY REFERENCE — only genuinely new
+        blocks are assembled into one bucket-padded row and scattered
+        into the pool in a single dispatch. The adopted prefix is the
+        longest leading run of resident-or-shipped blocks (a skipped
+        block this tier has since evicted just shortens the run — the
+        request re-prefills a longer suffix, always causally sound).
+
+        Returns True when a non-empty prefix is (or already was)
+        resident, False when nothing could be adopted (routing-only
+        frame, pool rejection) — the request then admits through a full
+        prefill, which is slower but still token-identical for greedy.
+        Structural mismatches between the frame and THIS engine's
+        model/cache geometry raise: adopting wrong-shaped or
+        wrong-dtype KV would stream garbage."""
+        if self.prefix_index is None:
             raise EngineError("adopt_prefix requires the prefix cache "
                               "(role: decode builds it by contract)")
         p = int(handoff.p)
         if p <= 0:
             return False  # routing-only handoff: nothing to adopt
-        A = self.prefix_align
-        if p % A:
-            raise EngineError(f"handoff prefix length {p} is not aligned "
-                              f"to {A}")
+        PB = self.prefix_block
+        bs = int(handoff.block_size)
+        if p % bs:
+            raise EngineError(f"handoff prefix length {p} is not a "
+                              f"multiple of its block size {bs}")
         if bool(handoff.kv_quant) != bool(self.kv_quant):
             raise EngineError(
                 f"handoff KV quantization ({handoff.kv_quant}) disagrees "
                 f"with this engine ({self.kv_quant}) — tiers must share "
                 f"the cache layout")
         c = self.config
-        k = handoff.arrays["k"]
-        v = handoff.arrays["v"]
-        want = (c.num_layers, 1, p, c.num_kv_heads, c.dim_per_head)
-        if k.shape != want or v.shape != want:
-            raise EngineError(
-                f"handoff KV shape {k.shape} does not match this model "
-                f"({want})")
+        want = (c.num_layers, 1, bs, c.num_kv_heads, c.dim_per_head)
         want_dtype = np.dtype(np.int8 if self.kv_quant
                               else self.cache_dtype)
-        if k.dtype != want_dtype or v.dtype != want_dtype:
-            raise EngineError(
-                f"handoff KV dtype {k.dtype} does not match this "
-                f"engine's cache dtype {want_dtype}")
+        for j, planes in handoff.blocks.items():
+            k, v = planes["k"], planes["v"]
+            if k.shape != want or v.shape != want:
+                raise EngineError(
+                    f"handoff block {j} KV shape {k.shape} does not "
+                    f"match this model ({want})")
+            if k.dtype != want_dtype or v.dtype != want_dtype:
+                raise EngineError(
+                    f"handoff block {j} KV dtype {k.dtype} does not "
+                    f"match this engine's cache dtype {want_dtype}")
         tokens = tuple(int(t) for t in handoff.tokens[:p])
-        if self.prefix_store.has(tokens):
-            return True  # e.g. a later turn of the same session
-        # Pad to the smallest prefill bucket that holds p: entries at
-        # bucket capacities are exactly the shapes the prefix-cache
-        # warmup compiled seed copies for — an adopted entry must never
-        # trigger a mid-traffic XLA compile.
-        capacity = self.bucket_for(p)
-
-        def pad_to(arr: np.ndarray, axis: int) -> jnp.ndarray:
-            if arr.shape[axis] < capacity:
-                widths = [(0, 0)] * arr.ndim
-                widths[axis] = (0, capacity - arr.shape[axis])
-                arr = np.pad(arr, widths)
-            return jnp.asarray(arr)
-
-        cache = KVCache(
-            k=pad_to(k, 2), v=pad_to(v, 2),
-            lengths=jnp.full((1,), p, jnp.int32),
-            k_scale=(pad_to(handoff.arrays["k_scale"], 3)
-                     if self.kv_quant else None),
-            v_scale=(pad_to(handoff.arrays["v_scale"], 3)
-                     if self.kv_quant else None),
+        # Leading coverage: resident tree blocks first, then contiguous
+        # shipped frame blocks. A hole (skipped-and-evicted) ends it.
+        cov = self.prefix_index.match_len(tokens)
+        for j in range(p // bs):
+            lo, hi = j * bs, (j + 1) * bs
+            if hi <= cov:
+                continue
+            if lo > cov or j not in handoff.blocks:
+                break
+            cov = hi
+        p_eff = PB * (min(cov, p) // PB)
+        if p_eff <= 0:
+            return False
+        plan = self.prefix_index.plan_insert(tokens[:p_eff])
+        if plan is None:
+            # Fully resident (adoption by reference — the sender skipped
+            # everything and this tree still holds it), or the pool
+            # rejected the tail even after eviction.
+            return self.prefix_index.match_len(tokens[:p_eff]) >= p_eff
+        # Assemble the new tail into one bucket-padded batch-1 row and
+        # scatter it in ONE dispatch — the same per-bucket program the
+        # local store path compiled, so adoption never triggers a
+        # mid-traffic XLA compile.
+        capacity = self.bucket_for(p_eff)
+        m = plan.matched_len
+        k_row = np.zeros((c.num_layers, 1, capacity, c.num_kv_heads,
+                          c.dim_per_head), want_dtype)
+        v_row = np.zeros_like(k_row)
+        ks_row = vs_row = None
+        if self.kv_quant:
+            ks_row = np.zeros((c.num_layers, 1, c.num_kv_heads, capacity),
+                              np.float32)
+            vs_row = np.zeros_like(ks_row)
+        for j, planes in handoff.blocks.items():
+            lo, hi = j * bs, (j + 1) * bs
+            if hi <= m or lo >= p_eff:
+                continue  # resident already, or past the adopted run
+            # A frame block may straddle p_eff when the sender's block
+            # size is not a multiple of this pool's (the floored tail):
+            # clip to the adopted run — the row is only capacity wide.
+            w = min(hi, p_eff) - lo
+            k_row[:, :, lo:lo + w] = planes["k"][:, :, :w]
+            v_row[:, :, lo:lo + w] = planes["v"][:, :, :w]
+            if self.kv_quant:
+                ks_row[:, :, :, lo:lo + w] = planes["k_scale"][:, :, :, :w]
+                vs_row[:, :, :, lo:lo + w] = planes["v_scale"][:, :, :, :w]
+        row = KVCache(
+            k=jnp.asarray(k_row), v=jnp.asarray(v_row),
+            lengths=jnp.full((1,), p_eff, jnp.int32),
+            k_scale=jnp.asarray(ks_row) if self.kv_quant else None,
+            v_scale=jnp.asarray(vs_row) if self.kv_quant else None,
         )
-        nbytes = sum(x.nbytes for x in jax.tree.leaves(cache))
-        return self.prefix_store.insert(tokens, cache, nbytes)
+        try:
+            self._pool_kv = self._write_blocks(
+                self._pool_kv, row,
+                self._bucket_ids(capacity, plan.new_ids, at=m // PB))
+        except Exception:
+            plan.abort()
+            raise
+        plan.commit()
+        return True
 
     # ------------------------------------------------------------------
     # Chunked prefill (long prompts, interleaved with decode blocks)
@@ -1050,7 +1212,7 @@ class InferenceEngine:
 
     def start_chunked_prefill(self, slot: int, prompt_ids: list[int],
                               sampling: SamplingParams,
-                              hit: PrefixHit | None = None) -> ChunkedPrefill:
+                              hit: RadixHit | None = None) -> ChunkedPrefill:
         """Begin a chunked prefill for `slot`; drive it to completion with
         advance_chunked_prefill (one device dispatch per call). With a
         prefix-cache `hit`, the cache is seeded from the cached entry and
@@ -1070,7 +1232,7 @@ class InferenceEngine:
                 start = hit.length
                 if not 0 < start < true_len:
                     raise EngineError("cached prefix does not fit prompt")
-                if tuple(prompt_ids[:start]) != hit.entry.tokens[:start]:
+                if tuple(prompt_ids[:start]) != hit.tokens:
                     raise EngineError("prompt diverges from cached prefix")
             sfx_len = true_len - start
             n_chunks = -(-sfx_len // C)
@@ -1081,12 +1243,13 @@ class InferenceEngine:
 
             cache = self._new_prefix_cache(bucket)
             if hit is not None:
-                cache = self._insert_from_prefix(cache, hit.entry.cache,
-                                                 jnp.int32(start))
-                hit.release()  # copy dispatched; entry free to evict
-                self.prefix_store.note_reuse(1, start)
-            elif self.prefix_store is not None:
-                self.prefix_store.note_miss(1)  # admitted uncached
+                cache = self._insert_from_blocks(
+                    cache, self._pool_kv,
+                    self._bucket_ids(bucket, hit.blocks), jnp.int32(start))
+                hit.release()  # gather dispatched; blocks free to evict
+                self.prefix_index.note_reuse(1, start)
+            elif self.prefix_index is not None:
+                self.prefix_index.note_miss(1)  # admitted uncached
             return ChunkedPrefill(
                 slot=slot, ids=padded, true_len=true_len, n_chunks=n_chunks,
                 cache=cache,
@@ -1127,16 +1290,28 @@ class InferenceEngine:
             self.state, cache, jnp.asarray([job.slot], jnp.int32),
             jnp.asarray([job.true_len], jnp.int32), toks,
             job.temp, job.top_p, job.top_k, job.decode_key)
-        # The finished buffer holds the FULL prompt's KV and would
-        # otherwise be dropped — adopt it into the prefix store for free
-        # (zero copy: insert_all only read it). Completed chunked
-        # prefills are exactly the long shared preambles worth caching.
-        if self.prefix_store is not None and job.full_ids:
-            A = self.prefix_align
-            p = A * (job.true_len // A)
-            if p >= A and not self.prefix_store.has(job.full_ids[:p]):
-                nbytes = sum(x.nbytes for x in jax.tree.leaves(cache))
-                self.prefix_store.insert(job.full_ids[:p], cache, nbytes)
+        # The finished buffer holds the FULL prompt's KV — scatter its
+        # unresident whole blocks into the pool before it is dropped.
+        # Completed chunked prefills are exactly the long shared
+        # preambles worth caching, and only the NEW tail is written:
+        # blocks the tree already holds (e.g. the seed prefix of a
+        # seeded job) stay shared by reference.
+        if self.prefix_index is not None and job.full_ids:
+            PB = self.prefix_block
+            p = PB * (job.true_len // PB)
+            plan = (self.prefix_index.plan_insert(job.full_ids[:p])
+                    if p >= PB else None)
+            if plan is not None:
+                try:
+                    bucket = cache.k.shape[2]
+                    self._pool_kv = self._write_blocks(
+                        self._pool_kv, cache,
+                        self._bucket_ids(bucket, plan.new_ids,
+                                         at=plan.matched_len // PB))
+                except Exception:
+                    plan.abort()
+                    raise
+                plan.commit()
         return int(np.asarray(toks)[0])
 
     def _new_prefix_cache(self, capacity: int, batch: int = 1):
@@ -1308,29 +1483,31 @@ class InferenceEngine:
                     bucket, self.max_seq_len)).lengths)
 
         # Prefix-cache hit-path programs (only when the cache is on —
-        # budget 0 keeps warmup exactly as before): per (batch, bucket),
-        # the row extract (store path), the seed copy from an entry at
-        # EVERY possible entry capacity (entries keep the bucket they
-        # were built at, and a prefix built at one bucket may serve
-        # prompts in another), and the batched suffix continuation at the
-        # prefix_align shape. A hit burst mid-traffic must never pay a
-        # fresh XLA compile — the exact stall the cache exists to remove.
-        if self.prefix_store is not None:
+        # budget 0 keeps warmup exactly as before): per bucket the block
+        # scatter (store/adopt path), per (batch, bucket) the row
+        # extract (store path), the block-gather seed, and the batched
+        # suffix continuation at the prefix_align shape. A hit burst
+        # mid-traffic must never pay a fresh XLA compile — the exact
+        # stall the cache exists to remove. (The old aligned store
+        # needed a seed-copy variant per entry CAPACITY on top of the
+        # grid; pool blocks are all one shape, so that whole compile
+        # dimension is gone.)
+        if self.prefix_index is not None:
             A = self.prefix_align
-            entries = {}
-            for ts in self.prefill_buckets:
-                s = self._prefill_scratch_for(1, ts)
-                entries[ts] = self._extract_prefix_row(s, jnp.int32(0),
-                                                       jnp.int32(0))
-                self._store_prefill_scratch(1, ts, s)
+            for bucket in self.prefill_buckets:
+                # All lanes at the trash block: the scatter compiles and
+                # runs, and the garbage lands where nobody reads.
+                row = self._new_prefix_cache(bucket)
+                self._pool_kv = self._write_blocks(
+                    self._pool_kv, row, self._bucket_ids(bucket))
             for bucket in self.prefill_buckets:
                 for batch in self.prefill_batches_for(bucket):
                     scratch = self._prefill_scratch_for(batch, bucket)
                     self._extract_prefix_row(scratch, jnp.int32(0),
                                              jnp.int32(0))
-                    for dummy in entries.values():
-                        scratch = self._insert_from_prefix(scratch, dummy,
-                                                           jnp.int32(0))
+                    scratch = self._insert_from_blocks(
+                        scratch, self._pool_kv, self._bucket_ids(bucket),
+                        jnp.int32(0))
                     toks, prefix = self._chunk_final(
                         self.params, jnp.zeros((batch, A), jnp.int32),
                         scratch, jnp.ones((batch,), jnp.int32),
@@ -1413,8 +1590,8 @@ class InferenceEngine:
         (tests assert zero steady-state recompiles against this)."""
         out: dict[str, int] = {}
         for name in ("_prefill", "_decode", "_verify", "_chunk_step",
-                     "_chunk_final", "_insert_all", "_insert_from_prefix",
-                     "_extract_prefix_row"):
+                     "_chunk_final", "_insert_all", "_insert_from_blocks",
+                     "_write_blocks", "_extract_prefix_row"):
             fn = getattr(self, name, None)
             if fn is not None and hasattr(fn, "_cache_size"):
                 out[name] = fn._cache_size()
@@ -1543,6 +1720,8 @@ class InferenceEngine:
                                          None),
             prefix_cache_bytes=int(
                 (getattr(tpu_cfg, "prefix_cache_mb", None) or 0) * 2**20),
+            prefix_block_tokens=int(
+                getattr(tpu_cfg, "prefix_block_tokens", None) or 16),
             speculative=SpecConfig.from_knob(
                 getattr(tpu_cfg, "speculative", None)),
             fused_dequant=bool(getattr(tpu_cfg, "fused_dequant", False)),

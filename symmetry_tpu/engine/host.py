@@ -154,7 +154,33 @@ class EngineHost:
         self._role = (getattr(config.tpu, "role", "unified") or "unified"
                       if config is not None else "unified")
         self.handoff_stats = {"frames": 0, "bytes": 0, "prefix_tokens": 0,
-                              "routing_only": 0, "serialize_s": 0.0}
+                              "routing_only": 0, "serialize_s": 0.0,
+                              # Block-manifest accounting (frames v2):
+                              # blocks covered by emitted manifests vs
+                              # blocks whose payload actually shipped —
+                              # the gap is the incremental-handoff win
+                              # (asserted by the disagg smoke's
+                              # warm-handoff leg).
+                              "blocks": 0, "blocks_shipped": 0}
+        # Digests of blocks already shipped from this prefill host (LRU-
+        # bounded). A block in the ledger is OMITTED from later frames:
+        # the decode tier adopts it by reference from its radix tree, or
+        # — if it evicted the block since — shortens the adopted prefix
+        # and re-prefills a longer suffix (correct either way; the
+        # ledger is a bytes optimization, never a correctness input).
+        # Gated by tpu.handoff_ledger: the tpu_native LOCAL PAIR enables
+        # it (both hosts respawn as one unit, so the ledger cannot
+        # outlive the receiver's tree); pool mode (N decode members) and
+        # network mode (decode respawns independently of the remote
+        # prefill node) leave it off — a stale ledger would silently
+        # degrade every warm handoff to a full re-prefill.
+        from collections import OrderedDict
+
+        self._ledger_on = bool(getattr(config.tpu, "handoff_ledger",
+                                       False)) if config is not None \
+            else False
+        self._shipped: OrderedDict[str, None] = OrderedDict()
+        self._shipped_cap = 65536
         self.adopt_stats = {"frames": 0, "bytes": 0, "adopted": 0,
                             "rejected": 0, "errors": 0,
                             "deserialize_s": 0.0}
@@ -433,9 +459,9 @@ class EngineHost:
                          "error": f"tokenization failed: {exc}"}, events=1)
             return
         if self._role == "prefill":
-            align = self._engine.prefix_align or 0
-            if align and (len(prompt_ids) - 1) // align == 0:
-                # Short-prompt fast path: no aligned prefix can be
+            pb = self._engine.prefix_block or 0
+            if pb and (len(prompt_ids) - 1) // pb == 0:
+                # Short-prompt fast path: no whole-block prefix can be
                 # handed off, so running the prefill HERE would only
                 # duplicate the decode tier's suffix dispatch. Route the
                 # tokens straight through as a routing-only frame — the
@@ -475,23 +501,23 @@ class EngineHost:
 
     def _handoff_sink(self, slot: int, req: Any, first: int) -> None:
         """Prefill-role scheduler terminal (runs on the engine thread):
-        snapshot the slot lane's KV through the aligned prefix length,
-        serialize, and emit the handoff frame. By return the lane is
-        free — the np.asarray below syncs the extract before the
-        scheduler can reuse the slot."""
+        snapshot the slot lane's KV through the whole-block prefix
+        length, serialize it blockwise, and emit the handoff frame. By
+        return the lane is free — the np.asarray below syncs the
+        extract before the scheduler can reuse the slot."""
         import numpy as np
 
         t0 = time.monotonic()
         n = len(req.prompt_ids)
-        align = self._engine.prefix_align or 0
-        p = align * ((n - 1) // align) if align else 0
+        pb = self._engine.prefix_block or 0
+        p = pb * ((n - 1) // pb) if pb else 0
         if p > 0:
-            # Pipe-transport bound: cap to the largest aligned prefix
-            # whose frame fits the broker's line limit (see
+            # Pipe-transport bound: cap to the largest whole-block
+            # prefix whose frame fits the broker's line limit (see
             # HANDOFF_MAX_KV_BYTES). Shorter-than-built prefixes are
             # causally sound; the decode tier pays a longer suffix.
-            max_p = align * (HANDOFF_MAX_KV_BYTES
-                             // self._engine.kv_bytes_per_token() // align)
+            max_p = pb * (HANDOFF_MAX_KV_BYTES
+                          // self._engine.kv_bytes_per_token() // pb)
             p = min(p, max_p)
         arrays = None
         if p > 0:
@@ -510,6 +536,7 @@ class EngineHost:
     def _emit_handoff(self, req_id: str, prompt_ids: list[int], p: int,
                       arrays: Any, t0: float | None = None) -> None:
         from symmetry_tpu.engine.disagg import encode_kv_handoff
+        from symmetry_tpu.engine.prefix_cache import block_digests
 
         if t0 is None:
             t0 = time.monotonic()
@@ -519,12 +546,27 @@ class EngineHost:
         # silently vanishes (watchdog territory).
         if FAULTS.enabled and FAULTS.point("disagg.handoff"):
             return
+        pb = self._engine.prefix_block or 0
+        skip: list[int] = []
+        digests: list[str] = []
+        if p > 0 and pb and self._ledger_on:
+            # Incremental handoff: blocks whose digest this host already
+            # shipped are omitted from the payload (manifest-only). The
+            # ledger mutates under _wlock — this method runs on the
+            # engine thread AND the pipe-reader thread (fast path).
+            digests = block_digests(prompt_ids, p, pb)
+            with self._wlock:
+                skip = [j for j, d in enumerate(digests)
+                        if d in self._shipped]
         frame = encode_kv_handoff(req_id, prompt_ids, p, arrays,
-                                  kv_quant=self._engine.kv_quant)
+                                  kv_quant=self._engine.kv_quant,
+                                  block_size=pb, skip=skip,
+                                  digests=digests if digests else None)
         import base64
 
         b64 = base64.b64encode(frame).decode("ascii")
         dt = time.monotonic() - t0
+        n_blocks = p // pb if (p and pb) else 0
         # Under _wlock: this method runs on the ENGINE thread via the
         # scheduler's handoff sink AND on the pipe-reader thread via the
         # short-prompt fast path in _submit — unlocked `dict[k] += 1`
@@ -533,9 +575,16 @@ class EngineHost:
             self.handoff_stats["frames"] += 1
             self.handoff_stats["bytes"] += len(frame)
             self.handoff_stats["prefix_tokens"] += p
+            self.handoff_stats["blocks"] += n_blocks
+            self.handoff_stats["blocks_shipped"] += n_blocks - len(skip)
             if p == 0:
                 self.handoff_stats["routing_only"] += 1
             self.handoff_stats["serialize_s"] += dt
+            for d in digests:
+                self._shipped.pop(d, None)
+                self._shipped[d] = None  # most-recently-shipped last
+            while len(self._shipped) > self._shipped_cap:
+                self._shipped.popitem(last=False)
         self._m_handoff_frames.inc()
         self._m_handoff_bytes.inc(len(frame))
         self._m_handoff_serialize.observe(dt)
@@ -551,6 +600,7 @@ class EngineHost:
         self._write({"op": HostOp.HANDOFF, "id": req_id, "p": p,
                      "prompt_len": len(prompt_ids),
                      "nbytes": len(frame), "frame": b64,
+                     "blocks": n_blocks, "shipped": n_blocks - len(skip),
                      "t": round(time.monotonic(), 4)})
 
     def _handle_adopt(self, msg: dict) -> None:

@@ -1,199 +1,507 @@
-"""Shared-prefix KV store: prefill each common prefix once, admit many.
+"""Radix-tree prefix cache over a paged KV block pool.
 
-Realistic serving traffic overwhelmingly shares prompt prefixes — system
-prompts, few-shot preambles, multi-turn history — yet every request used
-to pay a full prefill. This module is the host-side bookkeeping for
-automatic prefix reuse (the engine owns the device work): an HBM-budgeted
-LRU of batch-1 prefix `KVCache` buffers, keyed by the token content of
-ALIGNED prompt prefixes, in the spirit of vLLM's automatic prefix caching
-and SGLang's RadixAttention but shaped for this engine's static-bucket
-world.
+Every KV-reuse path in the engine — local shared-prefix admission,
+multi-turn session history, disagg handoff adoption — lands here. The
+previous design (an aligned-bucket LRU of monolithic batch-1 slabs)
+could only hit on `prefix_align` boundaries and paid a slab copy for
+every insert/evict/handoff. This rebuild follows the literature the
+repo tracks in PAPERS.md:
+
+  - RadixAttention (SGLang): a radix tree over token sequences makes
+    EVERY shared prefix reusable — multi-turn histories of arbitrary
+    length, agent trees, shared system prompts — not just the ones that
+    happen to end on an alignment boundary.
+  - PagedAttention (vLLM): KV lives in fixed-size blocks drawn from a
+    fixed pool, so cache membership is pointer arithmetic: insert is a
+    scatter of NEW blocks only, adoption of already-resident content is
+    a refcount bump, and eviction frees block ids without touching HBM.
+
+Split of responsibilities: this module is pure host-side bookkeeping
+(block ids, refcounts, the tree) with no JAX dependency — the engine
+owns the device-side pool array (`[L, n_blocks, block_tokens, K, D]`)
+and the two compiled programs that move KV in and out of it
+(`insert_from_blocks` gather-seed, `write_blocks` scatter-store). The
+pool's shapes are FIXED at construction: a fixed block size, a fixed
+block count, index vectors padded to each bucket's block count — zero
+steady-state recompiles (symlint R3 guards the programs themselves).
 
 Design points:
 
-  - Alignment. Prefixes are stored and matched only at multiples of the
-    engine's `prefix_align` (min(prefill_chunk, smallest bucket)): the
-    hit path runs the uncached suffix through ONE fixed-shape
-    continuation dispatch, so the suffix must fit a compiled shape. A
-    stored entry of aligned length P serves a hit at ANY aligned p <= P
-    — KV at position i depends only on tokens <= i (causal), so the
-    first p positions of a longer prefix ARE the shorter prefix's KV.
-    The index therefore maps every aligned boundary of every entry.
-
-  - Keys are digests of the prefix token bytes; a hit re-verifies the
-    actual tokens against the entry (collisions must produce a miss,
-    never silently wrong KV).
-
-  - Strictly-partial matches only: lookup never returns p == len(prompt).
-    The suffix (>= 1 token) is what produces the first sampled token —
-    the continuation dispatch projects the last valid position and
-    samples, so a "full" hit would still need a forward call; always
-    leaving >= 1 suffix token keeps one uniform hit path.
-
-  - Budget + LRU + pins. Entries are evicted least-recently-used when a
-    new insert would exceed the byte budget; an entry is PINNED from
-    lookup until the engine has dispatched the copy out of it, and
-    pinned entries are never evicted (the budget must not claim back HBM
-    that a copy in flight still reads).
+  - Match granularity is ONE BLOCK (`block_tokens`, default 16), not
+    one bucket: lookup walks the tree in whole blocks and returns the
+    longest block-aligned strict prefix with resident KV. Strictly
+    partial only — the suffix (>= 1 token) produces the first sampled
+    token, same contract as before.
+  - Nodes own block lists; children are keyed by their edge's first
+    block (siblings always diverge within their first block — insert
+    splits edges at block boundaries to keep that invariant).
+  - Eviction is leaf-LRU and frees blocks, never copies: the
+    least-recently-touched leaf whose blocks are unpinned is detached
+    and its block ids returned to the free list. Interior nodes become
+    evictable once their children go.
+  - Pins are per-block refcounts. A block's refcount is 1 while only
+    the tree owns it; a `RadixHit` holds +1 on every matched block
+    until `release()` (the engine releases once the seed gather out of
+    the pool is dispatched). Blocks with refcount > 1 are never freed.
+  - Insert is two-phase: `plan_insert` allocates block ids for the
+    UNCOVERED tail (evicting leaf-LRU as needed) without touching the
+    tree; the engine scatters KV into those blocks on device and then
+    `commit()`s the plan (or `abort()`s on a failed dispatch, returning
+    the ids). The tree therefore never references a block whose KV
+    write was not dispatched.
 
 Thread contract: all mutating calls happen on the scheduler's engine
-thread (same as the engine itself). stats() may be read cross-thread —
-it snapshots plain ints under the GIL, same discipline as the
-scheduler's metrics dict.
+thread. stats() may be read cross-thread — it snapshots plain ints
+under the GIL, the same discipline as the scheduler's metrics dict
+(no tree walks, no dict iteration over mutable containers).
 """
 
 from __future__ import annotations
 
 import hashlib
-from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any
 
+from symmetry_tpu.utils.metrics import METRICS, MetricName
 
-def _digest(token_bytes: bytes) -> bytes:
+
+def prefix_digest(token_bytes: bytes) -> bytes:
+    """Content digest used for block manifests (handoff frames) and the
+    prefill tier's shipped-block ledger. A block's KV depends on EVERY
+    token at or before it (causal attention), so block j's digest
+    covers tokens[: (j+1) * block_tokens] — two blocks share a digest
+    iff their full causal context matches."""
     return hashlib.blake2b(token_bytes, digest_size=16).digest()
 
 
-@dataclass
-class PrefixEntry:
-    """One cached prefix: batch-1 KV buffer + the tokens it encodes."""
+def token_bytes(tokens) -> bytes:
+    import numpy as np
 
-    tokens: tuple[int, ...]   # the full stored prefix (aligned length)
-    cache: Any                # batch-1 KVCache, capacity = build bucket
-    nbytes: int
-    pins: int = 0
+    return np.asarray(tokens, dtype=np.int32).tobytes()
+
+
+def block_digests(tokens, p: int, block_tokens: int) -> list[str]:
+    """Hex digests for the p // block_tokens blocks covering
+    tokens[:p], each over its full causal context (see prefix_digest).
+    One running hash, copied per block — O(p) total, not O(p^2)."""
+    if block_tokens < 1 or p % block_tokens:
+        raise ValueError(
+            f"prefix length {p} is not a multiple of block size "
+            f"{block_tokens}")
+    buf = token_bytes(tokens[:p])
+    step = block_tokens * 4  # int32 tokens
+    h = hashlib.blake2b(digest_size=16)
+    out: list[str] = []
+    for j in range(p // block_tokens):
+        h.update(buf[j * step: (j + 1) * step])
+        out.append(h.copy().digest().hex())
+    return out
+
+
+class BlockPool:
+    """Refcounted free list over a fixed set of KV block ids.
+
+    Block id 0 is the TRASH block: scatter dispatches are padded to each
+    bucket's full block count, and every pad lane writes to the trash
+    block, whose content nobody ever reads. It is never allocated.
+    Ids 1..n_blocks are the allocatable pool."""
+
+    TRASH = 0
+
+    def __init__(self, n_blocks: int, block_tokens: int,
+                 block_bytes: int) -> None:
+        if n_blocks < 1:
+            raise ValueError("block pool needs at least one block")
+        if block_tokens < 1:
+            raise ValueError("block size must be >= 1 token")
+        self.n_blocks = int(n_blocks)
+        self.block_tokens = int(block_tokens)
+        self.block_bytes = int(block_bytes)
+        # refcount per id (index 0 = trash, never allocated): 0 = free,
+        # 1 = tree-owned, > 1 = tree-owned and pinned by hits in flight.
+        self._refs = [0] * (self.n_blocks + 1)
+        self._free = list(range(self.n_blocks, 0, -1))  # pop() -> 1 first
+        self._in_use = 0
+        self._pinned = 0          # blocks with refs > 1
+        self._high_water = 0      # peak blocks in use (bytes via property)
+        self._m_in_use = METRICS.gauge(
+            MetricName.PREFIX_BLOCKS_IN_USE,
+            "KV blocks currently owned by the radix prefix cache")
+
+    # ------------------------------------------------------------ queries
 
     @property
-    def length(self) -> int:
-        return len(self.tokens)
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self._in_use
+
+    @property
+    def pinned(self) -> int:
+        return self._pinned
+
+    @property
+    def bytes_in_use(self) -> int:
+        return self._in_use * self.block_bytes
+
+    @property
+    def budget_bytes(self) -> int:
+        return self.n_blocks * self.block_bytes
+
+    @property
+    def hbm_high_water_bytes(self) -> int:
+        """Peak pool occupancy in bytes — the per-session memory-economics
+        number ROADMAP item 3 asks the bench to report. (The device pool
+        array itself is allocated once at construction; this tracks how
+        much of it the cache has ever actually owned.)"""
+        return self._high_water * self.block_bytes
+
+    def refcount(self, block_id: int) -> int:
+        return self._refs[block_id]
+
+    # ----------------------------------------------------------- mutation
+
+    def alloc(self, n: int) -> list[int] | None:
+        """Allocate `n` blocks at refcount 1, or None (all-or-nothing)
+        when the free list is short — the caller evicts and retries."""
+        if n < 0:
+            raise ValueError("alloc of negative block count")
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        for i in ids:
+            self._refs[i] = 1
+        self._in_use += n
+        self._high_water = max(self._high_water, self._in_use)
+        self._m_in_use.set(self._in_use)
+        return ids
+
+    def ref(self, ids) -> None:
+        for i in ids:
+            if self._refs[i] < 1:
+                raise RuntimeError(f"ref of free block {i}")
+            self._refs[i] += 1
+            if self._refs[i] == 2:
+                self._pinned += 1
+
+    def unref(self, ids) -> None:
+        """Drop one reference per id; a block reaching refcount 0 goes
+        back to the free list."""
+        for i in ids:
+            r = self._refs[i] - 1
+            if r < 0:
+                raise RuntimeError(f"unref of free block {i}")
+            self._refs[i] = r
+            if r == 1:
+                self._pinned -= 1
+            elif r == 0:
+                self._in_use -= 1
+                self._free.append(i)
+        self._m_in_use.set(self._in_use)
+
+
+class RadixNode:
+    """One tree node: an edge of whole blocks from its parent."""
+
+    __slots__ = ("tokens", "blocks", "children", "parent", "last_used")
+
+    def __init__(self, tokens: tuple[int, ...], blocks: list[int],
+                 parent: "RadixNode | None") -> None:
+        self.tokens = tokens          # edge label; len == len(blocks)*BS
+        self.blocks = blocks          # pool ids, one per edge block
+        self.children: dict[tuple[int, ...], RadixNode] = {}
+        self.parent = parent
+        self.last_used = 0
 
 
 @dataclass
-class PrefixHit:
-    """A pinned lookup result: `entry.cache[:, :, :length]` is the KV of
-    `prompt[:length]`. Call release() once the copy out of the entry has
-    been dispatched (idempotent — safe to call from cleanup paths)."""
+class RadixHit:
+    """A pinned lookup result: `blocks` hold the KV of
+    `prompt[:length]`, in order. Call release() once the gather out of
+    the pool has been dispatched (idempotent — safe from cleanup
+    paths). `group_key` partitions scheduler admissions: requests with
+    equal (node, matched_len) share one seed dispatch."""
 
-    entry: PrefixEntry
-    length: int               # aligned tokens usable for THIS prompt
-    _store: "PrefixStore | None" = field(repr=False, default=None)
+    node: RadixNode
+    length: int                    # matched tokens (multiple of block size)
+    blocks: tuple[int, ...]
+    tokens: tuple[int, ...]        # the matched prefix itself
+    _index: "RadixIndex | None" = field(repr=False, default=None)
     _released: bool = False
 
     @property
     def group_key(self) -> tuple[int, int]:
-        """Requests with equal group_key can share one seed dispatch."""
-        return (id(self.entry), self.length)
+        return (id(self.node), self.length)
 
     def release(self) -> None:
         if not self._released:
             self._released = True
-            self._store._unpin(self.entry)
+            self._index.pool.unref(self.blocks)
 
 
-class PrefixStore:
-    """LRU store of prefix KV entries under a byte budget."""
+@dataclass
+class InsertPlan:
+    """Blocks allocated for an insert's uncovered tail; the tree learns
+    about them only at commit() — after the device scatter dispatched.
+    The plan PINS the matched prefix path for its lifetime: the
+    eviction its own allocation may trigger (and any other eviction
+    between plan and commit) must never free the blocks the new tail
+    extends."""
 
-    def __init__(self, budget_bytes: int, align: int) -> None:
-        if align < 1:
-            raise ValueError("prefix alignment must be >= 1")
-        self.budget_bytes = int(budget_bytes)
-        self.align = int(align)
-        # Full-prefix digest -> entry, most-recently-used LAST.
-        self._entries: "OrderedDict[bytes, PrefixEntry]" = OrderedDict()
-        # Boundary digest -> (entry key, boundary length). Several
-        # boundaries of one entry, and boundaries of DIFFERENT entries
-        # sharing a prefix, all land here; latest insert wins a contended
-        # boundary (both map to identical KV content, verified at hit).
-        self._index: dict[bytes, tuple[bytes, int]] = {}
+    tokens: tuple[int, ...]        # the FULL prefix being inserted
+    matched_len: int               # tokens already resident (tree-covered)
+    new_ids: list[int]             # one per new block, in prefix order
+    matched_blocks: tuple[int, ...] = ()   # pinned until commit/abort
+    _index: "RadixIndex | None" = field(repr=False, default=None)
+    _done: bool = False
+
+    def commit(self) -> None:
+        if self._done:
+            raise RuntimeError("insert plan already resolved")
+        self._done = True
+        try:
+            self._index._commit(self)
+        finally:
+            self._index.pool.unref(self.matched_blocks)
+
+    def abort(self) -> None:
+        if not self._done:
+            self._done = True
+            self._index.pool.unref(self.new_ids)
+            self._index.pool.unref(self.matched_blocks)
+
+
+class RadixIndex:
+    """The radix tree over token sequences, indexing pool blocks."""
+
+    def __init__(self, pool: BlockPool) -> None:
+        self.pool = pool
+        self.block_tokens = pool.block_tokens
+        self._root = RadixNode((), [], None)
+        self._tick = 0
+        self._n_nodes = 0
+        # Eviction candidates in LRU order (oldest first): every LEAF,
+        # keyed by id(node), re-ordered on touch. Kept incrementally so
+        # an insert-under-pressure pays O(evicted leaves), not a full
+        # tree scan per freed leaf. A node whose last child is evicted
+        # re-enters at the tail — slightly fresher than its last_used
+        # tick says, a deliberate approximation (its subtree WAS in use
+        # more recently than the tick).
+        self._leaves: "dict[int, RadixNode]" = {}
         self.stats_counters = {
             "hits": 0, "misses": 0, "insertions": 0, "evictions": 0,
-            "rejected": 0, "tokens_reused": 0,
+            "rejected": 0, "tokens_reused": 0, "nodes_evicted": 0,
         }
-        self._bytes = 0
-        # Count of entries with pins > 0, maintained incrementally: the
-        # stats() snapshot is read from the host's stdin thread while the
-        # engine thread mutates the store, so it must only copy plain
-        # ints — iterating _entries cross-thread could observe a
-        # mutation mid-iteration and kill the stats op.
-        self._pinned = 0
+        self._m_evicted = METRICS.counter(
+            MetricName.PREFIX_BLOCKS_EVICTED,
+            "KV blocks freed by leaf-LRU eviction")
+        self._m_hit_depth = METRICS.histogram(
+            MetricName.PREFIX_HIT_DEPTH,
+            "blocks matched per radix lookup hit")
 
-    # ------------------------------------------------------------- queries
+    # ------------------------------------------------------------ queries
 
     def __len__(self) -> int:
-        return len(self._entries)
+        return self._n_nodes
 
     @property
     def bytes_used(self) -> int:
-        return self._bytes
+        return self.pool.bytes_in_use
 
-    def has(self, tokens: tuple[int, ...] | list[int]) -> bool:
-        """True when an entry already covers this EXACT aligned prefix
-        (used to skip redundant store dispatches)."""
-        key = _digest(self._token_bytes(tokens))
-        hit = self._index.get(key)
-        if hit is None:
-            return False
-        entry = self._entries.get(hit[0])
-        return (entry is not None
-                and entry.tokens[:len(tokens)] == tuple(tokens))
+    def _now(self) -> int:
+        self._tick += 1
+        return self._tick
 
-    def lookup(self, prompt_ids: list[int]) -> PrefixHit | None:
-        """Longest aligned strict prefix of `prompt_ids` with cached KV,
-        pinned; None on miss. Does NOT touch the hit/miss counters: a
-        request may be looked up several times before it actually admits
-        (budget deferral re-resolves next block) or may fall back to a
-        full prefill despite a match (no compiled continuation shape) —
-        the engine counts per ADMITTED request via note_reuse/note_miss,
-        so hit_rate means 'fraction of admissions that reused cached
-        KV', the number the bench quotes."""
+    def _walk(self, tokens, limit_blocks: int,
+              touch: bool) -> tuple[RadixNode, list[int], int]:
+        """Descend from the root matching whole blocks of `tokens`, at
+        most `limit_blocks`. Returns (deepest node reached, matched
+        block ids in order, matched token count). `touch` refreshes
+        LRU recency along the path."""
+        bs = self.block_tokens
+        node = self._root
+        blocks: list[int] = []
+        pos = 0
+        now = self._now() if touch else 0
+        while len(blocks) < limit_blocks:
+            key = tuple(tokens[pos:pos + bs])
+            child = node.children.get(key)
+            if child is None:
+                break
+            nb = len(child.blocks)
+            take = 1  # the key IS the first edge block
+            while (take < nb and len(blocks) + take < limit_blocks
+                   and child.tokens[take * bs:(take + 1) * bs]
+                   == tuple(tokens[pos + take * bs:pos + (take + 1) * bs])):
+                take += 1
+            blocks.extend(child.blocks[:take])
+            pos += take * bs
+            if touch:
+                child.last_used = now
+                if not child.children:
+                    # Refresh the leaf's LRU position (dicts preserve
+                    # insertion order; re-inserting moves it to the
+                    # tail = most recently used).
+                    self._leaves.pop(id(child), None)
+                    self._leaves[id(child)] = child
+            node = child
+            if take < nb:
+                break  # diverged (or hit the limit) inside this edge
+        return node, blocks, pos
+
+    def lookup(self, prompt_ids) -> RadixHit | None:
+        """Longest block-aligned strict prefix of `prompt_ids` with
+        resident KV, pinned; None on miss. Does NOT touch the hit/miss
+        counters — the engine counts per ADMITTED request via
+        note_reuse/note_miss (a request may be looked up several times
+        before it actually admits), so hit_rate means 'fraction of
+        admissions that reused cached KV'."""
         n = len(prompt_ids)
-        a = self.align
-        # Strictly below n: the suffix dispatch must sample >= 1 token.
-        for p in range(a * ((n - 1) // a), 0, -a):
-            key = _digest(self._token_bytes(prompt_ids[:p]))
-            ref = self._index.get(key)
-            if ref is None:
-                continue
-            entry = self._entries.get(ref[0])
-            if entry is None or entry.length < p:
-                continue
-            if entry.tokens[:p] != tuple(prompt_ids[:p]):
-                continue  # digest collision — must read as a miss
-            self._entries.move_to_end(ref[0])
-            self._pin(entry)
-            return PrefixHit(entry=entry, length=p, _store=self)
-        return None
+        limit = (n - 1) // self.block_tokens  # suffix must keep >= 1 token
+        if limit <= 0:
+            return None
+        node, blocks, pos = self._walk(prompt_ids, limit, touch=True)
+        if not blocks:
+            return None
+        self.pool.ref(blocks)
+        self._m_hit_depth.observe(len(blocks))
+        return RadixHit(node=node, length=pos, blocks=tuple(blocks),
+                        tokens=tuple(prompt_ids[:pos]), _index=self)
 
-    # ------------------------------------------------------------ mutation
+    def match_len(self, tokens) -> int:
+        """Resident coverage of `tokens` in whole blocks (token count;
+        NOT capped below len(tokens) — used by insert planning and
+        adoption, where full coverage means nothing to do)."""
+        _, _, pos = self._walk(tokens, len(tokens) // self.block_tokens,
+                               touch=False)
+        return pos
 
-    def insert(self, tokens: list[int] | tuple[int, ...], cache: Any,
-               nbytes: int) -> bool:
-        """Adopt `cache` (batch-1 KV whose first len(tokens) positions
-        encode `tokens`) under the budget; evicts LRU unpinned entries to
-        make room. Returns False (and drops the buffer ref) when the
-        prefix is already stored, misaligned, or cannot fit."""
-        tokens = tuple(tokens)
-        if not tokens or len(tokens) % self.align:
-            return False
-        if self.has(tokens):
-            return False
-        while (self._bytes + nbytes > self.budget_bytes
-               and self._evict_one()):
-            pass
-        if self._bytes + nbytes > self.budget_bytes:
+    def covers(self, tokens) -> bool:
+        """True when every whole block of `tokens` is already resident
+        (used to skip redundant store dispatches)."""
+        p = (len(tokens) // self.block_tokens) * self.block_tokens
+        return p == 0 or self.match_len(tokens) >= p
+
+    # ----------------------------------------------------------- mutation
+
+    def plan_insert(self, tokens) -> InsertPlan | None:
+        """Allocate blocks for the uncovered tail of `tokens` (whose
+        length must be a whole number of blocks), evicting leaf-LRU
+        until they fit. The matched prefix path is PINNED (refcounted)
+        for the plan's lifetime — the eviction this very allocation
+        triggers must never free the blocks the tail extends. None when
+        `tokens` is fully resident, empty, or cannot fit even after
+        eviction (counted as rejected)."""
+        bs = self.block_tokens
+        p = len(tokens)
+        if p == 0 or p % bs:
+            return None
+        _node, matched, m = self._walk(tokens, p // bs, touch=True)
+        need = (p - m) // bs
+        if need == 0:
+            return None
+        self.pool.ref(matched)
+        ids = self.pool.alloc(need)
+        while ids is None and self._evict_one():
+            ids = self.pool.alloc(need)
+        if ids is None:
+            self.pool.unref(matched)
             self.stats_counters["rejected"] += 1
-            return False
-        entry = PrefixEntry(tokens=tokens, cache=cache, nbytes=int(nbytes))
-        key = _digest(self._token_bytes(tokens))
-        old = self._entries.pop(key, None)
-        if old is not None:  # same digest, different tokens (collision)
-            self._bytes -= old.nbytes
-        self._entries[key] = entry
-        self._bytes += entry.nbytes
-        for p in range(self.align, entry.length + 1, self.align):
-            self._index[_digest(self._token_bytes(tokens[:p]))] = (key, p)
+            return None
+        return InsertPlan(tokens=tuple(tokens), matched_len=m,
+                          new_ids=ids, matched_blocks=tuple(matched),
+                          _index=self)
+
+    def _commit(self, plan: InsertPlan) -> None:
+        """Attach the plan's blocks to the tree, splitting the edge at
+        the divergence boundary when needed so siblings keep diverging
+        within their first block."""
+        bs = self.block_tokens
+        tokens = plan.tokens
+        # Re-walk: the tree may have changed between plan and commit
+        # only via THIS thread (engine-thread contract), and a commit
+        # always directly follows its plan — but re-walking keeps the
+        # structure correct even if that ever changes, at negligible
+        # cost. The matched coverage is the plan's by construction.
+        node, _, pos = self._walk(tokens, plan.matched_len // bs,
+                                  touch=False)
+        if pos != plan.matched_len:
+            # The resident prefix changed between plan and commit —
+            # engine-thread contract broken. Fail loudly, free the ids.
+            self.pool.unref(plan.new_ids)
+            raise RuntimeError(
+                f"radix commit raced an eviction/insert: planned match "
+                f"{plan.matched_len}, found {pos}")
+        # `node` is the deepest node on the path; if the match ended
+        # INSIDE node's edge, split it at the boundary.
+        depth_into = pos - self._depth_of_parent(node)
+        if node is not self._root and depth_into < len(node.tokens):
+            node = self._split(node, depth_into)
+        child = RadixNode(tokens=tuple(tokens[pos:]),
+                          blocks=list(plan.new_ids), parent=node)
+        child.last_used = self._now()
+        node.children[tuple(tokens[pos:pos + bs])] = child
+        self._leaves.pop(id(node), None)   # gained a child: not a leaf
+        self._leaves[id(child)] = child
+        self._n_nodes += 1
         self.stats_counters["insertions"] += 1
+
+    def _depth_of_parent(self, node: RadixNode) -> int:
+        """Token depth at which `node`'s edge starts."""
+        d = 0
+        cur = node.parent
+        while cur is not None:
+            d += len(cur.tokens)
+            cur = cur.parent
+        return d
+
+    def _split(self, node: RadixNode, at: int) -> RadixNode:
+        """Split `node`'s edge at token offset `at` (a block boundary
+        inside the edge); returns the new upper node. Block ownership
+        moves with the tokens; refcounts are untouched (same owners)."""
+        bs = self.block_tokens
+        assert 0 < at < len(node.tokens) and at % bs == 0
+        upper = RadixNode(tokens=node.tokens[:at],
+                          blocks=node.blocks[:at // bs],
+                          parent=node.parent)
+        upper.last_used = node.last_used
+        parent_key = node.tokens[:bs]
+        node.parent.children[parent_key] = upper
+        node.tokens = node.tokens[at:]
+        node.blocks = node.blocks[at // bs:]
+        node.parent = upper
+        upper.children[node.tokens[:bs]] = node
+        self._n_nodes += 1
+        return upper
+
+    def _evict_one(self) -> bool:
+        """Detach the least-recently-used LEAF whose blocks are all
+        unpinned and free its blocks; False when nothing is safely
+        evictable. Walks the incrementally-maintained LRU leaf registry,
+        skipping pinned leaves in place — O(pinned prefix) per evicted
+        leaf, never a tree scan, never on the lookup fast path."""
+        victim: RadixNode | None = None
+        for node in self._leaves.values():
+            if all(self.pool.refcount(b) == 1 for b in node.blocks):
+                victim = node
+                break  # oldest unpinned leaf
+        if victim is None:
+            return False
+        del self._leaves[id(victim)]
+        del victim.parent.children[victim.tokens[:self.block_tokens]]
+        parent = victim.parent
+        if parent is not self._root and not parent.children:
+            self._leaves[id(parent)] = parent  # exposed: evictable next
+        self.pool.unref(victim.blocks)
+        self._n_nodes -= 1
+        self.stats_counters["evictions"] += len(victim.blocks)
+        self.stats_counters["nodes_evicted"] += 1
+        self._m_evicted.inc(len(victim.blocks))
         return True
+
+    # --------------------------------------------------------- accounting
 
     def note_reuse(self, n_requests: int, prefix_len: int) -> None:
         """Account `n_requests` ADMITTED via cached KV (one hit each)
@@ -202,61 +510,20 @@ class PrefixStore:
         self.stats_counters["tokens_reused"] += n_requests * prefix_len
 
     def note_miss(self, n_requests: int) -> None:
-        """Account `n_requests` admitted WITHOUT cached KV (full
-        prefill or unseeded chunked prefill)."""
         self.stats_counters["misses"] += n_requests
-
-    def _pin(self, entry: PrefixEntry) -> None:
-        entry.pins += 1
-        if entry.pins == 1:
-            self._pinned += 1
-
-    def _unpin(self, entry: PrefixEntry) -> None:
-        entry.pins -= 1
-        if entry.pins == 0:
-            self._pinned -= 1
-
-    def _evict_one(self) -> bool:
-        """Drop the least-recently-used UNPINNED entry; False when every
-        entry is pinned (nothing safely evictable)."""
-        for key, entry in self._entries.items():
-            if entry.pins <= 0:
-                del self._entries[key]
-                self._bytes -= entry.nbytes
-                for p in range(self.align, entry.length + 1, self.align):
-                    bkey = _digest(self._token_bytes(entry.tokens[:p]))
-                    if self._index.get(bkey, (None,))[0] != key:
-                        continue
-                    # The evicted entry may have WON this boundary from
-                    # another resident entry sharing the prefix (latest
-                    # insert wins) — repair the index to any survivor
-                    # that still covers it, else a live prefix would
-                    # silently stop hitting until its own entry churned.
-                    del self._index[bkey]
-                    prefix = entry.tokens[:p]
-                    for okey, other in self._entries.items():
-                        if (other.length >= p
-                                and other.tokens[:p] == prefix):
-                            self._index[bkey] = (okey, p)
-                            break
-                self.stats_counters["evictions"] += 1
-                return True
-        return False
-
-    # --------------------------------------------------------------- misc
-
-    @staticmethod
-    def _token_bytes(tokens: list[int] | tuple[int, ...]) -> bytes:
-        import numpy as np
-
-        return np.asarray(tokens, dtype=np.int32).tobytes()
 
     def stats(self) -> dict[str, Any]:
         out: dict[str, Any] = dict(self.stats_counters)
-        out["entries"] = len(self._entries)
-        out["bytes"] = self._bytes
-        out["budget_bytes"] = self.budget_bytes
-        out["pinned"] = self._pinned
+        pool = self.pool
+        out["nodes"] = self._n_nodes
+        out["block_tokens"] = pool.block_tokens
+        out["blocks_total"] = pool.n_blocks
+        out["blocks_in_use"] = pool.in_use
+        out["blocks_free"] = pool.free_count
+        out["pinned"] = pool.pinned
+        out["bytes"] = pool.bytes_in_use
+        out["budget_bytes"] = pool.budget_bytes
+        out["hbm_high_water_bytes"] = pool.hbm_high_water_bytes
         n = out["hits"] + out["misses"]
         out["hit_rate"] = round(out["hits"] / n, 4) if n else 0.0
         return out

@@ -58,7 +58,7 @@ class GenRequest:
     trace_id: str = ""
     # Decode-tier handoff adoption (engine/disagg/): called ONCE with
     # this request on the engine thread when admission first picks it,
-    # BEFORE the prefix lookup — the PrefixStore's mutation contract is
+    # BEFORE the prefix lookup — the radix index's mutation contract is
     # engine-thread-only, and the adoption's heavy work (frame decode,
     # device transfer) belongs next to the other admission device work,
     # not on the host's serial wire thread. The thunk fills
@@ -848,10 +848,11 @@ class Scheduler:
         now = time.monotonic()
         ready: list[tuple[int, GenRequest]] = []
         # Prefix-cache hits partition into their OWN dispatch units keyed
-        # by (bucket, entry, prefix length): a hit unit admits through the
-        # engine's cached path (seed copy + suffix-only prefill) while
-        # miss units pay the full coalesced prefill — mixing them would
-        # force everyone onto the slower path.
+        # by (bucket, (radix node, matched_len)): equal keys share one
+        # block-gather seed, and a hit unit admits through the engine's
+        # cached path (pool gather + suffix-only prefill) while miss
+        # units pay the full coalesced prefill — mixing them would force
+        # everyone onto the slower path.
         hit_units: dict[tuple, tuple[Any, list[tuple[int, GenRequest]]]] = {}
         for slot, req in group:
             req.picked_at = now

@@ -157,6 +157,11 @@ class TpuNativeBackend(InferenceBackend):
         # first member spawns (they must not bail while start() is
         # still assembling the pool) and cleared first thing in stop().
         self._pool_active = False
+        # The provider's SLO burn-rate monitor (attached after
+        # construction): the pool heartbeat reads its live fast-window
+        # burn and feeds PoolRouter.update_gauges — the placement
+        # tie-break input that was plumbed but never fed live.
+        self._slo_monitor = None
         if self._disagg:
             from symmetry_tpu.engine.disagg import (
                 HandoffBroker, LinkConfig, PoolConfig)
@@ -251,6 +256,13 @@ class TpuNativeBackend(InferenceBackend):
             MetricName.RELAY_HOST_FRAMES, "host-pipe frames relayed")
         self._m_host_events = METRICS.counter(
             MetricName.RELAY_HOST_EVENTS, "token events relayed")
+
+    def attach_slo_monitor(self, monitor: Any) -> None:
+        """Provider hook: hand this backend the live SLO burn-rate
+        monitor so the pool heartbeat can feed PoolRouter.update_gauges
+        with real burn instead of the 0.0 the router defaults to. Safe
+        to call in any mode; only pool mode reads it."""
+        self._slo_monitor = monitor
 
     @property
     def _process_mode(self) -> bool:
@@ -355,8 +367,14 @@ class TpuNativeBackend(InferenceBackend):
             # tier derives its own config on its own machine.
             self._cfg_path = write_cfg(derive_role_config(cfg, "decode"))
             if self._local_pair:
-                self._prefill_cfg_path = write_cfg(
-                    derive_role_config(cfg, "prefill"))
+                pre_cfg = derive_role_config(cfg, "prefill")
+                # Incremental handoff is sound ONLY for the local pair:
+                # the supervisor respawns both hosts as one unit, so the
+                # prefill host's shipped-block ledger can never outlive
+                # the decode tree it refers to. Pool/net modes keep it
+                # off (see TpuConfig.handoff_ledger).
+                pre_cfg["tpu"].setdefault("handoff_ledger", True)
+                self._prefill_cfg_path = write_cfg(pre_cfg)
         else:
             self._cfg_path = write_cfg(cfg)
         self._host_down = asyncio.Event()
@@ -847,6 +865,16 @@ class TpuNativeBackend(InferenceBackend):
                 return_exceptions=True)
             if not self._pool_active:
                 return
+            # Live SLO burn (provider monitor, fast window): the
+            # members of this pool serve one provider, so the burn is a
+            # provider-level signal — feeding it keeps the router's
+            # tie-break (and symtop's per-member burn column) on real
+            # request-stream data instead of a forever-0 placeholder,
+            # and a multi-provider router comparing pools sees honest
+            # numbers. None (no monitor attached / no SLO configured)
+            # leaves the gauge untouched.
+            burn = (self._slo_monitor.burn_rate()
+                    if self._slo_monitor is not None else None)
             for m, msg in zip(decode, replies[:len(decode)]):
                 if not isinstance(msg, dict) or not m.engine_alive:
                     if m.dead:
@@ -860,7 +888,8 @@ class TpuNativeBackend(InferenceBackend):
                             m.proc.kill()  # reader EOF runs death path
                     continue
                 self._pool.update_gauges(
-                    m.id, queue_depth=msg.get("queue_depth"))
+                    m.id, queue_depth=msg.get("queue_depth"),
+                    burn_rate=burn)
             for (member_id, _), reply in zip(plinks,
                                              replies[len(decode):]):
                 host = (reply.get("host")
@@ -868,7 +897,8 @@ class TpuNativeBackend(InferenceBackend):
                 if isinstance(host, dict) \
                         and host.get("queue_depth") is not None:
                     self._pool.update_gauges(
-                        member_id, queue_depth=host["queue_depth"])
+                        member_id, queue_depth=host["queue_depth"],
+                        burn_rate=burn)
 
     # --- pool membership callbacks (link-driven) ----------------------
 

@@ -67,6 +67,25 @@ class TpuConfig:
     # KV into the slot lane, prefilling only the uncached suffix. None/0
     # disables the cache entirely (no lookups, no extra warmup compiles).
     prefix_cache_mb: float | None = None
+    # Tokens per KV block in the radix prefix cache's paged pool. Shared
+    # prefixes match at THIS granularity (any whole-block prefix hits —
+    # multi-turn histories of arbitrary length, not just bucket-aligned
+    # preambles); smaller blocks share more but cost more index entries
+    # and a longer re-prefilled tail on handoff. Must divide every
+    # prefill bucket (enforced only when the cache is enabled).
+    prefix_block_tokens: int = 16
+    # Prefill-role only: skip handoff-frame payloads for blocks this
+    # host already shipped (the receiver adopts them by reference from
+    # its radix tree). SOUND ONLY when the sender and its single decode
+    # peer live and die together — the tpu_native local pair sets it
+    # (the supervisor respawns both hosts as one unit, so the ledger
+    # can never outlive the receiver's tree). Pool mode (N decode
+    # members — a skipped block may be resident on a DIFFERENT member)
+    # and network mode (the decode host can respawn while the remote
+    # prefill node's ledger survives) leave it off: correctness would
+    # hold either way (the receiver adopts the longest covered prefix),
+    # but a stale ledger silently degrades KV reuse to full re-prefill.
+    handoff_ledger: bool = False
     # Speculative decoding (engine/spec/): n-gram prompt-lookup drafting
     # with batched block verification. None/False disables it entirely —
     # the decode path and warmup compile set are then byte-identical to a

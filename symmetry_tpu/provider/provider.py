@@ -226,6 +226,13 @@ class SymmetryProvider:
         # breach as a first-class signal, not a bench-time observation.
         self.slo = SloMonitor(self.config.get("slo"),
                               on_breach=self._on_slo_breach)
+        if hasattr(self.backend, "attach_slo_monitor"):
+            # Live placement input (ROADMAP item 4 remainder): the
+            # tpu_native pool heartbeat feeds this monitor's fast-window
+            # burn rate into PoolRouter.update_gauges, so placement's
+            # burn tie-break runs on the real request stream instead of
+            # only queue depth.
+            self.backend.attach_slo_monitor(self.slo)
 
     # ----- lifecycle (reference: init(), src/provider.ts:37-81) -----
 

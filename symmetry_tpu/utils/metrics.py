@@ -89,6 +89,12 @@ class MetricName:
     SCHED_DISPATCH = "sym_sched_dispatch_seconds"            # {kind}
     SCHED_TTFT = "sym_sched_ttft_seconds"
 
+    # --- radix prefix cache (engine/prefix_cache.py; lives in the host
+    #     process, tier-labeled through the HostOp.METRICS probe)
+    PREFIX_BLOCKS_IN_USE = "sym_prefix_blocks_in_use"
+    PREFIX_BLOCKS_EVICTED = "sym_prefix_blocks_evicted_total"
+    PREFIX_HIT_DEPTH = "sym_prefix_radix_hit_depth_blocks"
+
     # --- engine host pipe (engine/host.py)
     HOST_PIPE_WRITES = "sym_host_pipe_writes_total"
     HOST_PIPE_BYTES = "sym_host_pipe_bytes_total"
@@ -724,6 +730,24 @@ class SloMonitor:
         if self._on_breach is not None:
             self._on_breach(event)
         return event
+
+    def burn_rate(self, now: float | None = None) -> float:
+        """Current worst fast-window burn across every configured SLO,
+        pruned live — the placement input the elastic disagg pool's
+        router consumes (PoolRouter.update_gauges burn_rate): a tier
+        that is burning error budget should stop winning placement
+        ties. 0.0 when no SLO is configured or nothing has burned."""
+        if not self.targets:
+            return 0.0
+        now = self._clock() if now is None else now
+        budget = max(1.0 - self.objective, 1e-9)
+        worst = 0.0
+        with self._lock:
+            for fast_w, _slow_w in self._windows.values():
+                fast_w.prune(now)
+                burn, _n = fast_w.burn(budget)
+                worst = max(worst, burn)
+        return worst
 
     def evaluate(self, now: float | None = None) -> list[dict[str, Any]]:
         """Evaluate every rule (periodic path — observe() already

@@ -798,6 +798,7 @@ class TestHostStatsLockRegression:
 
         class _Eng:
             kv_quant = False
+            prefix_block = 8
 
         host._engine = _Eng()
         host._write = lambda obj, events=0: None  # no real pipe

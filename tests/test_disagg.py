@@ -273,7 +273,7 @@ def make_engine(cfg, params, role="unified", cache_mb=16, chunk=8,
         cfg, params, ByteTokenizer(), max_slots=slots, max_seq_len=64,
         prefill_buckets=(16, 32), cache_dtype=jnp.float32,
         prefill_chunk=chunk, prefix_cache_bytes=int(cache_mb * 2**20),
-        role=role, **kw)
+        prefix_block_tokens=8, role=role, **kw)
 
 
 def drive(sched, prompts, max_new=6, timeout=120):
@@ -302,13 +302,14 @@ def drive(sched, prompts, max_new=6, timeout=120):
     return out
 
 
-def host_style_handoff(engine, slot, req):
-    """What the prefill host's sink does: extract the aligned slot-lane
-    KV and serialize it (the real sink lives in engine/host.py; this
-    mirrors it so the identity test exercises the same frame path)."""
+def host_style_handoff(engine, slot, req, skip=()):
+    """What the prefill host's sink does: extract the whole-block
+    slot-lane KV and serialize it blockwise (the real sink lives in
+    engine/host.py; this mirrors it so the identity test exercises the
+    same frame path)."""
     n = len(req.prompt_ids)
-    A = engine.prefix_align
-    p = A * ((n - 1) // A)
+    PB = engine.prefix_block
+    p = PB * ((n - 1) // PB)
     arrays = None
     if p > 0:
         cache = engine.extract_slot_kv(slot, p)
@@ -318,7 +319,8 @@ def host_style_handoff(engine, slot, req):
             arrays["k_scale"] = np.asarray(cache.k_scale)[:, :, :, :p]
             arrays["v_scale"] = np.asarray(cache.v_scale)[:, :, :, :p]
     return encode_kv_handoff(req.id, req.prompt_ids, p, arrays,
-                             kv_quant=engine.kv_quant)
+                             kv_quant=engine.kv_quant,
+                             block_size=PB, skip=skip)
 
 
 PROMPTS = [
@@ -383,18 +385,38 @@ class TestRoleContracts:
             "x", list(range(20)), 16, qarr, kv_quant=True))
         with pytest.raises(EngineError, match="quantization"):
             engine.adopt_prefix(h)
-        # misaligned prefix length (align is 8 here)
+        # non-whole-block prefix length: adoption FLOORS to whole
+        # engine blocks (block is 8 here) instead of rejecting — a
+        # shorter prefix is always causally sound
         mis = gqa_arrays(L=cfg.num_layers, K=cfg.num_kv_heads,
                          D=cfg.dim_per_head, p=12)
         h = decode_kv_handoff(encode_kv_handoff(
-            "x", list(range(20)), 12, mis))
-        with pytest.raises(EngineError, match="aligned"):
-            engine.adopt_prefix(h)
+            "y", list(range(100, 120)), 12, mis))
+        assert engine.adopt_prefix(h) is True
+        assert engine.prefix_index.match_len(list(range(100, 120))) == 8
+        # multi-block frame whose block size straddles the pool's
+        # (bs=12 over PB=8): the tail block [24:36) must CLIP to the
+        # floored run [24:32) — an unclipped assembly write would
+        # broadcast-crash against the 32-capacity row
+        mis = gqa_arrays(L=cfg.num_layers, K=cfg.num_kv_heads,
+                         D=cfg.dim_per_head, p=36)
+        h = decode_kv_handoff(encode_kv_handoff(
+            "z", list(range(200, 236)), 36, mis, block_size=12))
+        assert engine.adopt_prefix(h) is True
+        assert engine.prefix_index.match_len(list(range(200, 236))) == 32
         # control: a well-formed frame adopts
         h = decode_kv_handoff(encode_kv_handoff(
             "x", list(range(20)), 16, good))
         assert engine.adopt_prefix(h) is True
-        assert engine.adopt_prefix(h) is True  # idempotent (has())
+        assert engine.adopt_prefix(h) is True  # idempotent (resident)
+        # manifest-only frame (every block skipped): adopted by
+        # reference while resident...
+        h_skip = decode_kv_handoff(encode_kv_handoff(
+            "x", list(range(20)), 16, good, block_size=8, skip=[0, 1]))
+        assert engine.adopt_prefix(h_skip) is True
+        # ...but a fresh decode tier (nothing resident) cannot use it
+        fresh = make_engine(cfg, params, role="decode")
+        assert fresh.adopt_prefix(h_skip) is False
 
 
 class TestDisaggIdentity:
@@ -484,15 +506,16 @@ class TestDisaggIdentity:
         cfg, params = setup
         eng_d = make_engine(cfg, params, role="decode", cache_mb=1e-4)
         # Decode-role construction raises an undersized budget to the
-        # geometry floor (2 × largest-bucket entry bytes) — a default
-        # too small for the model must never silently reject EVERY
-        # adoption.
-        assert eng_d.prefix_store.budget_bytes >= \
+        # geometry floor (2 × largest-bucket prefix worth of blocks) —
+        # a default too small for the model must never silently reject
+        # EVERY adoption.
+        assert eng_d.block_pool.budget_bytes >= \
             2 * 32 * eng_d.kv_bytes_per_token()
-        # Simulate a store with no headroom (everything pinned/full):
-        # insert() rejects, lookup misses, admission runs the ordinary
-        # full-prefill path.
-        eng_d.prefix_store.budget_bytes = 64
+        # Simulate a pool with no headroom: allocate every block OUTSIDE
+        # the tree (nothing is evictable), so plan_insert rejects,
+        # lookup misses, and admission runs the ordinary full-prefill
+        # path.
+        eng_d.block_pool.alloc(eng_d.block_pool.free_count)
         eng_d.warmup()
         h = decode_kv_handoff(encode_kv_handoff(
             "r0", PROMPTS[0], 16,
@@ -652,6 +675,7 @@ class TestBackendDisaggIdentity:
 
 class _StubPrefillEngine:
     prefix_align = 8
+    prefix_block = 8
     kv_quant = False
 
     def __init__(self, cfg, params):

@@ -315,6 +315,34 @@ class TestSloMonitor:
         m.observe("ttft", 5.0)
         assert len(breaches) == 2
 
+    def test_burn_rate_accessor_feeds_pool_gauges(self):
+        """SloMonitor.burn_rate(): the live fast-window burn the
+        tpu_native pool heartbeat feeds into PoolRouter.update_gauges —
+        0 while healthy, > 0 under burn, decaying as the window prunes,
+        and 0 with no SLO configured."""
+        clock, breaches = FakeClock(), []
+        m = make_monitor(clock, breaches)
+        assert m.burn_rate() == 0.0
+        for _ in range(10):
+            clock.t += 1.0
+            m.observe("ttft", 5.0)  # every event over target
+        burn = m.burn_rate()
+        assert burn >= 10.0
+        # the router consumes it through update_gauges and the member's
+        # placement score reflects it
+        from symmetry_tpu.engine.disagg.pool import PoolRouter
+
+        router = PoolRouter()
+        router.add_member("d0", "decode")
+        router.mark_healthy("d0")
+        router.update_gauges("d0", queue_depth=0, burn_rate=burn)
+        (member,) = router.members("decode")
+        assert member.burn_rate == pytest.approx(burn)
+        # window prune: far in the future the burn decays to zero
+        clock.t += 10_000.0
+        assert m.burn_rate() == 0.0
+        assert SloMonitor(None, clock=clock).burn_rate() == 0.0
+
     def test_unknown_slo_and_disabled_config(self):
         clock, breaches = FakeClock(), []
         m = make_monitor(clock, breaches)

@@ -86,12 +86,39 @@ def provider_config_dict() -> dict:
                            "backoff_base_s": 0.2, "backoff_max_s": 1.0,
                            "max_respawns": 3, "spawn_timeout_s": 300.0,
                            "stop_grace_s": 5.0, "min_stable_s": 0.5},
-            # Per-tier fault: the PREFILL host's second handoff crashes
-            # it (phase 2); the decode host is never armed.
+            # Per-tier fault: the PREFILL host's THIRD handoff crashes
+            # it (phase 2 — handoffs 1 and 2 are phase 1's cold request
+            # and phase 1b's warm block-manifest request); the decode
+            # host is never armed.
             "disagg": {"prefill": {
-                "faults": {"disagg.handoff": "crash@nth=2"}}},
+                "faults": {"disagg.handoff": "crash@nth=3"}}},
         },
     }
+
+
+# Phase 1b: a SECOND request that extends PROMPT — it shares every
+# whole block of the first request's prefix, so its handoff frame must
+# ship only the non-resident tail blocks (the shared ones ride the
+# digest manifest and are adopted by reference on the decode tier).
+PROMPT_WARM = PROMPT + " blocks"  # still fits the 64 bucket
+
+
+def assert_warm_handoff(dg_cold: dict, dg_warm: dict) -> tuple[int, int]:
+    """Counter-assert the incremental handoff: the warm frame shipped
+    strictly fewer bytes than the cold one, some blocks were
+    manifest-only (skipped), and some still shipped (the new tail)."""
+    cold_bytes = dg_cold["handoff_bytes"]
+    warm_bytes = dg_warm["handoff_bytes"] - cold_bytes
+    assert 0 < warm_bytes < cold_bytes, \
+        f"warm handoff not incremental: cold={cold_bytes} warm={warm_bytes}"
+    blocks = dg_warm.get("blocks", 0) - dg_cold.get("blocks", 0)
+    shipped = (dg_warm.get("blocks_shipped", 0)
+               - dg_cold.get("blocks_shipped", 0))
+    assert blocks > 0, f"warm handoff carried no block manifest: {dg_warm}"
+    assert shipped < blocks, \
+        f"warm handoff shipped every block ({shipped}/{blocks}) — " \
+        f"the resident-prefix skip never engaged"
+    return warm_bytes, cold_bytes
 
 
 def assert_phase1_stats(stats: dict) -> dict:
@@ -150,6 +177,20 @@ async def run_backend_direct() -> int:
               f"{dg['handoff_frames']} handoff frame(s), "
               f"{dg['handoff_bytes']} bytes, prefill-tier p50 "
               f"{(dg.get('prefill_tier_s') or {}).get('p50')}s")
+
+        # phase 1b: block-manifest incremental handoff — the second
+        # request extends PROMPT, its shared prefix blocks are already
+        # resident on the decode tier, and the wire must carry only the
+        # non-resident tail.
+        text1b = await collect(backend, PROMPT_WARM)
+        assert text1b, "phase 1b streamed no text"
+        dg1b = (await backend.engine_stats()).get("disagg") or {}
+        warm_bytes, cold_bytes = assert_warm_handoff(dg, dg1b)
+        print(f"disagg smoke: phase 1b warm handoff shipped "
+              f"{warm_bytes} bytes vs {cold_bytes} cold "
+              f"({dg1b.get('blocks_shipped', 0) - dg.get('blocks_shipped', 0)}"
+              f"/{dg1b.get('blocks', 0) - dg.get('blocks', 0)} blocks "
+              f"on the wire)")
 
         # phase 2: prefill-host crash mid-request → restarting shed →
         # respawned pair serves the retry
@@ -230,6 +271,21 @@ async def run_network() -> int:
           f"wire; {dg['handoff_frames']} handoff frame(s), "
           f"{dg['handoff_bytes']} bytes, prefill-tier p50 "
           f"{(dg.get('prefill_tier_s') or {}).get('p50')}s")
+
+    # phase 1b: warm block-manifest handoff through the wire — shared
+    # prefix blocks ride the manifest only, the tail ships.
+    deltas1b = []
+    async for item in client.chat_failover(
+            "mem://server", server_ident.public_key, "tiny:disagg",
+            [{"role": "user", "content": PROMPT_WARM}], max_tokens=8,
+            temperature=0.0):
+        deltas1b.append(item)
+    text1b = "".join(d for d in deltas1b if isinstance(d, str))
+    assert text1b, "phase 1b streamed no text"
+    dg1b = (await provider.backend.engine_stats()).get("disagg") or {}
+    warm_bytes, cold_bytes = assert_warm_handoff(dg, dg1b)
+    print(f"disagg smoke: phase 1b warm handoff shipped {warm_bytes} "
+          f"bytes vs {cold_bytes} cold")
 
     # phase 2: prefill-host crash mid-request → restarting shed →
     # client failover retry completes on the respawned pair
