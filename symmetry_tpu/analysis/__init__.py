@@ -1,25 +1,40 @@
 """symlint: project-invariant static analysis (see tools/symlint.py).
 
-Five AST checkers over the repo, each making one runtime invariant
-statically checkable:
+Eight checkers over the repo, each making one runtime invariant
+statically checkable. Six are flat AST passes:
 
   wire-contract     host-pipe op / MessageKey producer↔consumer sets
   concurrency       cross-thread mutation locks; blocking-in-async
   recompile-hazard  value syncs / data branches inside jit traces
   fault-seam        SYMMETRY_FAULTS arming ↔ FAULTS.point guards
   metric-names      MetricName registry ↔ METRICS emission sites
+  knobs             tpu.* knobs: TpuConfig ↔ README ↔ read sites
 
-Run via `python tools/symlint.py` (text or --json, --baseline
-suppression file, exit 1 on non-baselined findings). The suite is also
-importable — `run(root)` — which is how tests/test_analysis.py asserts
-the repo itself stays clean.
+and two are path-sensitive, built on the CFG + abstract-state walker
+in dataflow.py (one node per statement, exception/finally/early-return
+edges, per-path states — the PR-12 class of bug lives only on paths):
+
+  lifecycle         paired resources (radix pins, insert plans, pool
+                    blocks, bare locks) released on EVERY path out,
+                    exception edges included; double-release;
+                    use-after-release
+  donation          jax.jit donate_argnums buffers never read after
+                    the jitted call without rebinding
+
+Run via `python tools/symlint.py` (text, --json, or --sarif output,
+--baseline suppression file, exit 1 on non-baselined findings). The
+suite is also importable — `run(root)` — which is how
+tests/test_analysis.py asserts the repo itself stays clean.
 """
 
 from __future__ import annotations
 
 from symmetry_tpu.analysis import (
     concurrency,
+    donation,
     fault_seams,
+    knobs,
+    lifecycle,
     metric_names,
     recompile,
     wire_contract,
@@ -38,6 +53,9 @@ ALL_CHECKERS: tuple[CheckerSpec, ...] = (
     recompile.SPEC,
     fault_seams.SPEC,
     metric_names.SPEC,
+    lifecycle.SPEC,
+    donation.SPEC,
+    knobs.SPEC,
 )
 
 

@@ -1021,9 +1021,12 @@ class InferenceEngine:
             plan = self.prefix_index.plan_insert(ids[:p])
             if plan is None:
                 continue  # fully resident, or rejected even after LRU
-            row_cache = self._extract_prefix_row(prefix, jnp.int32(row),
-                                                 jnp.int32(p))
             try:
+                # Inside the try: a device failure in the extract (or
+                # anywhere before commit) must abort the plan, or its
+                # pinned prefix and allocated blocks leak forever.
+                row_cache = self._extract_prefix_row(
+                    prefix, jnp.int32(row), jnp.int32(p))
                 bucket = row_cache.k.shape[2]
                 lane0 = plan.matched_len // PB
                 self._pool_kv = self._write_blocks(
@@ -1161,37 +1164,45 @@ class InferenceEngine:
         # Assemble the new tail into one bucket-padded batch-1 row and
         # scatter it in ONE dispatch — the same per-bucket program the
         # local store path compiled, so adoption never triggers a
-        # mid-traffic XLA compile.
-        capacity = self.bucket_for(p_eff)
-        m = plan.matched_len
-        k_row = np.zeros((c.num_layers, 1, capacity, c.num_kv_heads,
-                          c.dim_per_head), want_dtype)
-        v_row = np.zeros_like(k_row)
-        ks_row = vs_row = None
-        if self.kv_quant:
-            ks_row = np.zeros((c.num_layers, 1, c.num_kv_heads, capacity),
-                              np.float32)
-            vs_row = np.zeros_like(ks_row)
-        for j, planes in handoff.blocks.items():
-            lo, hi = j * bs, (j + 1) * bs
-            if hi <= m or lo >= p_eff:
-                continue  # resident already, or past the adopted run
-            # A frame block may straddle p_eff when the sender's block
-            # size is not a multiple of this pool's (the floored tail):
-            # clip to the adopted run — the row is only capacity wide.
-            w = min(hi, p_eff) - lo
-            k_row[:, :, lo:lo + w] = planes["k"][:, :, :w]
-            v_row[:, :, lo:lo + w] = planes["v"][:, :, :w]
-            if self.kv_quant:
-                ks_row[:, :, :, lo:lo + w] = planes["k_scale"][:, :, :, :w]
-                vs_row[:, :, :, lo:lo + w] = planes["v_scale"][:, :, :, :w]
-        row = KVCache(
-            k=jnp.asarray(k_row), v=jnp.asarray(v_row),
-            lengths=jnp.full((1,), p_eff, jnp.int32),
-            k_scale=jnp.asarray(ks_row) if self.kv_quant else None,
-            v_scale=jnp.asarray(vs_row) if self.kv_quant else None,
-        )
+        # mid-traffic XLA compile. The whole assembly runs inside the
+        # try: a failure anywhere between plan and commit (no bucket
+        # fits, a frame missing its scale planes, a device transfer
+        # error) must abort the plan, or its pinned matched prefix and
+        # allocated blocks leak forever.
         try:
+            capacity = self.bucket_for(p_eff)
+            m = plan.matched_len
+            k_row = np.zeros((c.num_layers, 1, capacity, c.num_kv_heads,
+                              c.dim_per_head), want_dtype)
+            v_row = np.zeros_like(k_row)
+            ks_row = vs_row = None
+            if self.kv_quant:
+                ks_row = np.zeros(
+                    (c.num_layers, 1, c.num_kv_heads, capacity),
+                    np.float32)
+                vs_row = np.zeros_like(ks_row)
+            for j, planes in handoff.blocks.items():
+                lo, hi = j * bs, (j + 1) * bs
+                if hi <= m or lo >= p_eff:
+                    continue  # resident already, or past the adopted run
+                # A frame block may straddle p_eff when the sender's
+                # block size is not a multiple of this pool's (the
+                # floored tail): clip to the adopted run — the row is
+                # only capacity wide.
+                w = min(hi, p_eff) - lo
+                k_row[:, :, lo:lo + w] = planes["k"][:, :, :w]
+                v_row[:, :, lo:lo + w] = planes["v"][:, :, :w]
+                if self.kv_quant:
+                    ks_row[:, :, :, lo:lo + w] = \
+                        planes["k_scale"][:, :, :, :w]
+                    vs_row[:, :, :, lo:lo + w] = \
+                        planes["v_scale"][:, :, :, :w]
+            row = KVCache(
+                k=jnp.asarray(k_row), v=jnp.asarray(v_row),
+                lengths=jnp.full((1,), p_eff, jnp.int32),
+                k_scale=jnp.asarray(ks_row) if self.kv_quant else None,
+                v_scale=jnp.asarray(vs_row) if self.kv_quant else None,
+            )
             self._pool_kv = self._write_blocks(
                 self._pool_kv, row,
                 self._bucket_ids(capacity, plan.new_ids, at=m // PB))

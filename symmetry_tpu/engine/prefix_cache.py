@@ -403,16 +403,27 @@ class RadixIndex:
         if need == 0:
             return None
         self.pool.ref(matched)
-        ids = self.pool.alloc(need)
-        while ids is None and self._evict_one():
+        ids = None
+        try:
             ids = self.pool.alloc(need)
-        if ids is None:
+            while ids is None and self._evict_one():
+                ids = self.pool.alloc(need)
+            if ids is not None:
+                return InsertPlan(tokens=tuple(tokens), matched_len=m,
+                                  new_ids=ids,
+                                  matched_blocks=tuple(matched),
+                                  _index=self)
+        except Exception:
+            # An eviction failure (or anything else between alloc and
+            # the plan handoff) must not leak the matched-prefix pin or
+            # the freshly allocated blocks.
+            if ids is not None:
+                self.pool.unref(ids)
             self.pool.unref(matched)
-            self.stats_counters["rejected"] += 1
-            return None
-        return InsertPlan(tokens=tuple(tokens), matched_len=m,
-                          new_ids=ids, matched_blocks=tuple(matched),
-                          _index=self)
+            raise
+        self.pool.unref(matched)
+        self.stats_counters["rejected"] += 1
+        return None
 
     def _commit(self, plan: InsertPlan) -> None:
         """Attach the plan's blocks to the tree, splitting the edge at

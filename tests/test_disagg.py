@@ -1655,3 +1655,35 @@ class TestCreditGate:
             t.cancel()
 
         run_async(main())
+
+
+class TestAdoptLeakRegression:
+    """Regression for the real L402 symlint's lifecycle checker found
+    in adopt_prefix: the row assembly between plan_insert and the
+    scatter ran OUTSIDE the abort guard, so a failure there (no bucket
+    fits, a malformed frame, a device transfer error) leaked the
+    plan's pinned prefix and allocated blocks forever."""
+
+    def test_scatter_failure_aborts_plan_and_state_survives(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params, role="decode")
+        h = decode_kv_handoff(encode_kv_handoff(
+            "x", list(range(20)), 16,
+            gqa_arrays(L=cfg.num_layers, K=cfg.num_kv_heads,
+                       D=cfg.dim_per_head, p=16)))
+        real = engine._write_blocks
+
+        def boom(*a, **kw):
+            raise RuntimeError("scatter failed")
+
+        engine._write_blocks = boom
+        with pytest.raises(RuntimeError, match="scatter failed"):
+            engine.adopt_prefix(h)
+        pool = engine.prefix_index.pool
+        # plan aborted: nothing pinned, every allocated block returned
+        assert pool.pinned == 0 and pool.in_use == 0
+        # the store is uncorrupted — the same frame adopts cleanly once
+        # the device cooperates again
+        engine._write_blocks = real
+        assert engine.adopt_prefix(h) is True
+        assert engine.prefix_index.match_len(list(range(20))) == 16

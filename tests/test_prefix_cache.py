@@ -625,3 +625,54 @@ class TestSchedulerIntegration:
         assert len(staged) == 1
         stages = staged[0].stages
         assert stages["recv"] <= stages["picked"] <= stages["first"]
+
+
+class TestLifecycleLeakRegressions:
+    """Regressions for the real L4xx findings symlint's lifecycle
+    checker surfaced (the PR-12 crash class, path-sensitively): every
+    failure between a plan's acquisition and its commit must abort the
+    plan, or the matched-prefix pins and freshly allocated blocks leak
+    until restart."""
+
+    def test_plan_insert_eviction_failure_releases_everything(self):
+        idx = mk_index(n_blocks=4)
+        do_insert(idx, [1, 2, 3, 4, 5, 6, 7, 8])
+        do_insert(idx, [9, 10, 11, 12, 13, 14, 15, 16])
+        assert idx.pool.free_count == 0
+
+        def boom():
+            raise RuntimeError("eviction exploded")
+
+        idx._evict_one = boom
+        with pytest.raises(RuntimeError, match="eviction exploded"):
+            # shares the first 2 blocks (pinned by the plan), needs a
+            # third → alloc fails → eviction raises mid-plan
+            idx.plan_insert([1, 2, 3, 4, 5, 6, 7, 8,
+                             91, 92, 93, 94])
+        del idx._evict_one
+        # the matched-prefix pins were released and nothing leaked:
+        # tree ownership is the only reference again
+        assert idx.pool.pinned == 0
+        assert idx.pool.in_use == 4 and idx.pool.free_count == 0
+        # the index is still healthy — the same insert succeeds once
+        # eviction works again (evicts the other entry's leaf)
+        plan = idx.plan_insert([1, 2, 3, 4, 5, 6, 7, 8, 91, 92, 93, 94])
+        assert plan is not None and plan.matched_len == 8
+        plan.commit()
+        assert idx.match_len([1, 2, 3, 4, 5, 6, 7, 8, 91, 92, 93, 94,
+                              0]) == 12
+
+    def test_store_prefix_extract_failure_aborts_plan(self, setup):
+        cfg, params = setup
+        engine = make_engine(cfg, params)
+
+        def boom(*a, **kw):
+            raise RuntimeError("device error in extract")
+
+        engine._extract_prefix_row = boom
+        with pytest.raises(RuntimeError, match="device error"):
+            engine._maybe_store_prefix(
+                [(0, list(range(16)), SamplingParams())], None)
+        pool = engine.prefix_index.pool
+        # plan aborted: no pins held, every allocated block returned
+        assert pool.pinned == 0 and pool.in_use == 0

@@ -1,15 +1,20 @@
 #!/usr/bin/env python3
 """symlint — the project-invariant static-analysis gate.
 
-Runs the five AST checkers in symmetry_tpu/analysis/ over the repo and
-exits non-zero when any finding is not covered by the baseline file,
-so CI fails on protocol/concurrency/recompile/fault-seam drift before
-the test suite even starts (the whole run is ~4 s of `ast.parse` +
-checker passes, no JAX import, no device).
+Runs the eight checkers in symmetry_tpu/analysis/ over the repo — six
+flat AST passes plus the two path-sensitive dataflow checkers
+(lifecycle, donation) — and exits non-zero when any finding is not
+covered by the baseline file, so CI fails on protocol/concurrency/
+recompile/fault-seam/lifecycle drift before the test suite even starts
+(the whole run is ~6 s of `ast.parse` + checker passes, no JAX import,
+no device; CI asserts the 10 s budget).
 
 Usage:
     python tools/symlint.py                  # text output, repo root
     python tools/symlint.py --json           # machine-readable report
+    python tools/symlint.py --sarif out.sarif  # + SARIF 2.1.0 file (CI
+                                             # uploads it so findings
+                                             # annotate the PR diff)
     python tools/symlint.py --checker wire-contract --checker fault-seam
     python tools/symlint.py --baseline tools/symlint_baseline.json
     python tools/symlint.py --no-baseline    # show EVERYTHING
@@ -49,6 +54,58 @@ from symmetry_tpu.analysis.core import iter_py_files  # noqa: E402
 
 DEFAULT_BASELINE = os.path.join("tools", "symlint_baseline.json")
 SCHEMA_VERSION = 1
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+SARIF_VERSION = "2.1.0"
+
+
+def sarif_report(findings, checkers, baseline) -> dict:
+    """SARIF 2.1.0 document for `findings`. One rule per finding code
+    (its checker's doc as the description); baselined findings carry a
+    `suppressions` entry quoting the baseline justification, which
+    GitHub code scanning honors (they upload but do not alert), so the
+    inline PR annotations show exactly the NEW findings."""
+    rules = [{
+        "id": code,
+        "name": f"{spec.name}/{code}",
+        "shortDescription": {"text": f"[{spec.name}] {spec.doc}"},
+    } for spec in checkers for code in spec.codes]
+    reasons = {}
+    if baseline is not None:
+        reasons = {e["fingerprint"]: e.get("reason", "")
+                   for e in baseline.entries if isinstance(e, dict)}
+    results = []
+    for f in findings:
+        r = {
+            "ruleId": f.code,
+            "level": "note" if f.baselined else "error",
+            "message": {"text": f"[{f.checker}] {f.message}"},
+            "partialFingerprints": {
+                "symlintFingerprint/v1": f.fingerprint},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "%SRCROOT%"},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        }
+        if f.baselined:
+            r["suppressions"] = [{
+                "kind": "external",
+                "justification": reasons.get(f.fingerprint, ""),
+            }]
+        results.append(r)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "symlint",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -63,6 +120,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="repo root to scan (default: this checkout)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable JSON report on stdout")
+    ap.add_argument("--sarif", default=None, metavar="PATH",
+                    help="also write a SARIF 2.1.0 report to PATH "
+                         "(github/codeql-action/upload-sarif annotates "
+                         "PR diffs with it; baselined findings upload "
+                         "as suppressed notes)")
     ap.add_argument("--checker", action="append", default=None,
                     metavar="NAME",
                     help="run only this checker (repeatable); "
@@ -145,6 +207,14 @@ def main(argv: list[str] | None = None) -> int:
         selected_codes = {c for s in checkers for c in s.codes}
         unused = [fp for fp in baseline.unused()
                   if fp.split(":", 1)[0] in selected_codes]
+
+    if args.sarif:
+        # Written BEFORE the exit-code decision: a failing run is
+        # exactly when CI needs the file to annotate the diff.
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(sarif_report(findings, checkers, baseline), fh,
+                      indent=2)
+            fh.write("\n")
 
     if args.as_json:
         report = {
