@@ -280,6 +280,194 @@ def run_e2e_client_worker() -> int:
     return 0
 
 
+def run_chaos(preset_name: str, *, clients: int, slots: int, max_new: int,
+              prompt_chars: int, max_seq: int, dtype_name: str, block: int,
+              bucket: int, seam: str) -> dict:
+    """The kill-under-load robustness bench (`--chaos`): arm ONE named
+    fault seam on provider 1's engine host (default: a pipe-write crash
+    that lands mid-stream), drive a concurrent client fleet through
+    chat_failover, and run the SAME drill twice — stream resumption on
+    (the default failure model) vs off (legacy discard-and-restart).
+    The headline is WASTED WORK: tokens generated and then thrown away
+    (restart arm: every discarded partial; resume arm: only offset-dedup
+    drops and refused-resume fallbacks) plus the recovery latency from
+    the failure sentinel to the next delivered delta (post-kill TTFT).
+
+    Providers live in this process over the in-memory transport (the
+    engine hosts are still real subprocesses) — this bench measures
+    recovery behavior and wasted work, not peak wire throughput; the
+    north-star numbers stay with --e2e."""
+    import asyncio
+    import statistics
+    import time as _time
+
+    from symmetry_tpu.client.client import (
+        ChatRestart,
+        ChatResume,
+        ClientError,
+        SymmetryClient,
+    )
+    from symmetry_tpu.identity import Identity
+    from symmetry_tpu.provider.config import ConfigManager
+    from symmetry_tpu.provider.provider import SymmetryProvider
+    from symmetry_tpu.server.broker import SymmetryServer
+    from symmetry_tpu.transport.memory import MemoryTransport
+    from symmetry_tpu.utils.faults import FAULTS
+
+    seam_name, sep, seam_spec = seam.partition("=")
+    if not sep or not seam_name or not seam_spec:
+        raise RuntimeError(f"--chaos-seam wants seam=action@trigger, "
+                           f"got {seam!r}")
+
+    def pct(vals, p):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1,
+                              max(0, -(-p * len(vals) // 100) - 1))], 4)
+
+    async def run_arm(resume_on: bool) -> dict:
+        FAULTS.clear()
+        hub = MemoryTransport()
+        ident = Identity.from_name("chaos-bench-server")
+        server = SymmetryServer(ident, hub, ping_interval_s=60.0)
+        await server.start("mem://chaos-server")
+
+        def provider_cfg(name: str, faults: dict | None) -> ConfigManager:
+            return ConfigManager(config={
+                "name": name, "public": True,
+                "serverKey": ident.public_hex,
+                "modelName": f"{preset_name}:chaos",
+                "apiProvider": "tpu_native",
+                "dataCollectionEnabled": False,
+                "maxConnections": clients + 8,
+                "flightRecorder": {"enabled": False},
+                **({"faults": faults} if faults else {}),
+                "tpu": {"model_preset": preset_name, "dtype": dtype_name,
+                        "max_batch_size": slots, "max_seq_len": max_seq,
+                        "prefill_buckets": [bucket],
+                        "decode_block": block,
+                        # The resume admission path seeds through the
+                        # radix cache — on, so resumes are cheap
+                        # re-prefills, the contract under test.
+                        "prefix_cache_mb": 64.0},
+            })
+
+        providers = []
+        for name, faults in (("chaos-p1", {seam_name: seam_spec}),
+                             ("chaos-p2", None)):
+            prov = SymmetryProvider(
+                provider_cfg(name, faults), transport=hub,
+                identity=Identity.from_name(name),
+                server_address="mem://chaos-server")
+            await prov.start(f"mem://{name}")
+            await prov.wait_registered()
+            providers.append(prov)
+        p1, p2 = providers
+        # Steer the first wave at the faulted provider.
+        server.registry.set_connections(p2.identity.public_hex, 5)
+
+        prompts = [(f"req {i:04d} " + "resume the work under fire "
+                    * 64)[:prompt_chars] for i in range(clients)]
+        per_req: list[dict] = []
+
+        async def one(i: int) -> None:
+            client = SymmetryClient(
+                Identity.from_name(f"chaos-cli-{i}"), hub)
+            row = {"completed": False, "resumes": 0, "restarts": 0,
+                   "resumed_tokens": 0, "discarded_tokens": 0,
+                   "recovery_s": []}
+            t_fail = None
+            try:
+                async for item in client.chat_failover(
+                        "mem://chaos-server", ident.public_key,
+                        f"{preset_name}:chaos",
+                        [{"role": "user", "content": prompts[i]}],
+                        max_tokens=max_new, resume=resume_on,
+                        attempts=4, busy_retry_rounds=2):
+                    if isinstance(item, ChatResume):
+                        row["resumes"] += 1
+                        row["resumed_tokens"] += item.resumed_tokens or 0
+                        t_fail = _time.monotonic()
+                    elif isinstance(item, ChatRestart):
+                        row["restarts"] += 1
+                        row["discarded_tokens"] += (
+                            item.discarded_tokens or 0)
+                        t_fail = _time.monotonic()
+                    elif item and t_fail is not None:
+                        row["recovery_s"].append(
+                            _time.monotonic() - t_fail)
+                        t_fail = None
+                row["completed"] = True
+            except ClientError as exc:
+                row["error"] = str(exc)
+            per_req.append(row)
+
+        t0 = _time.monotonic()
+        await asyncio.gather(*[one(i) for i in range(clients)])
+        wall = _time.monotonic() - t0
+        tokens_streamed = sum(p.metrics["tokens_out"] for p in providers)
+        dedup = sum(p.backend.resume_stats["dedup_dropped"]
+                    for p in providers
+                    if hasattr(p.backend, "resume_stats"))
+        for prov in providers:
+            await prov.stop(drain_timeout_s=2)
+        await server.stop()
+        FAULTS.clear()
+        recoveries = [r for row in per_req for r in row["recovery_s"]]
+        discarded = sum(r["discarded_tokens"] for r in per_req)
+        return {
+            "resumption": resume_on,
+            "requests": clients,
+            "completed": sum(r["completed"] for r in per_req),
+            "failed": sum(not r["completed"] for r in per_req),
+            "wall_s": round(wall, 2),
+            "tokens_streamed": tokens_streamed,
+            "resumes": sum(r["resumes"] for r in per_req),
+            "restarts": sum(r["restarts"] for r in per_req),
+            "resumed_tokens": sum(r["resumed_tokens"] for r in per_req),
+            # Wasted work = tokens generated then thrown away: discarded
+            # partials (restart path) + overlap the relay dedup dropped
+            # (resume path) — regenerated − resumed, per the Round-14
+            # protocol.
+            "wasted_tokens": discarded + dedup,
+            "discarded_tokens": discarded,
+            "dedup_dropped_tokens": dedup,
+            "recovery_s": {"n": len(recoveries),
+                           "p50": pct(recoveries, 50),
+                           "p99": pct(recoveries, 99),
+                           "mean": (round(statistics.mean(recoveries), 4)
+                                    if recoveries else None)},
+        }
+
+    async def main() -> dict:
+        arms = {}
+        for resume_on in (True, False):
+            label = "resume" if resume_on else "restart"
+            print(f"[chaos] arm {label}: {clients} clients, seam {seam}",
+                  file=sys.stderr)
+            arms[label] = await run_arm(resume_on)
+            print(f"[chaos] arm {label}: "
+                  f"{arms[label]['completed']}/{clients} completed, "
+                  f"wasted {arms[label]['wasted_tokens']} tok, "
+                  f"resumed {arms[label]['resumed_tokens']} tok",
+                  file=sys.stderr)
+        saved = (arms["restart"]["wasted_tokens"]
+                 - arms["resume"]["wasted_tokens"])
+        return {
+            "kind": "chaos",
+            "preset": preset_name,
+            "clients": clients, "slots": slots, "max_new": max_new,
+            "seam": seam,
+            "arms": arms,
+            # The robustness headline: wasted-work tokens the resume
+            # path saved vs shed-and-retry, at identical kill schedules.
+            "wasted_tokens_saved": saved,
+        }
+
+    return asyncio.new_event_loop().run_until_complete(main())
+
+
 def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             prompt_chars: int, max_seq: int, dtype_name: str, block: int,
             quant: str | None, kv_quant: bool, bucket: int,
@@ -1475,6 +1663,23 @@ def main() -> None:
                          "2x2-vs-1x1 row schema of the BASELINE.md "
                          "pool protocol. Transport from "
                          "--disagg-transport (memory default)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="kill-under-load robustness bench: arm "
+                         "--chaos-seam on provider 1's engine host, run "
+                         "the client fleet through chat_failover with "
+                         "stream resumption ON then OFF, and report "
+                         "wasted-work tokens (regenerated − resumed) "
+                         "plus post-kill recovery latency per arm "
+                         "(BASELINE.md Round 14). Sized small by "
+                         "default (8 clients × 64 tok); --clients/"
+                         "--max-new/--preset rescale it")
+    ap.add_argument("--chaos-seam", default="host.pipe_write=crash@nth=12",
+                    metavar="SEAM=ACTION@TRIGGER",
+                    help="the fault armed on provider 1's host for "
+                         "--chaos (utils/faults.py grammar). The default "
+                         "crash lands a few event frames into the first "
+                         "wave at the default chaos shape; retune nth "
+                         "for bigger fleets")
     ap.add_argument("--multi-turn", type=int, default=1, metavar="N",
                     help="conversation workload (--e2e): every client "
                          "runs one N-turn session, re-submitting the "
@@ -1603,6 +1808,17 @@ def main() -> None:
         if pool_mn is None or pool_mn[0] < 1 or pool_mn[1] < 1:
             ap.error("--disagg-pool wants MxN with M,N >= 1 (e.g. 2x2)")
         args.disagg = True  # the pool IS a disagg topology
+    if args.chaos:
+        # Chaos-mode defaults: a recovery drill, not a throughput run —
+        # small fleet, short streams, the default seam's nth tuned to
+        # land mid-first-wave at exactly this shape.
+        args.clients = args.clients if args.clients is not None else 8
+        args.slots = args.slots if args.slots is not None else 4
+        args.max_new = args.max_new if args.max_new is not None else 64
+        args.prompt_len = (args.prompt_len if args.prompt_len is not None
+                           else 128)
+        args.max_seq = (args.max_seq if args.max_seq is not None
+                        else 384)
     if args.clients is None:
         args.clients = (32 if args.multi_turn > 1
                         else 96 if (args.shared_prefix or args.speculative)
@@ -1679,6 +1895,14 @@ def main() -> None:
         result = run_bench("tiny", slots=2, steps=8, prompt_len=16,
                            max_seq=64, dtype_name="float32", mesh_model=1,
                            block=2)
+    elif args.chaos:
+        result = run_chaos(
+            args.preset, clients=args.clients, slots=args.slots,
+            max_new=args.max_new,
+            prompt_chars=max(1, args.prompt_len - 24),
+            max_seq=args.max_seq, dtype_name=args.dtype,
+            block=args.block, bucket=args.prompt_len,
+            seam=args.chaos_seam)
     elif args.engine:
         result = engine_bench()
     elif args.proxy:

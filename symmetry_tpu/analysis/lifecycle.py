@@ -125,6 +125,19 @@ SPECS: tuple[ResourceSpec, ...] = (
         receiver_hint="lock",
         releases=(ReleaseSpec(frozenset({"release"}), idempotent=False),),
     ),
+    ResourceSpec(
+        # The per-stream resume journal entry (provider/backends/base.py
+        # ResumeJournal): track() on admission, release() on EVERY exit
+        # path — exception edges included. A leaked entry is a finished
+        # request the death path would stamp `emitted` for forever (and
+        # an unbounded dict on a busy provider); an early release is a
+        # crash shed that stamps 0 and costs the client its RNG-lane
+        # anchor.
+        name="resume-journal",
+        acquire=frozenset({"track"}),
+        receiver_hint="journal",
+        releases=(ReleaseSpec(frozenset({"release"}), idempotent=True),),
+    ),
 )
 
 _ALL_ACQUIRES = frozenset().union(*(s.acquire for s in SPECS))

@@ -42,6 +42,23 @@ class ProviderGoneError(ClientError):
     burn the pool on a deterministically-bad request."""
 
 
+class ProviderDiedMidStreamError(ProviderGoneError):
+    """The provider died AFTER streaming part of the completion. Carries
+    everything a resume needs: the text deltas the client already holds
+    (`emitted_text` — authoritative: TCP ordering guarantees it is
+    exactly the prefix the provider relayed) and the emitted TOKEN count
+    when the wire managed to stamp one (`emitted_tokens`; None when the
+    connection just dropped — the resume path then lets the serving host
+    re-derive the count from the text). chat_failover turns this into a
+    `resume` request instead of regenerating from token 0."""
+
+    def __init__(self, message: str, emitted_text: str = "",
+                 emitted_tokens: int | None = None) -> None:
+        super().__init__(message)
+        self.emitted_text = emitted_text
+        self.emitted_tokens = emitted_tokens
+
+
 class ProviderBusyError(ClientError):
     """The provider shed the request before serving it (its backlog is
     over queue_limit) — retryable on ANOTHER provider: nothing streamed,
@@ -69,9 +86,27 @@ class ProviderRestartingError(ProviderBusyError):
     from the pool as a corpse)."""
 
     def __init__(self, message: str, retry_after_s: float | None = None,
-                 **kw) -> None:
+                 emitted_text: str = "",
+                 emitted_tokens: int | None = None, **kw) -> None:
         super().__init__(message, **kw)
         self.retry_after_s = retry_after_s
+        # Mid-stream restarting sheds carry what already streamed, same
+        # contract as ProviderDiedMidStreamError: the structured shed
+        # frame stamps the provider's EXACT relayed-token count (what
+        # this client holds — TCP ordering), so a seeded resume restores
+        # its RNG lane to the right position. The engine host's journal
+        # rides separately as emittedEngine when it exceeds the relayed
+        # count (tokens that died on the pipe — lost work, not resume
+        # state).
+        self.emitted_text = emitted_text
+        self.emitted_tokens = emitted_tokens
+
+
+class ResumeRefusedError(ClientError):
+    """The provider refused to RESUME (its backend regenerates from
+    scratch — splicing would duplicate the completion). The request
+    itself is fine: chat_failover falls back to one from-scratch
+    restart instead of failing the call."""
 
 
 class DeadlineExceededError(ClientError):
@@ -97,7 +132,15 @@ def busy_retry_backoff(queue_depth: int | None, queue_limit: int | None,
     jittered wait, never multiplied into it: retrying before the hint is
     guaranteed to be shed again, and jittering the hint downward would
     do exactly that — so everyone waits at least the hint, desynchronized
-    beyond it."""
+    beyond it.
+
+    When the shed DID carry a hint, the per-round doubling is clamped to
+    the round-0 base: the hint already encodes how long the provider
+    needs (its own respawn backoff), and doubling our base on top of it
+    would amplify a restarting provider's honest estimate into a wait
+    that grows with OUR retry count — a resume round after a mid-stream
+    crash must honor the hint, not punish it (the doubling exists for
+    hint-LESS busy sheds, where depth is the only signal we have)."""
     depth = queue_depth or 0
     limit = queue_limit or 0
     over = depth / limit if limit > 0 else 1.0
@@ -105,8 +148,9 @@ def busy_retry_backoff(queue_depth: int | None, queue_limit: int | None,
     # become a stall of our own) and the per-round doubling has its own
     # ceiling (×16) for the same reason — a caller asking for many retry
     # rounds gets persistence, not quarter-hour sleeps.
-    base = (min(2.0, 0.25 * (1.0 + over))
-            * (2 ** min(max(0, round_idx), 4)))
+    doubling = 1 if retry_after_s is not None else (
+        2 ** min(max(0, round_idx), 4))
+    base = min(2.0, 0.25 * (1.0 + over)) * doubling
     wait = base * (0.5 + rand())
     if retry_after_s is not None:
         wait += float(retry_after_s)
@@ -128,10 +172,28 @@ class ProviderDetails:
 @dataclass(slots=True)
 class ChatRestart:
     """Failover marker: a new provider took over and generation restarted —
-    everything streamed before this event must be discarded."""
+    everything streamed before this event must be discarded.
+    `discarded_tokens` is the emitted-token count of the voided partial
+    (None when no attempt stamped one) — the wasted-work numerator the
+    chaos bench compares against the resume path's."""
 
     attempt: int
     provider_key: str
+    discarded_tokens: int | None = None
+
+
+@dataclass(slots=True)
+class ChatResume:
+    """Failover marker: a new provider took over and generation RESUMED
+    from the last token the client received — everything streamed before
+    this event is still valid, and the deltas that follow splice onto it
+    (token-identical to an uninterrupted run for greedy and seeded
+    sampling). `resumed_tokens` is how many already-streamed tokens the
+    resume skipped regenerating — the wasted-work the resume path saved."""
+
+    attempt: int
+    provider_key: str
+    resumed_tokens: int | None = None
 
 
 class ProviderSession:
@@ -249,9 +311,22 @@ class ProviderSession:
         speculative: bool | None = None,
         trace_id: str | None = None,
         deadline_s: float | None = None,
+        resume_text: str | None = None,
+        resume_tokens: int | None = None,
     ) -> AsyncIterator[str]:
         """Send one inference request; yield text deltas as they stream.
         Safe to call concurrently on one session (requestId multiplexing).
+
+        `resume_text` marks this chat as a RESUME of an interrupted
+        stream: the provider continues generation from the end of that
+        text (conditioning on prompt + resume_text through its prefix
+        cache) instead of regenerating it, and yields only the
+        continuation. `resume_tokens` is the emitted-token count the
+        text represents (from the shed's stamped journal count) — it
+        positions a seeded request's RNG lane; None lets the serving
+        host re-derive it from the text. A mid-stream failure raises
+        ProviderDiedMidStreamError / ProviderRestartingError carrying
+        the deltas yielded so far, so the caller can resume elsewhere.
 
         Every chat carries a trace id (minted here unless the caller
         brings one): the provider threads it through its backend and the
@@ -278,6 +353,10 @@ class ProviderSession:
                      ("deadline_s", deadline_s)):
             if v is not None:
                 payload[k] = v
+        if resume_text is not None:
+            payload["resume"] = {"text": resume_text,
+                                 **({"tokens": int(resume_tokens)}
+                                    if resume_tokens is not None else {})}
         self._ensure_reader()
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[req_id] = queue
@@ -285,6 +364,25 @@ class ProviderSession:
         t_send = time.monotonic()
         t_first: float | None = None
         n_deltas = 0
+        # Everything yielded so far, for the resume path: a mid-stream
+        # death's error carries it, and the caller splices a continuation
+        # onto it instead of discarding the work.
+        emitted_parts: list[str] = []
+
+        def _mid_stream(exc: ClientError) -> ClientError:
+            """Attach the emitted state to a mid-stream retryable. A
+            pre-first-delta failure stays the plain class (nothing to
+            resume)."""
+            if not emitted_parts:
+                return exc
+            if isinstance(exc, ProviderRestartingError):
+                exc.emitted_text = "".join(emitted_parts)
+                return exc
+            if isinstance(exc, ProviderGoneError):
+                return ProviderDiedMidStreamError(
+                    str(exc), emitted_text="".join(emitted_parts))
+            return exc
+
         try:
             await self._peer.send(MessageKey.INFERENCE, payload)
             dialect = self._details.provider_dialect
@@ -292,8 +390,8 @@ class ProviderSession:
                 msg = await queue.get()
                 if msg is None:
                     ended = True  # wire gone; nothing left to misroute
-                    raise ProviderGoneError(
-                        "provider closed connection mid-stream")
+                    raise _mid_stream(ProviderGoneError(
+                        "provider closed connection mid-stream"))
                 if msg.key == MessageKey.INFERENCE:
                     # stream-start marker; carries the backend dialect —
                     # and the provider's monotonic stamp, bracketed by our
@@ -321,6 +419,7 @@ class ProviderSession:
                                 "client_ttft", t_send, t_first - t_send,
                                 request_id=req_id, trace_id=trace_id)
                         n_deltas += 1
+                        emitted_parts.append(delta)
                         yield delta
                 elif msg.key == MessageKey.INFERENCE_ENDED:
                     ended = True
@@ -329,13 +428,19 @@ class ProviderSession:
                         # provider-side cancellation (shutdown/drain): a
                         # truncated stream must look like provider death —
                         # retryable — not a normal completion
-                        raise ProviderGoneError(
-                            "provider cancelled the stream")
+                        raise _mid_stream(ProviderGoneError(
+                            "provider cancelled the stream"))
                     self.last_usage = data
                     return
                 elif msg.key == MessageKey.INFERENCE_ERROR:
                     ended = True
                     data = msg.data or {}
+                    if data.get("resumeUnsupported"):
+                        # Structured resume refusal (proxy backend):
+                        # typed so failover can fall back to a restart
+                        # without guessing from the message text.
+                        raise ResumeRefusedError(
+                            data.get("error", "resume not supported"))
                     if data.get("expired"):
                         # Deadline shed: terminal, not retryable — nobody
                         # is waiting for this answer anymore.
@@ -344,12 +449,18 @@ class ProviderSession:
                     if data.get("restarting"):
                         # Engine-host crash/wedge, supervisor respawning:
                         # retryable — fail over now, optionally come back
-                        # after retryAfterS.
-                        raise ProviderRestartingError(
+                        # after retryAfterS. Mid-stream sheds stamp the
+                        # relayed-token count ("emitted", journal-fed) so
+                        # the resume can restore a seeded RNG lane.
+                        emitted = data.get("emitted")
+                        raise _mid_stream(ProviderRestartingError(
                             data.get("error", "provider restarting"),
                             retry_after_s=data.get("retryAfterS"),
+                            emitted_tokens=(int(emitted)
+                                            if isinstance(emitted, int)
+                                            else None),
                             queue_depth=data.get("queueDepth"),
-                            queue_limit=data.get("queueLimit"))
+                            queue_limit=data.get("queueLimit")))
                     if data.get("busy"):
                         # Structured shed (provider over queue_limit, or
                         # draining): distinguishable so failover retries
@@ -542,17 +653,30 @@ class SymmetryClient:
         *,
         attempts: int = 3,
         busy_retry_rounds: int = 1,
+        resume: bool = True,
         **chat_kw,
-    ) -> AsyncIterator[str | "ChatRestart"]:
-        """Streaming chat with provider failover.
+    ) -> AsyncIterator[str | "ChatRestart" | "ChatResume"]:
+        """Streaming chat with provider failover and mid-stream RESUME.
 
-        If the assigned provider dies before the stream completes, the
+        If the assigned provider dies MID-STREAM (crash, wedge, link cut,
+        pool-member loss — any retryable shed after the first delta), the
+        next attempt issues a `resume` request instead of regenerating:
+        the new provider continues from the last token this client
+        received (conditioning on prompt + received text through its
+        radix prefix cache), a ChatResume sentinel is yielded, and the
+        continuation deltas SPLICE onto what was already yielded —
+        token-identical to an uninterrupted run for greedy and seeded
+        sampling. `resume=False` restores the old discard-and-restart
+        behavior. A provider that refuses the resume (proxy backend, or
+        a history that outgrew its prefill buckets) triggers ONE
+        fallback to a from-scratch restart.
+
+        If the assigned provider dies before anything streamed, the
         server is asked for a FRESH provider (the dead one excluded — its
         sessions were invalidated server-side) and generation restarts.
         A restart yields a ChatRestart sentinel first: text streamed from
-        the dead provider is void and consumers must discard it (a
-        half-finished completion cannot be resumed token-exactly on
-        another node). chat_text_failover does that bookkeeping for you.
+        the dead provider is void and consumers must discard it.
+        chat_text_failover does both bookkeepings for you.
 
         Busy-shed backoff: when busy (or restarting) sheds exhausted the
         pool — the providers are healthy, just over their backlog bound
@@ -572,6 +696,14 @@ class SymmetryClient:
         """
         dead: list[str] = []
         busy: list[str] = []
+        # Resume state: every delta yielded so far (still-valid text once
+        # a resume splices onto it) and its emitted-token count (None
+        # once any failed attempt couldn't stamp one — the serving host
+        # then re-derives the count from the text). `resuming` arms the
+        # NEXT attempt as a resume instead of a restart.
+        acc_parts: list[str] = []
+        acc_tokens: int | None = 0
+        resuming = False
         last_exc: Exception | None = None
         # Tracked separately from last_exc: pool exhaustion surfaces as a
         # plain ClientError from request_provider AFTER the busy shed, so
@@ -607,8 +739,20 @@ class SymmetryClient:
                     pool_exhausted = True
                     break  # no provider left to fail over to
                 if n_tries > 0:
-                    yield ChatRestart(attempt=n_tries,
-                                      provider_key=details.peer_key)
+                    if resuming:
+                        yield ChatResume(attempt=n_tries,
+                                         provider_key=details.peer_key,
+                                         resumed_tokens=acc_tokens)
+                    else:
+                        # From-scratch restart: the partial text is void
+                        # (its token count rides the sentinel — the
+                        # wasted work the resume path exists to save).
+                        discarded = (acc_tokens if acc_parts else None)
+                        acc_parts.clear()
+                        acc_tokens = 0
+                        yield ChatRestart(attempt=n_tries,
+                                          provider_key=details.peer_key,
+                                          discarded_tokens=discarded)
                 n_tries += 1
                 try:
                     # relay_via: a NAT-only provider (direct dial fails,
@@ -620,10 +764,20 @@ class SymmetryClient:
                     if details.peer_key:
                         dead.append(details.peer_key)
                     continue
+                before = len(acc_parts)
                 try:
-                    async for delta in session.chat(messages, **kw):
+                    ckw = kw
+                    if resuming:
+                        ckw = {**kw, "resume_text": "".join(acc_parts),
+                               "resume_tokens": acc_tokens}
+                    async for delta in session.chat(messages, **ckw):
+                        acc_parts.append(delta)
                         yield delta
                     return
+                except DeadlineExceededError:
+                    # Terminal by contract — never converted to a
+                    # restart, resumed, or retried.
+                    raise
                 except (ProviderGoneError, ProviderBusyError,
                         ConnectionError, OSError) as exc:
                     # Provider-death AND busy-shed failures fail over (a
@@ -647,6 +801,44 @@ class SymmetryClient:
                         # will never admit again, so it is excluded like
                         # a corpse and earns no backoff retry round.
                         dead.append(details.peer_key)
+                    if len(acc_parts) > before:
+                        # Streamed something this attempt: fold its
+                        # stamped token count into the running total (a
+                        # missing stamp poisons the count to None — the
+                        # host re-derives it from the text).
+                        et = getattr(exc, "emitted_tokens", None)
+                        acc_tokens = (acc_tokens + int(et)
+                                      if acc_tokens is not None
+                                      and et is not None else None)
+                    # Everything yielded so far (this attempt's deltas
+                    # included) is still valid — the next attempt
+                    # CONTINUES it. The mid-stream provider is already
+                    # excluded above (dead or busy), so the immediate
+                    # resume round lands elsewhere when a peer exists.
+                    resuming = resume and bool(acc_parts)
+                except ClientError as exc:
+                    # A failed RESUME attempt falls back ONCE to a plain
+                    # restart — the next attempt regenerates from token
+                    # 0 after a ChatRestart. Two flavors: the structured
+                    # refusal (ResumeRefusedError — proxy backend,
+                    # expected) and any other resume-time error (e.g.
+                    # prompt+history beyond the host's prefill buckets,
+                    # which only exists because of the resume — the
+                    # original messages already streamed fine once, so
+                    # this is not a deterministically-bad request).
+                    # A non-resume ClientError keeps the old contract
+                    # and propagates.
+                    if not resuming:
+                        raise
+                    if isinstance(exc, ResumeRefusedError):
+                        logger.info(f"resume refused ({exc}); falling "
+                                    f"back to a from-scratch restart")
+                    else:
+                        logger.warning(
+                            f"resume attempt failed ({exc}); falling "
+                            f"back to a from-scratch restart")
+                    last_exc = exc
+                    resuming = False
                 finally:
                     await session.close()
             # Retry only when busy sheds actually ended the round: the
@@ -697,12 +889,16 @@ class SymmetryClient:
                                  model_name: str,
                                  messages: list[dict[str, str]],
                                  **kw) -> str:
-        """chat_failover collected to a final string (restart-aware)."""
+        """chat_failover collected to a final string (restart- and
+        resume-aware: a ChatResume keeps the partial text — the
+        continuation splices onto it; a ChatRestart voids it)."""
         parts: list[str] = []
         async for item in self.chat_failover(server_address, server_key,
                                              model_name, messages, **kw):
             if isinstance(item, ChatRestart):
                 parts.clear()  # the dead provider's partial text is void
+            elif isinstance(item, ChatResume):
+                pass  # spliced continuation: everything so far is valid
             else:
                 parts.append(item)
         return "".join(parts)

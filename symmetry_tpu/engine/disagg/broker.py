@@ -143,7 +143,8 @@ class HandoffBroker:
         it); the elastic pool writes it through reassign() once the
         placed submit is actually delivered."""
         keep = {k: submit[k] for k in
-                ("max_new", "sampling", "speculative", "trace", "deadline_s")
+                ("max_new", "sampling", "speculative", "trace", "deadline_s",
+                 "resume")
                 if k in submit}
         self._pending[request_id] = (keep, time.monotonic(), None)
         self.counters["submitted"] += 1
@@ -246,7 +247,11 @@ class HandoffBroker:
             self.counters["routing_only"] += 1
         op: dict[str, Any] = {"op": HostOp.ADOPT, "id": req_id,
                               "frame": handoff.get("frame")}
-        for k in ("max_new", "sampling", "speculative", "trace"):
+        for k in ("max_new", "sampling", "speculative", "trace", "resume"):
+            # "resume" rides through so the decode tier restores the
+            # RNG lane and token budget of a resumed request (the
+            # emitted tokens themselves already ride the frame — the
+            # prefill tier appended them to the prompt).
             if k in keep:
                 op[k] = keep[k]
         if "deadline_s" in keep:

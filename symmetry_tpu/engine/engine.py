@@ -84,6 +84,21 @@ class SamplingParams:
     top_p: float = 1.0
     top_k: int = 0
     seed: int | None = None
+    # Stream resumption: how many tokens of this request's completion
+    # were already sampled (and streamed) before this admission. For a
+    # SEEDED request the engine fast-forwards the per-slot PRNG chain by
+    # this many draws, so the resumed continuation samples exactly the
+    # tokens the uninterrupted run would have — seeded resumes are
+    # token-identical, not just greedy ones. Ignored without a seed
+    # (unseeded lanes use per-request process entropy, which a new host
+    # cannot reproduce anyway; greedy never consults the RNG).
+    # Caveat: the one-draw-per-token chain holds for the plain decode
+    # path only — a speculative verify dispatch consumes ONE split while
+    # emitting several tokens, so seeded SAMPLED identity under
+    # tpu.speculative is out of scope (it already isn't reproducible
+    # across spec on/off: rejection sampling draws differently); greedy
+    # resumes stay exact everywhere because greedy never reads the lane.
+    rng_skip: int = 0
 
     @classmethod
     def from_request(cls, req: Any) -> "SamplingParams":
@@ -737,6 +752,29 @@ class InferenceEngine:
             insert_all, donate_argnums=(0,),
             out_shardings=state_shard)
 
+        def rng_resume(key, skip):
+            """Fast-forward one request's PRNG chain past `skip` draws
+            (stream resumption): replays the exact split sequence the
+            serving path performs — prefill consumes the first split's
+            key, every decode step re-splits the carry — so the returned
+            (prefill key, decode key) put a resumed seeded request at
+            the same chain position an uninterrupted run would occupy
+            after `skip` sampled tokens. `skip` is DATA (fori_loop trip
+            count), so one compiled program covers every resume depth —
+            no per-length recompile."""
+            pk, dk = jax.random.split(key)
+
+            def body(_, carry):
+                dk, _pk = carry
+                s = jax.random.split(dk)
+                return s[0], s[1]
+
+            dk, pk = jax.lax.fori_loop(0, skip, body, (dk, pk))
+            return pk, dk
+
+        # Scalar key program, mesh-independent (keys are replicated).
+        self._rng_resume = jax.jit(rng_resume)
+
     # ------------------------------------------------------------------
     # Host-side API (called by the scheduler's engine thread)
 
@@ -776,9 +814,20 @@ class InferenceEngine:
         reproduce their whole completion; unseeded ones get per-request
         entropy. ONE derivation shared by every admission path, so a
         seeded request samples identically whether it was admitted via
-        full prefill, chunked prefill, or a prefix-cache hit."""
+        full prefill, chunked prefill, or a prefix-cache hit.
+
+        `rng_skip` (stream resumption) fast-forwards a SEEDED request's
+        chain past the draws its interrupted run already made: the
+        uninterrupted run samples token 1 from the prefill key and token
+        i+1 from the i-th decode split, so a resume after N emitted
+        tokens needs prefill key = the N-th step key and decode key =
+        the N-th carry — exactly what _rng_resume walks to."""
+        skip = max(0, int(sampling.rng_skip or 0))
         if sampling.seed is not None:
             key = jax.random.key(sampling.seed)
+            if skip:
+                pk, dk = self._rng_resume(key, skip)
+                return pk, dk
         else:
             self._requests_served += 1
             key = jax.random.fold_in(self._base_key, self._requests_served)
@@ -1392,6 +1441,10 @@ class InferenceEngine:
         full set ("decode" has the prefix store on by contract, so the
         adoption seed-copy shapes are always covered)."""
         decode_side = self.role != "prefill"
+        # The resume RNG fast-forward (scalar key program, one compile
+        # covers every resume depth): warm it so the first mid-stream
+        # recovery under load never pays a fresh XLA compile.
+        self._rng_resume(jax.random.key(0), 0)
         if decode_side:
             self.state, _ = self._decode(self.params, self.state)
         for bucket in self.prefill_buckets:

@@ -263,6 +263,16 @@ class EngineHost:
             out["t"] = {k: round(v, 4) for k, v in ev.stages.items()
                         if v is not None}
             out["t"]["out"] = round(time.monotonic(), 4)
+        if ev.tokens_reused is not None:
+            # First-event rider: radix tokens the admission reused
+            # (resume admissions assert > 0 — the cheap-resume contract).
+            out["reused"] = ev.tokens_reused
+        if ev.resumed_from is not None:
+            # Resume continuation start offset, in the client's token
+            # numbering — the relay drops any overlap below the client's
+            # own count (offset dedup: a resume never replays tokens the
+            # client already has).
+            out["resume_from"] = ev.resumed_from
         if ev.done:
             out["done"] = True
             out["finish_reason"] = ev.finish_reason
@@ -385,6 +395,17 @@ class EngineHost:
                 # for a stats read.
                 m["emit"] = dict(self.emit_stats)
                 m["role"] = self._role
+                # Per-request emitted-token journal rider: the tokens
+                # each live stream has had WRITTEN to the pipe. The
+                # backend's supervisor keeps the last heartbeat's copy,
+                # so a crash/wedge shed stamps an accurate `emitted`
+                # count even for frames the relay never got to read —
+                # the resume path's RNG-lane position. Tiny by
+                # construction (one int per in-flight request). Listed
+                # keys first: the engine thread mutates _reported
+                # concurrently and iteration must not race a resize.
+                m["journal"] = {k: self._reported.get(k, 0)
+                                for k in list(self._reported)}
                 if self._role == "prefill":
                     m["handoff"] = {**self.handoff_stats,
                                     "serialize_s": round(
@@ -444,20 +465,48 @@ class EngineHost:
         req_id = str(msg.get("id", ""))
         trace_id = str(msg.get("trace") or "")
         s = msg.get("sampling") or {}
-        sampling = SamplingParams(
-            temperature=float(s.get("temperature", 0.0)),
-            top_p=float(s.get("top_p", 1.0)),
-            top_k=int(s.get("top_k", 0)),
-            seed=s.get("seed"),
-        )
+        resume = msg.get("resume") if isinstance(msg.get("resume"), dict) \
+            else None
+        max_new = int(msg.get("max_new", 512))
+        resume_offset = 0
         try:
             prompt_ids = self._engine.tokenizer.apply_chat_template(
                 msg.get("messages") or [])
+            # Stream resumption (resolve_resume, tokenizer.py — ONE
+            # implementation across every admission path): condition on
+            # prompt + the emitted text the client already holds,
+            # generate only the continuation. The emitted run re-enters
+            # through the ordinary admission path — prompt+emitted
+            # blocks hit the radix cache (only the unaligned tail
+            # re-prefills) and the seed path treats it like any other
+            # prompt; the resolved offset positions a seeded request's
+            # RNG lane and offsets the token budget.
+            from symmetry_tpu.engine.tokenizer import resolve_resume
+
+            prompt_ids, max_new, resume_offset = resolve_resume(
+                self._engine.tokenizer, resume, prompt_ids, max_new)
         except Exception as exc:  # noqa: BLE001 — tokenizer failure → event
             self._write({"op": HostOp.EVENT, "id": req_id, "text": "",
                          "done": True, "finish_reason": "error",
                          "error": f"tokenization failed: {exc}"}, events=1)
             return
+        if resume is not None and max_new == 0:
+            # The interrupted stream had already spent the whole token
+            # budget — only the finish frame was lost. Complete NOW
+            # (finish "length", zero new tokens) instead of generating
+            # past the client's max_tokens.
+            self._write({"op": HostOp.EVENT, "id": req_id, "text": "",
+                         "done": True, "finish_reason": "length",
+                         "tokens": resume_offset, "tokens_new": 0,
+                         "resume_from": resume_offset}, events=1)
+            return
+        sampling = SamplingParams(
+            temperature=float(s.get("temperature", 0.0)),
+            top_p=float(s.get("top_p", 1.0)),
+            top_k=int(s.get("top_k", 0)),
+            seed=s.get("seed"),
+            rng_skip=resume_offset,
+        )
         if self._role == "prefill":
             pb = self._engine.prefix_block or 0
             if pb and (len(prompt_ids) - 1) // pb == 0:
@@ -480,12 +529,13 @@ class EngineHost:
         deadline = msg.get("deadline_s")
         self._scheduler.submit(GenRequest(
             prompt_ids=prompt_ids, sampling=sampling,
-            max_new_tokens=int(msg.get("max_new", 512)),
+            max_new_tokens=max_new,
             emit=emit,
             cancelled=lambda: req_id in self._cancelled,
             id=req_id,
             speculative=spec if isinstance(spec, bool) else None,
             trace_id=trace_id,
+            resume_offset=resume_offset,
             # deadline_s is RELATIVE (seconds left at provider submit);
             # anchor it to this process's clock at receipt so the
             # scheduler's admission check needs no cross-process offset.
@@ -680,11 +730,48 @@ class EngineHost:
                 self._m_adopt_frames.inc(outcome="routing_only")
 
         s = msg.get("sampling") or {}
+        resume = msg.get("resume") if isinstance(msg.get("resume"), dict) \
+            else None
+        max_new = int(msg.get("max_new", 512))
+        resume_offset = 0
+        if resume is not None:
+            try:
+                # A resumed migration: the emitted tokens already ride
+                # the frame (the prefill tier appended them to the
+                # prompt), so the resolved ids are discarded — this
+                # tier only restores the RNG lane position and the
+                # remaining token budget (resolve_resume: the shared
+                # implementation; a negative claim fails this one
+                # request, never the loop).
+                from symmetry_tpu.engine.tokenizer import resolve_resume
+
+                _, max_new, resume_offset = resolve_resume(
+                    self._engine.tokenizer, resume, [], max_new)
+            except Exception as exc:  # noqa: BLE001 — bad resume → event
+                with self._wlock:
+                    self.adopt_stats["errors"] += 1
+                self._m_adopt_frames.inc(outcome="error")
+                self._write({"op": HostOp.EVENT, "id": req_id,
+                             "text": "", "done": True,
+                             "finish_reason": "error",
+                             "error": f"handoff adoption failed: {exc}"},
+                            events=1)
+                return
+            if resume is not None and max_new == 0:
+                # Budget already spent by the interrupted stream — only
+                # the finish frame was lost; complete without admitting.
+                self._write({"op": HostOp.EVENT, "id": req_id,
+                             "text": "", "done": True,
+                             "finish_reason": "length",
+                             "tokens": resume_offset, "tokens_new": 0,
+                             "resume_from": resume_offset}, events=1)
+                return
         sampling = SamplingParams(
             temperature=float(s.get("temperature", 0.0)),
             top_p=float(s.get("top_p", 1.0)),
             top_k=int(s.get("top_k", 0)),
             seed=s.get("seed"),
+            rng_skip=resume_offset,
         )
         self._reported[req_id] = 0
 
@@ -699,12 +786,13 @@ class EngineHost:
             # Filled by the adopt thunk from the frame's tokens at
             # admission pick (the whole frame parse runs there).
             prompt_ids=[], sampling=sampling,
-            max_new_tokens=int(msg.get("max_new", 512)),
+            max_new_tokens=max_new,
             emit=emit,
             cancelled=lambda: req_id in self._cancelled,
             id=req_id,
             speculative=spec if isinstance(spec, bool) else None,
             trace_id=trace_id,
+            resume_offset=resume_offset,
             adopt=adopt,
             # Rebased by the broker for prefill-tier time already spent;
             # may arrive negative — the scheduler then sheds "expired".

@@ -94,6 +94,37 @@ class StreamDecoder:
         return text[len(prefix_text):]
 
 
+def resolve_resume(tokenizer: Tokenizer, resume: dict | None,
+                   prompt_ids: list[int], max_new: int
+                   ) -> tuple[list[int], int, int]:
+    """ONE implementation of resume-request resolution, shared by every
+    admission path (host submit, decode-tier adopt, in-process backend —
+    divergent copies already disagreed once on negative-count handling):
+    returns (prompt_ids + re-encoded emitted continuation, remaining
+    token budget, resume offset). The client's claimed token count wins
+    (it positions the seeded RNG lane exactly); the re-encoded length
+    stands in when the shed couldn't stamp one. Raises ValueError on a
+    negative claim — a malformed resume must be rejected, not inflate
+    the budget past the client's max_tokens.
+
+    A remaining budget of ZERO is meaningful: the interrupted stream had
+    already emitted the whole max_tokens budget (the crash ate only the
+    finish frame). Callers must then complete the request immediately
+    with finish_reason "length" and no new tokens — flooring to 1 here
+    would generate one token past the client's budget and break
+    token-identity with the uninterrupted run (which stopped exactly at
+    max_tokens)."""
+    if not isinstance(resume, dict):
+        return prompt_ids, max_new, 0
+    text = str(resume.get("text") or "")
+    emitted_ids = tokenizer.encode(text, bos=False) if text else []
+    claimed = resume.get("tokens")
+    offset = int(claimed) if claimed is not None else len(emitted_ids)
+    if offset < 0:
+        raise ValueError(f"resume tokens {offset} < 0")
+    return prompt_ids + emitted_ids, max(0, max_new - offset), offset
+
+
 class ByteTokenizer(Tokenizer):
     """ids 0-255 = raw bytes; 256 = BOS; 257 = EOS; ids >= 258 decode to
     byte (id % 256). vocab defaults to 258 (fits `tiny`).

@@ -47,6 +47,16 @@ class InferenceRequest:
     # sheds an already-expired request at admission instead of prefilling
     # work nobody is waiting for. None = no deadline.
     deadline_s: float | None = None
+    # Stream resumption (client "resume" payload): `resume_text` is the
+    # completion prefix the client already received from a provider that
+    # died mid-stream — the backend continues generation from its end
+    # (conditioning on prompt + resume_text, radix-cache-seeded on the
+    # engine) and yields ONLY the continuation. `resume_tokens` is the
+    # emitted-token count that text represents (positions a seeded
+    # request's RNG lane); None lets the engine re-derive it from the
+    # text. None resume_text = an ordinary request.
+    resume_text: str | None = None
+    resume_tokens: int | None = None
 
 
 @dataclass(slots=True)
@@ -63,10 +73,85 @@ class StreamChunk:
     tokens: int | None = None
 
 
+class ResumeJournal:
+    """Per-request emitted-token journal: the backend's record of how
+    many tokens each in-flight stream has relayed, so a crash/wedge/
+    link-loss shed can stamp an ACCURATE `emitted` count into its
+    structured error — the count a seeded resume uses to restore its
+    RNG lane position. Tracked per stream via a handle (acquire on
+    admission, release on every exit path — the lifecycle-checker
+    contract: a leaked handle is a request the death path would stamp
+    forever after it finished). The engine host's own journal (the
+    stats-heartbeat rider) is merged in as a lower bound for streams
+    whose frames died on the pipe.
+
+    Single-event-loop discipline: every mutation happens on the
+    provider's loop (stream tasks, reader tasks, death paths), so no
+    lock is needed — same ownership argument as the backend queues."""
+
+    def __init__(self) -> None:
+        self._emitted: dict[str, int] = {}
+
+    def track(self, request_id: str) -> "ResumeJournalHandle":
+        """Open the journal entry for one stream; the returned handle
+        must be released on every exit path."""
+        self._emitted.setdefault(request_id, 0)
+        return ResumeJournalHandle(self, request_id)
+
+    def note(self, request_id: str, tokens: int) -> None:
+        if tokens and request_id in self._emitted:
+            self._emitted[request_id] += int(tokens)
+
+    def merge(self, counts: dict | None) -> None:
+        """Fold the engine host's heartbeat journal in (host-side counts
+        of tokens WRITTEN to the pipe): for a tracked stream the larger
+        count wins — frames the relay never saw still happened, and the
+        shed must not understate what the engine emitted. (The resume
+        itself always conditions on the CLIENT's text; this count is the
+        shed's observability stamp and the wasted-work numerator.)"""
+        if not isinstance(counts, dict):
+            return
+        for req_id, n in counts.items():
+            key = str(req_id)
+            if key in self._emitted and isinstance(n, int):
+                self._emitted[key] = max(self._emitted[key], n)
+
+    def get(self, request_id: str) -> int:
+        return self._emitted.get(request_id, 0)
+
+    def release(self, request_id: str) -> None:
+        self._emitted.pop(request_id, None)
+
+
+class ResumeJournalHandle:
+    """One stream's journal entry. note() folds relayed tokens in;
+    release() closes the entry (idempotent — the death path may have
+    already stamped and the stream's finally still runs)."""
+
+    __slots__ = ("_journal", "_request_id")
+
+    def __init__(self, journal: ResumeJournal, request_id: str) -> None:
+        self._journal = journal
+        self._request_id = request_id
+
+    def note(self, tokens: int) -> None:
+        self._journal.note(self._request_id, tokens)
+
+    def release(self) -> None:
+        self._journal.release(self._request_id)
+
+
 class InferenceBackend(abc.ABC):
     """A source of streamed completions."""
 
     name: str = "?"
+    # Stream resumption support: True when stream() honors
+    # InferenceRequest.resume_text (continues from its end, yields only
+    # the continuation). The provider REFUSES resume requests against a
+    # backend that would regenerate from scratch — the client would
+    # splice a full completion onto its partial text — with a structured
+    # error the client turns into a from-scratch restart.
+    supports_resume: bool = False
     # Admission capacity. `slots` = requests served concurrently without
     # queueing (engine decode slots); `queue_limit` = total in-flight
     # (serving + queued) beyond which the provider sheds new inference
@@ -116,9 +201,15 @@ class BackendRestartingError(BackendError):
     (client.ProviderRestartingError joins the busy-shed backoff path)."""
 
     def __init__(self, message: str,
-                 retry_after_s: float | None = None) -> None:
+                 retry_after_s: float | None = None,
+                 emitted: int | None = None) -> None:
         super().__init__(message)
         self.retry_after_s = retry_after_s
+        # Journal-stamped emitted-token count for the dying stream (None
+        # when nothing streamed / unknown): the provider folds it into
+        # the structured shed so the client's resume knows its RNG lane
+        # position even when its own per-chunk counting is incomplete.
+        self.emitted = emitted
 
 
 class BackendDeadlineError(BackendError):

@@ -28,6 +28,11 @@ from symmetry_tpu.utils.trace import Tracer
 
 class EchoBackend(InferenceBackend):
     name = "echo"
+    # The echo is deterministic, so resumption is exact: the completion
+    # IS the last user message, and a resume just skips the word-chunks
+    # the client already holds — the protocol-level resume drill with no
+    # TPU and no subprocess (chaos smoke, failover tests).
+    supports_resume = True
 
     def __init__(self, delay_s: float = 0.0) -> None:
         self._delay = delay_s
@@ -45,16 +50,39 @@ class EchoBackend(InferenceBackend):
                 last_user = m.get("content", "")
                 break
         words = last_user.split(" ") or [""]
+        # Resume: skip the chunks whose cumulative text the client
+        # already received (one word ≈ one token here); yield only the
+        # continuation. Skipping is by CHARACTER COUNT, trusting the
+        # caller's resume_text to be the prefix it claims — a
+        # wrong-content text of the same length yields the canonical
+        # completion from that offset, not a splice onto the caller's
+        # text (fine for the protocol drill this backend exists for).
+        skip_chars = len(request.resume_text or "")
+        emitted = 0
+        n_words = 0
         for i, word in enumerate(words):
             token = word if i == 0 else " " + word
+            if skip_chars >= len(token):
+                skip_chars -= len(token)
+                n_words += 1
+                continue
+            if skip_chars:
+                # Resume boundary inside a chunk: yield only the unseen
+                # tail — the client splices text, so replaying received
+                # characters would duplicate them.
+                token = token[skip_chars:]
+                skip_chars = 0
             chunk = {
                 "object": "chat.completion.chunk",
                 "model": "echo",
                 "choices": [{"index": 0, "delta": {"content": token}}],
             }
-            yield StreamChunk(raw=f"data: {json.dumps(chunk)}", text=token)
+            yield StreamChunk(raw=f"data: {json.dumps(chunk)}", text=token,
+                              tokens=1)
+            emitted += 1
             if self._delay:
                 await asyncio.sleep(self._delay)
         self.tracer.record("echo_stream", t0, time.monotonic() - t0,
-                           trace_id=request.trace_id, tokens=len(words))
+                           trace_id=request.trace_id, tokens=emitted,
+                           resumed_from=n_words)
         yield StreamChunk(raw="data: [DONE]", text="", done=True)

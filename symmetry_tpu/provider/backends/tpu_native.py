@@ -25,6 +25,7 @@ from symmetry_tpu.provider.backends.base import (
     BackendRestartingError,
     InferenceBackend,
     InferenceRequest,
+    ResumeJournal,
     StreamChunk,
 )
 from symmetry_tpu.utils.faults import FAULTS
@@ -89,6 +90,10 @@ class TpuNativeBackend(InferenceBackend):
     """
 
     name = "tpu_native"
+    # Stream resumption: the host's resume admission continues generation
+    # from the client's received text (radix-cache-seeded), so a resume
+    # against this backend yields only the continuation.
+    supports_resume = True
 
     def __init__(self, config: Any) -> None:
         self._config = config
@@ -228,6 +233,15 @@ class TpuNativeBackend(InferenceBackend):
         # its job (one pipe read fans out a whole decode block).
         self.relay_stats = {"host_frames": 0, "host_events": 0,
                             "host_batched_frames": 0}
+        # Stream resumption: the per-request emitted-token journal (what
+        # each live stream has relayed — the death paths stamp `emitted`
+        # from it into their restarting sheds, so a seeded resume knows
+        # its RNG lane position) plus the relay-side resume ledger. The
+        # host's own journal (stats-heartbeat "journal" rider) is merged
+        # in as a lower bound each heartbeat.
+        self._journal = ResumeJournal()
+        self.resume_stats = {"resumes": 0, "resumed_tokens": 0,
+                             "reused_tokens": 0, "dedup_dropped": 0}
         # Per-stage TTFT attribution (round-4 task #3: the ~2 s
         # engine→provider hop): each first event carries the host's
         # monotonic stage stamps ("t" field), and this side closes the
@@ -256,6 +270,9 @@ class TpuNativeBackend(InferenceBackend):
             MetricName.RELAY_HOST_FRAMES, "host-pipe frames relayed")
         self._m_host_events = METRICS.counter(
             MetricName.RELAY_HOST_EVENTS, "token events relayed")
+        self._m_resume_wasted = METRICS.counter(
+            MetricName.RESUME_WASTED_TOKENS,
+            "overlap tokens the relay's resume offset-dedup dropped")
 
     def attach_slo_monitor(self, monitor: Any) -> None:
         """Provider hook: hand this backend the live SLO burn-rate
@@ -551,7 +568,9 @@ class TpuNativeBackend(InferenceBackend):
     def _shed_request(self, req_id: str, error: str) -> None:
         """One in-flight request → the structured RETRYABLE restarting
         shed (clients fail over / retry; the link or tier that failed
-        is already recovering)."""
+        is already recovering). Stamped with the journal's emitted
+        count, so pool re-placement and link-loss sheds carry the same
+        resume anchor the supervisor's crash sheds do."""
         self._broker.forget(req_id)
         if self._pool is not None:
             self._pool.note_done(req_id)
@@ -560,7 +579,9 @@ class TpuNativeBackend(InferenceBackend):
         if q is not None:
             q.put_nowait({"op": HostOp.EVENT, "id": req_id, "text": "",
                           "done": True, "finish_reason": "error",
-                          "restarting": True, "error": error})
+                          "restarting": True,
+                          "emitted": self._journal.get(req_id),
+                          "error": error})
 
     def _link_fail(self, req_id: str, reason: str) -> None:
         self._shed_request(
@@ -876,6 +897,11 @@ class TpuNativeBackend(InferenceBackend):
             burn = (self._slo_monitor.burn_rate()
                     if self._slo_monitor is not None else None)
             for m, msg in zip(decode, replies[:len(decode)]):
+                if isinstance(msg, dict):
+                    # Per-member journal rider: a member's death then
+                    # stamps its streams' sheds with counts no staler
+                    # than one pool heartbeat.
+                    self._journal.merge(msg.get("journal"))
                 if not isinstance(msg, dict) or not m.engine_alive:
                     if m.dead:
                         continue  # death path already ran
@@ -1264,10 +1290,15 @@ class TpuNativeBackend(InferenceBackend):
         the old plain error."""
         restarting = (self._started and self._sup_enabled
                       and not self._circuit_open)
-        for q in self._queues.values():
+        for req_id, q in self._queues.items():
             q.put_nowait({"op": HostOp.EVENT, "done": True,
                           "finish_reason": "error",
                           "restarting": restarting,
+                          # Journal-stamped emitted count: what this
+                          # stream already relayed (host heartbeat
+                          # journal merged in as a lower bound) — the
+                          # resume's RNG-lane position rides the shed.
+                          "emitted": self._journal.get(req_id),
                           "error": reason, "text": ""})
         for w in (self._stats_waiters + self._trace_waiters
                   + self._metrics_waiters
@@ -1449,6 +1480,12 @@ class TpuNativeBackend(InferenceBackend):
                     continue
                 msg = await self._probe_host_stats(
                     timeout=self._wedge_timeout_s)
+                if isinstance(msg, dict):
+                    # Emitted-token journal rider: the host's per-stream
+                    # pipe-write counts, merged as a lower bound so the
+                    # NEXT death's sheds stamp counts no staler than one
+                    # heartbeat.
+                    self._journal.merge(msg.get("journal"))
                 alive = msg is not None and self._engine_alive
                 if alive and self._local_pair and self._started:
                     # Decode tier answered — the prefill tier must too,
@@ -1787,6 +1824,7 @@ class TpuNativeBackend(InferenceBackend):
                 return {"supervisor": sup} if sup else None
             out = {k: v for k, v in msg.items() if k != "op"}
             out["relay"] = dict(self.relay_stats)
+            out["resume"] = dict(self.resume_stats)
             out["clock_offset_s"] = round(self._clock_offset, 6)
             out["stages"] = {name: h.to_dict()
                              for name, h in self.stage_hists.items()
@@ -1833,7 +1871,10 @@ class TpuNativeBackend(InferenceBackend):
         if self._scheduler is None:
             return None
         stats = getattr(self._scheduler, "stats", None)
-        return stats() if stats is not None else dict(self._scheduler.metrics)
+        out = (stats() if stats is not None
+               else dict(self._scheduler.metrics))
+        out["resume"] = dict(self.resume_stats)
+        return out
 
     async def _pool_engine_stats(self) -> dict:
         """Pool-mode serving breakdown: the first live decode member's
@@ -1848,6 +1889,7 @@ class TpuNativeBackend(InferenceBackend):
             if msg is not None:
                 out = {k: v for k, v in msg.items() if k != "op"}
         out["relay"] = dict(self.relay_stats)
+        out["resume"] = dict(self.resume_stats)
         out["stages"] = {name: h.to_dict()
                          for name, h in self.stage_hists.items()
                          if h.count}
@@ -1932,16 +1974,55 @@ class TpuNativeBackend(InferenceBackend):
             prompt_ids = engine.tokenizer.apply_chat_template(request.messages)
         except Exception as exc:  # tokenizer/template failure
             raise BackendError(f"tokenization failed: {exc}") from exc
+        sampling = SamplingParams.from_request(request)
+        resume_offset = 0
+        if request.resume_text is not None:
+            # In-process resume: same semantics as the host's _submit
+            # (resolve_resume — the shared implementation): condition on
+            # prompt + the client's received text, offset the budget,
+            # fast-forward the seeded RNG lane. Without this,
+            # supports_resume=True would let the provider accept a
+            # resume this branch then serves from token 0 — splicing a
+            # duplicate completion onto the client's partial text.
+            import dataclasses
+
+            from symmetry_tpu.engine.tokenizer import resolve_resume
+
+            try:
+                prompt_ids, max_new, resume_offset = resolve_resume(
+                    engine.tokenizer,
+                    {"text": request.resume_text,
+                     **({"tokens": request.resume_tokens}
+                        if request.resume_tokens is not None else {})},
+                    prompt_ids, max_new)
+            except Exception as exc:  # noqa: BLE001
+                raise BackendError(f"resume failed: {exc}") from exc
+            sampling = dataclasses.replace(sampling,
+                                           rng_skip=resume_offset)
+            self.resume_stats["resumes"] += 1
+            self.resume_stats["resumed_tokens"] += resume_offset
+            if max_new == 0:
+                # Budget already spent by the interrupted stream — only
+                # the finish frame was lost; complete without admitting.
+                yield StreamChunk(
+                    raw=self._chunk_line(request_id, created,
+                                         {"role": "assistant"}), text="")
+                yield StreamChunk(
+                    raw=self._chunk_line(request_id, created, {},
+                                         finish="length"), text="")
+                yield StreamChunk(raw="data: [DONE]", text="", done=True)
+                return
 
         if FAULTS.enabled and await FAULTS.apoint("backend.dispatch"):
             raise BackendError("injected frame drop at backend.dispatch")
         session = AsyncSession(self._scheduler,
                                loop=asyncio.get_running_loop())
-        session.submit(prompt_ids, SamplingParams.from_request(request),
+        session.submit(prompt_ids, sampling,
                        max_new, request_id=request_id,
                        speculative=request.speculative,
                        trace_id=request.trace_id,
-                       deadline_s=request.deadline_s)
+                       deadline_s=request.deadline_s,
+                       resume_offset=resume_offset)
 
         def chunk_line(delta: dict, finish: str | None = None) -> str:
             return self._chunk_line(request_id, created, delta, finish)
@@ -2059,6 +2140,19 @@ class TpuNativeBackend(InferenceBackend):
         self._queues[request_id] = queue
         completed = False
         t_recv = time.monotonic()
+        # Journal entry for this stream (released on every exit path):
+        # the death paths stamp their sheds' `emitted` counts from it.
+        journal = self._journal.track(request_id)
+        is_resume = request.resume_text is not None
+        if is_resume:
+            self.resume_stats["resumes"] += 1
+            if request.resume_tokens:
+                self.resume_stats["resumed_tokens"] += request.resume_tokens
+        # Offset dedup (armed by the first event's resume_from): events
+        # whose tokens the client already holds are dropped here at the
+        # relay, so a resume never replays received tokens even when the
+        # serving host floored its continuation below the client's count.
+        drop_left: int | None = None
         try:
             try:
                 submit = {
@@ -2076,7 +2170,13 @@ class TpuNativeBackend(InferenceBackend):
                     **({"trace": request.trace_id}
                        if request.trace_id else {}),
                     **({"deadline_s": request.deadline_s}
-                       if request.deadline_s is not None else {})}
+                       if request.deadline_s is not None else {}),
+                    **({"resume": {
+                            "text": request.resume_text,
+                            **({"tokens": int(request.resume_tokens)}
+                               if request.resume_tokens is not None
+                               else {})}}
+                       if is_resume else {})}
                 if self._disagg:
                     # Disagg: new work enters through the PREFILL tier;
                     # the broker keeps the state the decode tier will
@@ -2144,25 +2244,84 @@ class TpuNativeBackend(InferenceBackend):
                             off = dm.clock_offset
                     self._observe_stages(t_recv, t_submit, stamps,
                                          clock_offset=off)
+                if "reused" in ev:
+                    # First-event rider: radix tokens this admission
+                    # reused (for a resume, the cheap-seeded-re-prefill
+                    # contract the chaos round asserts on).
+                    if is_resume:
+                        self.resume_stats["reused_tokens"] += int(
+                            ev.get("reused") or 0)
+                        if request.resume_tokens is None:
+                            # Hard-drop resumes carry no claimed count —
+                            # the host derived it from the text and
+                            # echoes it as resume_from; book it so the
+                            # wasted-work headline counts this failure
+                            # class too.
+                            self.resume_stats["resumed_tokens"] += int(
+                                ev.get("resume_from") or 0)
+                    if is_resume and drop_left is None:
+                        # Arm the offset dedup: the host continued from
+                        # resume_from (its token numbering == the
+                        # client's claimed count when one was sent);
+                        # anything below the client's count is overlap.
+                        server_from = ev.get("resume_from")
+                        if (request.resume_tokens is not None
+                                and isinstance(server_from, int)):
+                            drop_left = max(
+                                0, request.resume_tokens - server_from)
                 err = ev.get("error")
                 if ev.get("restarting"):
                     # Host crash/wedge mid-stream: the structured
                     # RETRYABLE shed (supervisor is respawning; the
-                    # client should fail over now, not wait).
+                    # client should fail over now, not wait). Carries
+                    # the journal-stamped emitted count — the resume's
+                    # RNG-lane anchor.
+                    emitted = ev.get("emitted")
                     raise BackendRestartingError(
                         err or "engine host restarting",
-                        retry_after_s=self._restart_eta_s())
+                        retry_after_s=self._restart_eta_s(),
+                        emitted=(int(emitted)
+                                 if isinstance(emitted, int) else None))
                 if ev.get("finish_reason") == "expired":
                     raise BackendDeadlineError(
                         err or "request deadline expired")
                 if err and ev.get("finish_reason") == "error":
                     raise BackendError(err)
                 text = ev.get("text", "")
+                n_new = int(ev.get("tokens_new", 0))
+                if text and drop_left:
+                    if n_new <= drop_left:
+                        # Overlap: the client already has these tokens —
+                        # drop the text (a resume never replays tokens
+                        # the client received). A done=True event still
+                        # delivers its finish below: swallowing it would
+                        # hang the stream on a queue nobody feeds.
+                        drop_left -= n_new
+                        self.resume_stats["dedup_dropped"] += n_new
+                        self._m_resume_wasted.inc(n_new)
+                        if not ev.get("done"):
+                            continue
+                        text = ""
+                    else:
+                        # Straddling block event: token-to-text
+                        # boundaries inside one event are not
+                        # recoverable here, and relaying it whole would
+                        # splice already-received characters into the
+                        # client transcript — silent corruption. Fail
+                        # the RESUME attempt cleanly instead: the
+                        # client's fallback regenerates from scratch,
+                        # which is slower but byte-correct.
+                        raise BackendError(
+                            f"resume overlap straddles a block event "
+                            f"({n_new} tokens, {drop_left} left to "
+                            f"drop) — cannot dedup at token "
+                            f"granularity; restart the stream")
                 if text:
+                    journal.note(n_new)
                     yield StreamChunk(
                         raw=self._chunk_line(request_id, created,
                                              {"content": text}),
-                        text=text, tokens=int(ev.get("tokens_new", 0)))
+                        text=text, tokens=n_new)
                 if ev.get("done"):
                     completed = True
                     yield StreamChunk(
@@ -2174,6 +2333,10 @@ class TpuNativeBackend(InferenceBackend):
                                       done=True)
                     return
         finally:
+            # Journal release AFTER the stream settles: every death path
+            # that stamps from it ran synchronously before this task
+            # resumed, so the count was read while still tracked.
+            journal.release()
             self._queues.pop(request_id, None)
             if self._pool_mode:
                 placed = self._pool.assigned_to(request_id)

@@ -220,6 +220,15 @@ class SymmetryProvider:
         self._m_flight_dumps = METRICS.counter(
             MetricName.PROVIDER_FLIGHT_DUMPS,
             "flight-recorder dumps written", labels=("reason",))
+        # Stream resumption: resumes served (accepted/refused) and the
+        # recovery-latency headline — interruption to first CONTINUATION
+        # token (the resume request's TTFT as this provider saw it).
+        self._m_resumes = METRICS.counter(
+            MetricName.PROVIDER_RESUMES,
+            "resume requests handled", labels=("outcome",))
+        self._m_resume_ttft = METRICS.histogram(
+            MetricName.RESUME_TTFT,
+            "time to first continuation token of a resume request")
         # SLO burn-rate monitor (`slo:` config block, utils/metrics.py):
         # continuous evaluation over the request stream; a budget burn
         # triggers the flight recorder + a structured log event — SLO
@@ -952,6 +961,39 @@ class SymmetryProvider:
                                 {"error": "deadline_s already expired",
                                  "expired": True, **tag})
                 return
+        resume = data.get("resume")
+        resume_text: str | None = None
+        resume_tokens: int | None = None
+        if isinstance(resume, dict) and resume.get("text"):
+            # Stream resumption: the client holds a partial completion
+            # from a provider that died mid-stream and asks THIS one to
+            # continue from its end. A backend that would regenerate
+            # from scratch is refused with a structured marker — the
+            # client then falls back to a from-scratch restart instead
+            # of splicing a duplicate completion onto its partial text.
+            if not getattr(self.backend, "supports_resume", False):
+                self._m_resumes.inc(outcome="refused")
+                await peer.send(MessageKey.INFERENCE_ERROR,
+                                {"error": "backend does not support "
+                                          "stream resumption",
+                                 "resumeUnsupported": True, **tag})
+                return
+            resume_text = str(resume.get("text"))
+            rt = resume.get("tokens")
+            if rt is not None:
+                try:
+                    resume_tokens = int(rt)
+                except (TypeError, ValueError):
+                    resume_tokens = -1
+                if resume_tokens < 0:
+                    # Rejected at ingress for EVERY backend shape: a
+                    # negative claim would inflate the token budget
+                    # past the client's own max_tokens downstream.
+                    await peer.send(MessageKey.INFERENCE_ERROR,
+                                    {"error": "invalid resume tokens",
+                                     **tag})
+                    return
+            self._m_resumes.inc(outcome="accepted")
         spec = data.get("speculative")
         trace_id = str(data.get("traceId") or "")
         request = InferenceRequest(
@@ -964,6 +1006,8 @@ class SymmetryProvider:
             speculative=spec if isinstance(spec, bool) else None,
             trace_id=trace_id,
             deadline_s=deadline_s,
+            resume_text=resume_text,
+            resume_tokens=resume_tokens,
         )
         self._in_flight += 1
         self._unstarted += 1
@@ -1020,6 +1064,10 @@ class SymmetryProvider:
                         self._pending_gauges()
                         self._first_token_stamps.append(now_chunk)
                         self._m_ttft.observe(first_token_s)
+                        if resume_text is not None:
+                            # The recovery-latency headline: request
+                            # receipt → first CONTINUATION token.
+                            self._m_resume_ttft.observe(first_token_s)
                         self.slo.observe("ttft", first_token_s)
                     else:
                         # Inter-chunk gap: the stall any live stream saw
@@ -1085,6 +1133,24 @@ class SymmetryProvider:
                     await peer.send(MessageKey.INFERENCE_ERROR,
                                     {"error": str(exc), "busy": True,
                                      "restarting": True,
+                                     # Exact relayed-token count for the
+                                     # client's resume: everything sent
+                                     # before this ordered error frame
+                                     # was delivered, so n_tokens IS
+                                     # what the client holds. The
+                                     # backend's journal stamp may
+                                     # exceed it when pipe frames died
+                                     # with the host — those tokens are
+                                     # lost work the resume regenerates;
+                                     # the gap rides as emittedEngine
+                                     # (wasted-work observability, the
+                                     # chaos round's numerator).
+                                     "emitted": n_tokens,
+                                     **({"emittedEngine": exc.emitted}
+                                        if getattr(exc, "emitted", None)
+                                        is not None
+                                        and exc.emitted > n_tokens
+                                        else {}),
                                      **({"retryAfterS":
                                          round(exc.retry_after_s, 3)}
                                         if exc.retry_after_s is not None

@@ -72,6 +72,20 @@ class MetricName:
     SLO_BURN_RATE = "sym_slo_burn_rate"                      # {slo,window}
     SLO_BREACHES = "sym_slo_breaches_total"                  # {slo}
 
+    # --- stream resumption (provider relay + scheduler admission): the
+    #     crash-surviving generation path. `resumed_tokens` = tokens a
+    #     resume skipped regenerating (the saved work); `wasted_tokens` =
+    #     overlap tokens the relay's offset dedup dropped (work the
+    #     engine redid that the client already had); `resume_ttft` =
+    #     interruption → first CONTINUATION token, the recovery-latency
+    #     headline of the kill-under-load round.
+    PROVIDER_RESUMES = "sym_resume_requests_total"
+    RESUME_WASTED_TOKENS = "sym_resume_wasted_tokens_total"
+    RESUME_TTFT = "sym_resume_ttft_seconds"
+    SCHED_RESUMES = "sym_resume_admissions_total"
+    SCHED_RESUMED_TOKENS = "sym_resume_resumed_tokens_total"
+    SCHED_RESUME_REUSED = "sym_resume_reused_tokens_total"
+
     # --- relay / per-stage TTFT (provider/backends/tpu_native.py)
     TTFT_STAGE = "sym_ttft_stage_seconds"                    # {stage}
     RELAY_HOST_FRAMES = "sym_relay_host_frames_total"

@@ -49,6 +49,9 @@ class FakeHost:
         self._cfg = cfg
         self._wlock = threading.Lock()
         self._cancelled: set[str] = set()
+        # Emitted-token journal (mirrors EngineHost._reported): rides
+        # the stats reply so the supervisor's sheds stamp counts.
+        self._reported: dict[str, int] = {}
         fh = cfg.get("fakeHost") or {}
         self._fail_path = fh.get("failFile")
         self._delay = float(fh.get("tokenDelayS", 0.02))
@@ -89,14 +92,49 @@ class FakeHost:
     def _stream(self, msg: dict) -> None:
         req_id = str(msg.get("id", ""))
         n = max(1, min(int(msg.get("max_new", 4)), 64))
-        for i in range(n - 1):
+        with self._wlock:  # stats copies this dict under the same lock
+            self._reported[req_id] = 0
+        # Stream resumption, protocol-faithful: the deterministic
+        # completion for max_new=n is "t0 t1 … t{n-2} ", so a resume
+        # with R received tokens continues at t{R} — exactly the real
+        # host's continue-from-the-client's-text semantics, with the
+        # first event carrying the `reused`/`resume_from` riders (the
+        # fake's "radix hit" is the whole prompt+emitted run).
+        # `fakeHost.resumeOverlap: K` deliberately REWINDS the
+        # continuation K tokens (resume_from = R − K) — the overlap
+        # fixture the backend's offset dedup is tested against.
+        start = 0
+        resumed = None
+        resume = msg.get("resume")
+        if isinstance(resume, dict):
+            claimed = resume.get("tokens")
+            if claimed is not None:
+                start = max(0, int(claimed))
+            else:
+                # One token per "t{i} " word, same as emission.
+                start = len(str(resume.get("text") or "").split())
+            overlap = int((self._cfg.get("fakeHost") or {})
+                          .get("resumeOverlap", 0))
+            resumed = max(0, start - overlap)
+            start = resumed
+        first = True
+        for i in range(start, n - 1):
             if req_id in self._cancelled:
                 break
-            self.write({"op": HostOp.EVENT, "id": req_id, "text": f"t{i} ",
-                        "tokens": i + 1, "tokens_new": 1})
+            ev = {"op": HostOp.EVENT, "id": req_id, "text": f"t{i} ",
+                  "tokens": i + 1, "tokens_new": 1}
+            if first and resumed is not None:
+                ev["resume_from"] = resumed
+                ev["reused"] = max(resumed, 1)
+            first = False
+            self.write(ev)
+            with self._wlock:
+                self._reported[req_id] += 1
             time.sleep(self._delay)
         self.write({"op": HostOp.EVENT, "id": req_id, "text": "", "done": True,
                     "finish_reason": "stop", "tokens": n, "tokens_new": 0})
+        with self._wlock:
+            self._reported.pop(req_id, None)
         self._cancelled.discard(req_id)
 
     def serve(self) -> int:
@@ -125,9 +163,12 @@ class FakeHost:
                 self.write({"op": HostOp.CLOCK, "t0": msg.get("t0"),
                             "t": time.monotonic()})
             elif op == HostOp.STATS:
+                with self._wlock:
+                    journal = dict(self._reported)
                 self.write({"op": HostOp.STATS, "engine_alive": True,
                             "requests": 0, "tokens": 0,
                             "queue_depth": 0, "role": self._role,
+                            "journal": journal,
                             **({"faults": FAULTS.counters()}
                                if FAULTS.enabled else {})})
             elif op == HostOp.SUBMIT:

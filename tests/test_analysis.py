@@ -1007,6 +1007,43 @@ class TestLifecycle:
         })
         assert "L401" in lifecycle_codes(root)
 
+    def test_resume_journal_exception_leak_flags_L402(self, tmp_path):
+        # The resume-journal protocol (PR 14): track() on admission must
+        # release() on the exception edge too — a leaked entry is a
+        # finished request the death paths would stamp forever.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/backend.py": (
+                "def serve(self, request_id, host):\n"
+                "    entry = self._journal.track(request_id)\n"
+                "    host.submit(request_id)\n"
+                "    entry.release()\n"),
+        })
+        assert "L402" in lifecycle_codes(root)
+
+    def test_resume_journal_release_in_finally_clean(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/backend.py": (
+                "def serve(self, request_id, host):\n"
+                "    entry = self._journal.track(request_id)\n"
+                "    try:\n"
+                "        host.submit(request_id)\n"
+                "        entry.note(3)\n"
+                "    finally:\n"
+                "        entry.release()\n"),
+        })
+        assert lifecycle_codes(root) == set()
+
+    def test_resume_journal_hint_scopes_track(self, tmp_path):
+        # `track` on a non-journal receiver is someone else's method —
+        # the receiver hint keeps the spec from claiming it.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/other.py": (
+                "def follow(self, request_id):\n"
+                "    t = self._watcher.track(request_id)\n"
+                "    return t\n"),
+        })
+        assert lifecycle_codes(root) == set()
+
     def test_double_commit_flags_L403(self, tmp_path):
         root = write_tree(tmp_path, {
             "symmetry_tpu/engine.py": (

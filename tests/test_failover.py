@@ -14,9 +14,11 @@ import pytest
 
 from symmetry_tpu.client.client import (
     ChatRestart,
+    ChatResume,
     ClientError,
     DeadlineExceededError,
     ProviderBusyError,
+    ProviderDiedMidStreamError,
     ProviderGoneError,
     ProviderRestartingError,
     SymmetryClient,
@@ -107,9 +109,14 @@ class TestFailover:
             events = []
 
             async def chat():
+                # resume=False pins the LEGACY discard-and-restart mode
+                # (the resume path has its own suite below): p1's
+                # SlowBackend text is not a prefix of p2's echo, so a
+                # splice would be wrong here by construction.
                 async for item in client.chat_failover(
                         "mem://server", ident.public_key, "tiny:fo",
-                        [{"role": "user", "content": "failover!"}]):
+                        [{"role": "user", "content": "failover!"}],
+                        resume=False):
                     events.append(item)
 
             async def killer():
@@ -526,3 +533,350 @@ class TestBusyRetryBackoff:
         shallow = busy_retry_backoff(0, 8, rand=lambda: 0.5)
         deep = busy_retry_backoff(800, 8, rand=lambda: 0.5)
         assert shallow < deep <= 2.0  # capped base, never a self-stall
+
+    def test_retry_after_hint_clamps_round_doubling(self):
+        """Resume rounds must honor a restarting provider's hint, not
+        amplify it: with retryAfterS present the per-round doubling is
+        clamped to the round-0 base — the wait at round 3 equals the
+        wait at round 0 plus the hint, instead of 8x the base on top."""
+        r0 = busy_retry_backoff(4, 4, round_idx=0, retry_after_s=2.0,
+                                rand=lambda: 0.5)
+        r3 = busy_retry_backoff(4, 4, round_idx=3, retry_after_s=2.0,
+                                rand=lambda: 0.5)
+        assert r3 == pytest.approx(r0)
+        assert r3 == pytest.approx(2.0 + 0.5)  # hint + un-doubled base
+        # without the hint the same round still doubles (depth is the
+        # only signal there)
+        assert busy_retry_backoff(4, 4, round_idx=3, rand=lambda: 0.5) \
+            == pytest.approx(8 * 0.5)
+
+
+class PartialEchoBackend(InferenceBackend):
+    """Echo that dies mid-stream: streams the first `die_after` words of
+    the prompt, then raises the restarting shed — the mid-stream failure
+    whose emitted text IS a prefix of a healthy echo's completion, so a
+    resume on a survivor must splice byte-identically. With die_after
+    beyond the prompt it is just a slow resumable echo (the hard-drop
+    tests kill the connection from outside instead)."""
+
+    name = "partial-echo"
+    supports_resume = True
+
+    def __init__(self, die_after=3, delay=0.01) -> None:
+        self._die_after = die_after
+        self._delay = delay
+
+    async def start(self) -> None: ...
+
+    async def stop(self) -> None: ...
+
+    async def healthy(self) -> bool:
+        return True
+
+    async def stream(self, request):
+        last_user = ""
+        for m in reversed(request.messages):
+            if m.get("role") == "user":
+                last_user = m.get("content", "")
+                break
+        words = last_user.split(" ")
+        skip_chars = len(request.resume_text or "")
+        for i, word in enumerate(words):
+            if i >= self._die_after:
+                raise BackendRestartingError(
+                    "engine host restarting", retry_after_s=0.01)
+            token = word if i == 0 else " " + word
+            if skip_chars >= len(token):
+                skip_chars -= len(token)
+                continue
+            await asyncio.sleep(self._delay)
+            yield StreamChunk(
+                raw=f"data: {{\"choices\": [{{\"delta\": "
+                    f"{{\"content\": \"{token}\"}}}}]}}",
+                text=token, tokens=1)
+
+
+class NoResumeEchoBackend(InferenceBackend):
+    """Healthy echo that does NOT support resumption (the proxy-backend
+    shape): the provider must REFUSE a resume against it and the client
+    must fall back to a from-scratch restart."""
+
+    name = "no-resume-echo"
+    supports_resume = False
+
+    async def start(self) -> None: ...
+
+    async def stop(self) -> None: ...
+
+    async def healthy(self) -> bool:
+        return True
+
+    async def stream(self, request):
+        last_user = ""
+        for m in reversed(request.messages):
+            if m.get("role") == "user":
+                last_user = m.get("content", "")
+                break
+        for i, word in enumerate(last_user.split(" ")):
+            token = word if i == 0 else " " + word
+            yield StreamChunk(
+                raw=f"data: {{\"choices\": [{{\"delta\": "
+                    f"{{\"content\": \"{token}\"}}}}]}}",
+                text=token, tokens=1)
+
+
+class TestResumeFailover:
+    """The tentpole: a mid-stream retryable failure CONTINUES on the
+    next provider from the last received token — ChatResume, spliced
+    byte-identical, never a discarded partial."""
+
+    PROMPT = "resumable streams splice the continuation byte exact"
+
+    async def _network(self, hub, ident, p1_backend, p2_backend=None):
+        server = SymmetryServer(ident, hub, ping_interval_s=30.0)
+        await server.start("mem://server")
+        p1 = SymmetryProvider(
+            provider_config(ident.public_hex, "re-p1"), transport=hub,
+            identity=Identity.from_name("re-p1"), backend=p1_backend,
+            server_address="mem://server")
+        await p1.start("mem://re-p1")
+        await p1.wait_registered()
+        p2 = SymmetryProvider(
+            provider_config(ident.public_hex, "re-p2"), transport=hub,
+            identity=Identity.from_name("re-p2"), backend=p2_backend,
+            server_address="mem://server")
+        await p2.start("mem://re-p2")
+        await p2.wait_registered()
+        server.registry.set_connections(p2.identity.public_hex, 5)
+        return server, p1, p2
+
+    def test_restarting_mid_stream_resumes_on_other_peer(self):
+        """Mid-stream restarting shed → resume lands on the OTHER peer
+        (the dying one is excluded from the immediate round), carries
+        the provider's stamped emitted count, and the spliced transcript
+        equals the uninterrupted completion byte for byte."""
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("re-server1")
+            server, p1, p2 = await self._network(
+                hub, ident, PartialEchoBackend(die_after=3))
+            client = SymmetryClient(Identity.from_name("re-cli1"), hub)
+
+            events = []
+            async for item in client.chat_failover(
+                    "mem://server", ident.public_key, "tiny:fo",
+                    [{"role": "user", "content": self.PROMPT}]):
+                events.append(item)
+
+            resumes = [e for e in events if isinstance(e, ChatResume)]
+            assert len(resumes) == 1, events
+            assert not any(isinstance(e, ChatRestart) for e in events)
+            # satellite: the resume landed on a DIFFERENT peer
+            assert resumes[0].provider_key == p2.identity.public_hex
+            # the shed's journal-stamped count rode through: 3 words
+            assert resumes[0].resumed_tokens == 3
+            final = "".join(e for e in events if isinstance(e, str))
+            assert final == self.PROMPT, final
+            # and the splice duplicated nothing: pre-cut + post-cut
+            cut = events.index(resumes[0])
+            pre = "".join(e for e in events[:cut] if isinstance(e, str))
+            post = "".join(e for e in events[cut:] if isinstance(e, str))
+            assert pre + post == self.PROMPT
+            assert pre  # the failure really was mid-stream
+            await p1.stop(drain_timeout_s=1)
+            await p2.stop(drain_timeout_s=1)
+            await server.stop()
+
+        run(main())
+
+    def test_hard_death_mid_stream_resumes(self):
+        """A hard connection drop (no error frame, no token stamp):
+        ProviderDiedMidStreamError carries the text, the token count is
+        re-derived server-side, and the splice is still byte-identical."""
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("re-server2")
+            server, p1, p2 = await self._network(
+                hub, ident, SlowBackend(delay=0.02, n=100))
+            # p1 streams w0 w1 … — NOT a prefix of p2's echo, so for
+            # this test p1 must echo too: replace its backend.
+            p1.backend = PartialEchoBackend(die_after=100, delay=0.02)
+            client = SymmetryClient(Identity.from_name("re-cli2"), hub)
+
+            events = []
+
+            async def chat():
+                async for item in client.chat_failover(
+                        "mem://server", ident.public_key, "tiny:fo",
+                        [{"role": "user", "content": self.PROMPT}]):
+                    events.append(item)
+
+            async def killer():
+                while not p1._in_flight:
+                    await asyncio.sleep(0.01)
+                await asyncio.sleep(0.08)
+                for peer in list(p1._client_peers):
+                    await peer.close()
+                await p1.stop(drain_timeout_s=0)
+
+            await asyncio.gather(chat(), killer())
+
+            resumes = [e for e in events if isinstance(e, ChatResume)]
+            assert len(resumes) == 1, events
+            assert resumes[0].provider_key == p2.identity.public_hex
+            final = "".join(e for e in events if isinstance(e, str))
+            assert final == self.PROMPT, final
+            await p2.stop(drain_timeout_s=1)
+            await server.stop()
+
+        run(main())
+
+    def test_resume_refused_falls_back_to_restart(self):
+        """A survivor whose backend cannot resume (proxy shape) refuses
+        the resume with a structured marker; the client falls back ONCE
+        to a from-scratch restart and still completes correctly."""
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("re-server3")
+            server, p1, p2 = await self._network(
+                hub, ident, PartialEchoBackend(die_after=3),
+                p2_backend=NoResumeEchoBackend())
+            client = SymmetryClient(Identity.from_name("re-cli3"), hub)
+
+            events = []
+            async for item in client.chat_failover(
+                    "mem://server", ident.public_key, "tiny:fo",
+                    [{"role": "user", "content": self.PROMPT}]):
+                events.append(item)
+
+            # one resume ATTEMPT was made and refused; the fallback
+            # restart voids the partial text and regenerates whole
+            restarts = [e for e in events if isinstance(e, ChatRestart)]
+            assert len(restarts) == 1, events
+            final = "".join(
+                e for e in events[events.index(restarts[-1]) + 1:]
+                if isinstance(e, str))
+            assert final == self.PROMPT, final
+            await p1.stop(drain_timeout_s=1)
+            await p2.stop(drain_timeout_s=1)
+            await server.stop()
+
+        run(main())
+
+    def test_resume_false_restores_legacy_restart(self):
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("re-server4")
+            server, p1, p2 = await self._network(
+                hub, ident, PartialEchoBackend(die_after=3))
+            client = SymmetryClient(Identity.from_name("re-cli4"), hub)
+
+            events = []
+            async for item in client.chat_failover(
+                    "mem://server", ident.public_key, "tiny:fo",
+                    [{"role": "user", "content": self.PROMPT}],
+                    resume=False):
+                events.append(item)
+
+            assert any(isinstance(e, ChatRestart) for e in events)
+            assert not any(isinstance(e, ChatResume) for e in events)
+            restarts = [e for e in events if isinstance(e, ChatRestart)]
+            final = "".join(
+                e for e in events[events.index(restarts[-1]) + 1:]
+                if isinstance(e, str))
+            assert final == self.PROMPT
+            await p1.stop(drain_timeout_s=1)
+            await p2.stop(drain_timeout_s=1)
+            await server.stop()
+
+        run(main())
+
+    def test_text_failover_splices_resume(self):
+        """chat_text_failover keeps parts across a ChatResume (and the
+        result equals the uninterrupted completion)."""
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("re-server5")
+            server, p1, p2 = await self._network(
+                hub, ident, PartialEchoBackend(die_after=4))
+            client = SymmetryClient(Identity.from_name("re-cli5"), hub)
+            text = await client.chat_text_failover(
+                "mem://server", ident.public_key, "tiny:fo",
+                [{"role": "user", "content": self.PROMPT}])
+            assert text == self.PROMPT
+            await p1.stop(drain_timeout_s=1)
+            await p2.stop(drain_timeout_s=1)
+            await server.stop()
+
+        run(main())
+
+    def test_mid_stream_errors_carry_emitted_state(self):
+        """Direct-session contract: ProviderRestartingError mid-stream
+        carries the emitted text + the provider's stamped token count;
+        ProviderDiedMidStreamError (hard drop) carries the text with
+        tokens None."""
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("re-server6")
+            server, p1, p2 = await self._network(
+                hub, ident, PartialEchoBackend(die_after=2))
+            client = SymmetryClient(Identity.from_name("re-cli6"), hub)
+            details = await client.request_provider(
+                "mem://server", ident.public_key, "tiny:fo",
+                exclude=[p2.identity.public_hex])
+            assert details.peer_key == p1.identity.public_hex
+            session = await client.connect(details)
+            got = []
+            with pytest.raises(ProviderRestartingError) as exc_info:
+                async for d in session.chat(
+                        [{"role": "user", "content": self.PROMPT}]):
+                    got.append(d)
+            await session.close()
+            exc = exc_info.value
+            assert exc.emitted_text == "".join(got)
+            assert exc.emitted_tokens == 2
+            assert exc.emitted_text == "resumable streams"
+            await p1.stop(drain_timeout_s=1)
+            await p2.stop(drain_timeout_s=1)
+            await server.stop()
+
+        run(main())
+
+    def test_hard_drop_direct_session_raises_died_mid_stream(self):
+        """A connection that just dies mid-stream (no error frame at
+        all) surfaces as ProviderDiedMidStreamError with the received
+        text and tokens None (nothing stamped it)."""
+        async def main():
+            hub = MemoryTransport()
+            ident = Identity.from_name("re-server7")
+            server, p1, p2 = await self._network(
+                hub, ident, PartialEchoBackend(die_after=100, delay=0.03))
+            client = SymmetryClient(Identity.from_name("re-cli7"), hub)
+            details = await client.request_provider(
+                "mem://server", ident.public_key, "tiny:fo",
+                exclude=[p2.identity.public_hex])
+            session = await client.connect(details)
+            got = []
+
+            async def chat():
+                with pytest.raises(ProviderDiedMidStreamError) as ei:
+                    async for d in session.chat(
+                            [{"role": "user", "content": self.PROMPT}]):
+                        got.append(d)
+                assert ei.value.emitted_text == "".join(got)
+                assert ei.value.emitted_tokens is None
+                assert got, "drop landed before anything streamed"
+
+            async def killer():
+                while len(got) < 2:
+                    await asyncio.sleep(0.01)
+                for peer in list(p1._client_peers):
+                    await peer.close()
+
+            await asyncio.gather(chat(), killer())
+            await session.close()
+            await p1.stop(drain_timeout_s=1)
+            await p2.stop(drain_timeout_s=1)
+            await server.stop()
+
+        run(main())

@@ -7,10 +7,21 @@ injected stand-in for a provider process dying mid-stream) — and asserts:
 
   - the first provider actually streamed before dying (the fault landed
     MID-stream, not at admission);
-  - chat_failover recovers on the second provider with exactly one
-    ChatRestart sentinel and byte-identical final text;
+  - chat_failover (legacy resume=False mode) recovers on the second
+    provider with exactly one ChatRestart sentinel and byte-identical
+    final text;
   - the fault accounting (provider stats `faults` block) confirms the
     seam fired exactly once.
+
+Phase 5 (stream resumption, PR 14): the same mid-stream crash with the
+DEFAULT resume mode — chat_failover reissues a `resume` request on the
+survivor, exactly one ChatResume (zero ChatRestart), and the SPLICED
+transcript (pre-crash deltas + continuation) is byte-identical to an
+uninterrupted completion; then the same drill against a fake-host
+tpu_native provider asserts the resume admission reused cached tokens
+(`tokens_reused > 0` — a cheap seeded re-prefill, not a full
+regeneration) and that the crash shed carried the journal's emitted
+count.
 
 Then the no-op contract: with no faults configured, an instrumented seam
 must cost one attribute read — 200k guarded hits in well under half a
@@ -24,6 +35,7 @@ Run: python tools/chaos_smoke.py
 from __future__ import annotations
 
 import asyncio
+import os
 import sys
 import time
 
@@ -74,9 +86,11 @@ async def run() -> int:
     prompt = "the quick brown fox jumps over the lazy dog"
     client = SymmetryClient(Identity.from_name("chaos-smoke-cli"), hub)
     events = []
+    # resume=False pins the LEGACY discard-and-restart semantics (the
+    # default resume path gets its own phase below).
     async for item in client.chat_failover(
             "mem://server", server_ident.public_key, "echo:chaos",
-            [{"role": "user", "content": prompt}]):
+            [{"role": "user", "content": prompt}], resume=False):
         events.append(item)
 
     restarts = [e for e in events if isinstance(e, ChatRestart)]
@@ -93,10 +107,121 @@ async def run() -> int:
     print(f"chaos smoke: crash after {len(pre)} chunk(s) on p1; "
           f"failover completed {len(final)} chars on p2")
 
+    # ---- phase 5a: mid-stream crash → RESUME → spliced transcript ----
+    # Same kill, default resume mode: the survivor CONTINUES from the
+    # last received token instead of regenerating, and the client-side
+    # splice is byte-identical to an uninterrupted run.
+    from symmetry_tpu.client.client import ChatResume
+
+    server.registry.set_connections(p1.identity.public_hex, 0)
+    server.registry.set_connections(p2.identity.public_hex, 5)
+    FAULTS.clear()
+    FAULTS.load("provider.relay=error(injected mid-stream crash)@nth=3")
+    events = []
+    async for item in client.chat_failover(
+            "mem://server", server_ident.public_key, "echo:chaos",
+            [{"role": "user", "content": prompt}]):
+        events.append(item)
+    resumes = [e for e in events if isinstance(e, ChatResume)]
+    assert len(resumes) == 1, f"expected 1 resume, got {events}"
+    assert not any(isinstance(e, ChatRestart) for e in events), \
+        "resume mode must not restart"
+    assert resumes[0].provider_key == p2.identity.public_hex, \
+        "resume did not land on the survivor"
+    cut = events.index(resumes[0])
+    pre = "".join(e for e in events[:cut] if isinstance(e, str))
+    post = "".join(e for e in events[cut:] if isinstance(e, str))
+    assert pre, "fault fired before ANY chunk streamed — not mid-stream"
+    assert pre + post == prompt, \
+        f"spliced transcript not byte-identical: {pre + post!r}"
+    from symmetry_tpu.utils.metrics import METRICS
+
+    fams = METRICS.snapshot(compact=True).get("families", {})
+    res = fams.get("sym_resume_requests_total", {})
+    accepted = sum(s.get("value", 0) for s in res.get("series", [])
+                   if s.get("labels", {}).get("outcome") == "accepted")
+    assert accepted >= 1, f"resume counter not booked: {res}"
+    print(f"chaos smoke: phase 5a resume spliced {len(pre)}+{len(post)} "
+          f"chars byte-identical on p2")
+
     FAULTS.clear()
     for prov in providers:
         await prov.stop(drain_timeout_s=1)
     await server.stop()
+
+    # ---- phase 5b: fake-host tpu_native resume reuses cached tokens --
+    # The engine-shaped leg: a supervised fake host crashes mid-stream
+    # (restarting shed stamped with the journal's emitted count), the
+    # resume submit streams only the continuation, and the resume
+    # admission reports tokens_reused > 0.
+    from symmetry_tpu.provider.backends.base import (
+        BackendRestartingError, InferenceRequest)
+    from symmetry_tpu.provider.backends.tpu_native import TpuNativeBackend
+
+    fake_host = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "fake_host.py")
+
+    class FakeHostBackend(TpuNativeBackend):
+        def _host_argv(self, cfg_path):
+            return [sys.executable, fake_host, cfg_path]
+
+    cfg = ConfigManager(config={
+        "name": "chaos-resume", "public": False, "serverKey": "00" * 32,
+        "modelName": "fake:resume", "apiProvider": "tpu_native",
+        "dataCollectionEnabled": False,
+        # Life 1: ready + clock×5 = 6 startup writes; nth=11 kills the
+        # host on the stream's 5th event — mid-stream, 4 events relayed.
+        "faults": {"host.pipe_write": "crash@nth=11"},
+        "tpu": {"engine_isolation": "process", "max_batch_size": 4,
+                "supervisor": {"heartbeat_s": 0.2, "wedge_timeout_s": 1.0,
+                               "backoff_base_s": 0.05,
+                               "backoff_max_s": 0.2, "max_respawns": 3,
+                               "spawn_timeout_s": 15.0,
+                               "stop_grace_s": 0.5}},
+    })
+    backend = FakeHostBackend(cfg)
+    await backend.start()
+    try:
+        got = []
+        emitted_stamp = None
+        try:
+            async for chunk in backend.stream(InferenceRequest(
+                    messages=[{"role": "user", "content": "x"}],
+                    max_tokens=40)):
+                if chunk.text:
+                    got.append(chunk.text)
+        except BackendRestartingError as exc:
+            emitted_stamp = exc.emitted
+        assert got, "fake-host crash landed before anything streamed"
+        assert emitted_stamp == len(got), \
+            f"journal stamp {emitted_stamp} != relayed {len(got)}"
+        # Wait out the respawn, then resume from the received text.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if backend._proc is not None and not backend._host_dead \
+                    and not backend._restarting:
+                break
+            await asyncio.sleep(0.05)
+        full = [f"t{i} " for i in range(39)]
+        cont = []
+        async for chunk in backend.stream(InferenceRequest(
+                messages=[{"role": "user", "content": "x"}],
+                max_tokens=40, resume_text="".join(got),
+                resume_tokens=len(got))):
+            if chunk.text:
+                cont.append(chunk.text)
+        assert got + cont == full, \
+            f"resumed transcript diverged: {got + cont!r}"
+        assert backend.resume_stats["resumes"] == 1
+        assert backend.resume_stats["reused_tokens"] > 0, \
+            "resume admission did not reuse cached tokens"
+        print(f"chaos smoke: phase 5b fake-host resume "
+              f"{len(got)}+{len(cont)} events, emitted stamp "
+              f"{emitted_stamp}, reused "
+              f"{backend.resume_stats['reused_tokens']} tokens")
+    finally:
+        await backend.stop()
+    FAULTS.clear()
 
     # ---- no-op overhead contract --------------------------------------
     assert FAULTS.enabled is False
