@@ -27,6 +27,51 @@ def _rnd(x, nd: int = 3):
     return round(x, nd) if isinstance(x, (int, float)) else x
 
 
+# Bench-JSON schema version: bumped when the capture's SHAPE changes in
+# a way tools/benchdiff.py must know about (v1 = the stamped format —
+# schema + git_sha + resolved-knob config fingerprint on every capture).
+BENCH_SCHEMA = 1
+
+
+def _git_sha() -> str | None:
+    """The repo HEAD this capture ran at (None outside a git checkout) —
+    benchdiff prints both SHAs so a delta names its endpoints."""
+    import os
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def stamp_result(result: dict, config: dict, mode: str) -> dict:
+    """Stamp a bench capture with its identity: schema version, git SHA,
+    and the RESOLVED-knob config fingerprint (every knob that shapes the
+    measurement, post-default-resolution — not the raw argv). benchdiff
+    refuses to compare captures whose fingerprints disagree: a tok/s
+    delta between a 128-slot run and a 96-slot run is a config diff
+    wearing a regression costume, and the old eyeballed-JSON workflow
+    produced exactly that garbage silently."""
+    import hashlib
+
+    cfg = {"mode": mode, **{k: config[k] for k in sorted(config)}}
+    digest = hashlib.blake2b(
+        json.dumps(cfg, sort_keys=True, separators=(",", ":")).encode(),
+        digest_size=8).hexdigest()
+    result["schema"] = BENCH_SCHEMA
+    result["git_sha"] = _git_sha()
+    result["written_at"] = round(time.time(), 1)
+    result["config"] = cfg
+    result["config_fingerprint"] = digest
+    return result
+
+
 import contextlib
 
 
@@ -80,7 +125,8 @@ async def _provider_process(cfg: dict, server, model_name: str, *,
 def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
               max_seq: int, dtype_name: str, mesh_model: int,
               block: int = 1, quant: str | None = None,
-              kv_quant: bool = False, fused_dequant: bool = False) -> dict:
+              kv_quant: bool = False, fused_dequant: bool = False,
+              profile_sample: int = 0) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -115,7 +161,7 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
         config, params, ByteTokenizer(), mesh=mesh, max_slots=slots,
         max_seq_len=max_seq, prefill_buckets=(prompt_len,),
         cache_dtype=dtype, decode_block=block, kv_quant=kv_quant,
-        fused_dequant=fused_dequant)
+        fused_dequant=fused_dequant, profile_sample=profile_sample)
 
     # Compile the decode program BEFORE inserting real requests (warmup's
     # garbage device writes are only harmless pre-insert).
@@ -151,6 +197,17 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
 
     done_steps = n_disp * block
     tok_s = slots * done_steps / dt
+    # symprof block (tpu.profile_sample): per-dispatch-kind DEVICE
+    # duration p50s + the dispatch-gap share — the engine-only bench
+    # exercises prefill + decode_block; the serving bench covers the
+    # full kind set through the scheduler.
+    devprof_block = None
+    if profile_sample:
+        dstats = engine.devprof.stats()
+        devprof_block = dict(dstats)
+        devprof_block["device_p50_ms"] = {
+            kind: _rnd(1e3 * h["p50"], 3) if h.get("p50") else None
+            for kind, h in (dstats.get("device_s") or {}).items()}
     dtype_label = f"{dtype_name}+{quant}" if quant else dtype_name
     if kv_quant:
         dtype_label += "+kv8"
@@ -174,6 +231,7 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
         "decode_step_ms": round(1e3 * step_s, 2),
         "weight_bytes_per_step": weight_bytes,
         "weight_stream_gbs": round(weight_bytes / step_s / 1e9, 1),
+        **({"devprof": devprof_block} if devprof_block else {}),
     }
 
 
@@ -481,7 +539,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             disagg_transport: str | None = None,
             disagg_pool: tuple[int, int] | None = None,
             multi_turn: int = 1,
-            metrics_out: str | None = None) -> dict:
+            metrics_out: str | None = None,
+            profile_sample: int = 0) -> dict:
     """The NORTH-STAR measurement (BASELINE.json metric): aggregate WIRE
     tok/s and p50/p99 TTFT through the full serving path — server +
     tpu_native provider + N concurrent streaming clients over TCP
@@ -574,6 +633,11 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 # under 1% of greedy decode tok/s (--no-trace vs default
                 # at otherwise identical settings).
                 **({"tracing": False} if not tracing else {}),
+                # symprof (utils/devprof.py): 1-in-N completion probes
+                # per dispatch kind — per-kind device durations + the
+                # dispatch-gap share land in the engine.devprof block.
+                **({"profile_sample": profile_sample}
+                   if profile_sample else {}),
             },
         }
         # Provider log is ALWAYS captured (round-3 verdict #1: a 6-line
@@ -1771,6 +1835,17 @@ def main() -> None:
                          "(tpu.tracing=false). The tracing-overhead A/B "
                          "is this flag on vs off at otherwise identical "
                          "settings; acceptance: within 1%% tok/s")
+    ap.add_argument("--profile-sample", type=int, default=0, metavar="N",
+                    help="symprof device-time attribution "
+                         "(tpu.profile_sample): completion-probe every "
+                         "Nth engine dispatch of each kind — per-kind "
+                         "DEVICE duration p50s and the dispatch-gap "
+                         "share land in the JSON's devprof block (and "
+                         "the Perfetto export gains the device track). "
+                         "0 = off. Probes serialize 1 dispatch in N; "
+                         "the overhead A/B (BASELINE.md Round 15) is "
+                         "this flag vs 0 at otherwise identical "
+                         "settings")
     ap.add_argument("--metrics-out", default=None, metavar="PATH",
                     help="write the provider's final metrics-registry "
                          "snapshot (tier-labeled JSON, utils/metrics.py "
@@ -1884,8 +1959,70 @@ def main() -> None:
                          block=64 if user_block is None else user_block,
                          quant=None if args.quant == "none" else args.quant,
                          kv_quant=args.kv_quant == "int8",
-                         fused_dequant=args.fused_dequant)
+                         fused_dequant=args.fused_dequant,
+                         profile_sample=args.profile_sample)
 
+    # Capture identity (stamp_result): the RESOLVED knobs that shape the
+    # measurement — benchdiff refuses to diff two captures whose
+    # fingerprints disagree. Per MODE on purpose: a knob the measured
+    # path ignores must not enter the stamp, or two identical
+    # measurements launched with different inert flags false-refuse
+    # (the exact garbage-delta class the guard exists to stop).
+    # Branches that measure a DIFFERENT point than requested (the
+    # conservative e2e retry, the engine-only fallback) rebuild
+    # `mode`/`fp_cfg` so the stamp describes what actually ran.
+    mode = ("smoke" if args.smoke else "chaos" if args.chaos
+            else "engine" if args.engine else "proxy" if args.proxy
+            else "e2e")
+
+    def engine_fp(preset: str, slots: int, steps: int, prompt_len: int,
+                  max_seq: int, dtype: str, block: int, mesh_model: int,
+                  quant, kv_quant, fused_dequant: bool) -> dict:
+        return {"preset": preset, "slots": slots, "steps": steps,
+                "prompt_len": prompt_len, "max_seq": max_seq,
+                "dtype": dtype, "block": block, "mesh_model": mesh_model,
+                "quant": quant, "kv_quant": kv_quant,
+                "fused_dequant": fused_dequant,
+                "profile_sample": args.profile_sample}
+
+    if mode == "smoke":
+        fp_cfg = engine_fp("tiny", 2, 8, 16, 64, "float32", 2, 1,
+                           None, None, False)
+    elif mode == "chaos":
+        fp_cfg = {"preset": args.preset, "clients": args.clients,
+                  "slots": args.slots, "max_new": args.max_new,
+                  "prompt_len": args.prompt_len, "max_seq": args.max_seq,
+                  "dtype": args.dtype, "block": args.block,
+                  "chaos_seam": args.chaos_seam}
+    elif mode == "engine":
+        fp_cfg = engine_fp(args.preset, args.slots, args.steps,
+                           args.prompt_len, args.max_seq, args.dtype,
+                           args.block, args.mesh_model, args.quant,
+                           args.kv_quant, args.fused_dequant)
+    elif mode == "proxy":
+        fp_cfg = {"clients": args.clients, "max_new": args.max_new,
+                  "proxy_delay": args.proxy_delay}
+    else:
+        fp_cfg = {
+            "preset": args.preset, "slots": args.slots,
+            "clients": args.clients, "max_new": args.max_new,
+            "prompt_len": args.prompt_len, "max_seq": args.max_seq,
+            "dtype": args.dtype, "block": args.block,
+            "quant": args.quant, "kv_quant": args.kv_quant,
+            "fused_dequant": args.fused_dequant,
+            "shared_prefix": args.shared_prefix,
+            "prefix_cache_mb": args.prefix_cache_mb,
+            "speculative": args.speculative,
+            "draft_k": args.draft_k if args.speculative else None,
+            "disagg": args.disagg,
+            "disagg_transport": args.disagg_transport,
+            "disagg_pool": args.disagg_pool,
+            "multi_turn": args.multi_turn, "stagger": args.stagger,
+            "max_queue": args.max_queue, "max_ttft": args.max_ttft,
+            "client_procs": args.client_procs,
+            "tracing": not args.no_trace,
+            "profile_sample": args.profile_sample,
+        }
     if args.smoke:
         # Smoke mode must not touch a TPU: pin the CPU backend before any
         # jax usage (env alone can be overridden by site hooks).
@@ -1894,7 +2031,7 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
         result = run_bench("tiny", slots=2, steps=8, prompt_len=16,
                            max_seq=64, dtype_name="float32", mesh_model=1,
-                           block=2)
+                           block=2, profile_sample=args.profile_sample)
     elif args.chaos:
         result = run_chaos(
             args.preset, clients=args.clients, slots=args.slots,
@@ -1941,7 +2078,8 @@ def main() -> None:
                 disagg_transport=args.disagg_transport,
                 disagg_pool=pool_mn,
                 multi_turn=args.multi_turn,
-                metrics_out=args.metrics_out)
+                metrics_out=args.metrics_out,
+                profile_sample=args.profile_sample)
 
         try:
             result = e2e_attempt(args.max_seq, args.max_new)
@@ -1958,12 +2096,28 @@ def main() -> None:
                       file=sys.stderr)
                 try:
                     result = e2e_attempt(512, cons_new)
+                    # The retry measured a different point: stamp it as
+                    # one (benchdiff must not diff it against the
+                    # default-point baseline as same-config).
+                    mode = "e2e-conservative"
+                    fp_cfg.update(max_seq=512, max_new=cons_new)
                 except Exception as exc2:  # noqa: BLE001
                     print(f"conservative e2e retry failed ({exc2!r})",
                           file=sys.stderr)
             if result is None:
                 print("falling back to engine-only", file=sys.stderr)
                 result = engine_bench()
+                mode = "engine-fallback"
+                # Rebuild from the knobs engine_bench actually honors —
+                # e2e-only flags (clients, stagger, queue bounds, the
+                # mode workloads) did not shape this measurement.
+                fp_cfg = engine_fp(
+                    args.preset, args.slots, args.steps,
+                    args.prompt_len, args.max_seq, args.dtype,
+                    64 if user_block is None else user_block,
+                    args.mesh_model, args.quant, args.kv_quant,
+                    args.fused_dequant)
+    stamp_result(result, fp_cfg, mode)
     print(json.dumps(result))
 
 

@@ -219,6 +219,8 @@ class ProviderSession:
         self._stats_lock = asyncio.Lock()
         self._trace_q: asyncio.Queue = asyncio.Queue()
         self._trace_lock = asyncio.Lock()
+        self._profile_q: asyncio.Queue = asyncio.Queue()
+        self._profile_lock = asyncio.Lock()
         self._reader: asyncio.Task | None = None
         self._closed = False
         # Client-side spans (chat round trip, first delta) land in the
@@ -249,6 +251,9 @@ class ProviderSession:
                     continue
                 if msg.key == MessageKey.TRACE:
                     self._trace_q.put_nowait(data)
+                    continue
+                if msg.key == MessageKey.PROFILE:
+                    self._profile_q.put_nowait(data)
                     continue
                 req_id = str(data.get("requestId", ""))
                 q = self._queues.get(req_id)
@@ -289,6 +294,7 @@ class ProviderSession:
                 q.put_nowait(None)  # wire gone
             self._stats_q.put_nowait(None)
             self._trace_q.put_nowait(None)
+            self._profile_q.put_nowait(None)
 
     async def __aenter__(self) -> "ProviderSession":
         return self
@@ -542,6 +548,36 @@ class ProviderSession:
                     "no trace reply within 30s") from None
             if data is None:
                 raise ProviderGoneError("provider closed during trace query")
+            return data
+
+    async def capture_profile(self, duration_s: float = 2.0) -> dict:
+        """Trigger one bounded on-device jax.profiler capture on the
+        provider's engine and await the result: {"path": <trace dir>}
+        on success, {"error": ...} otherwise (no device backend, or a
+        capture already in progress). The reply arrives only after the
+        capture window closes — the timeout budgets for it. Same
+        reader/serialization discipline as stats()/trace()."""
+        self._check_usable()
+        self._ensure_reader()
+        async with self._profile_lock:
+            self._check_usable()
+            while not self._profile_q.empty():
+                if self._profile_q.get_nowait() is None:
+                    raise ProviderGoneError("provider closed connection")
+            await self._peer.send(MessageKey.PROFILE,
+                                  {"durationS": float(duration_s)})
+            try:
+                # Budget the capture window PLUS the profiler's cold
+                # init (the process's first capture can take tens of
+                # seconds) and the provider's own probe margin.
+                data = await asyncio.wait_for(self._profile_q.get(),
+                                              duration_s + 150.0)
+            except asyncio.TimeoutError:
+                raise ProviderGoneError(
+                    "no profile reply within the capture window") from None
+            if data is None:
+                raise ProviderGoneError(
+                    "provider closed during profile capture")
             return data
 
     async def trace_components(self) -> list[dict]:

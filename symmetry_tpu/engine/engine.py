@@ -173,6 +173,7 @@ class InferenceEngine:
         speculative: SpecConfig | None = None,
         fused_dequant: bool = False,
         role: str = "unified",
+        profile_sample: int = 0,
     ) -> None:
         self.config = config
         self.params = params
@@ -266,6 +267,13 @@ class InferenceEngine:
                                      else self.PREFILL_TOKEN_BUDGET)
         if self.prefill_token_budget < 1:
             raise EngineError("prefill_token_budget must be >= 1")
+        # symprof (utils/devprof.py, tpu.profile_sample): sampling
+        # completion probes around every dispatch kind below — per-kind
+        # DEVICE durations + the dispatch-gap series. Off (0) = one
+        # branch per dispatch: every hook is guarded by `dp.enabled`.
+        from symmetry_tpu.utils.devprof import DeviceProfiler
+
+        self.devprof = DeviceProfiler(profile_sample)
 
         c = config
 
@@ -899,6 +907,8 @@ class InferenceEngine:
         top_ps_arr = jnp.asarray(top_ps)
         top_ks_arr = jnp.asarray(top_ks)
         decode_keys_arr = jnp.stack(decode_keys)
+        dp = self.devprof
+        t_dp = dp.begin() if dp.enabled else 0.0
         toks, prefix = self._prefill(
             self.params, jnp.asarray(padded), lens_arr, temps_arr,
             top_ps_arr, top_ks_arr, jnp.stack(prefill_keys),
@@ -908,6 +918,10 @@ class InferenceEngine:
         self.state = self._insert_all(
             self.state, prefix, jnp.asarray(slots_arr), lens_arr,
             toks, temps_arr, top_ps_arr, top_ks_arr, decode_keys_arr)
+        if dp.enabled:
+            # The probe covers the prefill + insert chain (device order
+            # is FIFO, so last_token ready implies both executed).
+            dp.probe("prefill", self.state.last_token, t_dp)
         # Populate the prefix cache from this batch BEFORE the buffer goes
         # back to the pool (the extract reads it; the next same-shape
         # prefill would overwrite it).
@@ -1020,10 +1034,14 @@ class InferenceEngine:
                 prefill_keys.append(pk)
                 decode_keys.append(dk)
 
+            dp = self.devprof
+            t_dp = dp.begin() if dp.enabled else 0.0
             scratch = self._prefill_scratch_for(batch, bucket)
             scratch = self._insert_from_blocks(
                 scratch, self._pool_kv, self._bucket_ids(bucket, hit.blocks),
                 jnp.int32(p))
+            if dp.enabled:
+                dp.probe("seed_gather", scratch.lengths, t_dp)
             # The gather out of the pool is dispatched (device order is
             # FIFO, so any later scatter into a since-freed block runs
             # after this read): safe to unpin now.
@@ -1033,6 +1051,7 @@ class InferenceEngine:
             top_ps_arr = jnp.asarray(top_ps)
             top_ks_arr = jnp.asarray(top_ks)
             decode_keys_arr = jnp.stack(decode_keys)
+            t_dp = dp.begin() if dp.enabled else 0.0
             toks, prefix = self._chunk_final(
                 self.params, jnp.asarray(suffix), scratch, sfx_arr,
                 sfx_arr - 1, temps_arr, top_ps_arr, top_ks_arr,
@@ -1041,6 +1060,10 @@ class InferenceEngine:
                 self.state, prefix, jnp.asarray(slots_arr),
                 jnp.asarray(full_lens), toks, temps_arr, top_ps_arr,
                 top_ks_arr, decode_keys_arr)
+            if dp.enabled:
+                # The cached-hit suffix dispatch is still a prefill on
+                # the device (chunk_final + insert over the seeded rows).
+                dp.probe("prefill", self.state.last_token, t_dp)
             # The finished rows hold prefix + suffix KV: extend the tree
             # with the new tail blocks BEFORE the buffer goes back to
             # the scratch pool — this is what makes turn N+1 of a
@@ -1067,13 +1090,23 @@ class InferenceEngine:
             p = PB * (len(ids) // PB)
             if p < PB:
                 continue
+            dp = self.devprof
+            t_dp = 0.0
             plan = self.prefix_index.plan_insert(ids[:p])
             if plan is None:
                 continue  # fully resident, or rejected even after LRU
             try:
                 # Inside the try: a device failure in the extract (or
                 # anywhere before commit) must abort the plan, or its
-                # pinned prefix and allocated blocks leak forever.
+                # pinned prefix and allocated blocks leak forever. The
+                # probe's begin() sits here too — only a path that
+                # actually dispatches may close a pending dispatch gap
+                # (a plan-None early-out closing it at a bookkeeping
+                # moment would bias gap_share low), and an exception in
+                # it must abort the plan like any other pre-commit
+                # failure.
+                if dp.enabled:
+                    t_dp = dp.begin()
                 row_cache = self._extract_prefix_row(
                     prefix, jnp.int32(row), jnp.int32(p))
                 bucket = row_cache.k.shape[2]
@@ -1085,6 +1118,8 @@ class InferenceEngine:
                 plan.abort()
                 raise
             plan.commit()
+            if dp.enabled:
+                dp.probe("scatter", self._pool_kv.lengths, t_dp)
             return
 
     def prefix_cache_stats(self) -> dict | None:
@@ -1204,6 +1239,8 @@ class InferenceEngine:
         p_eff = PB * (min(cov, p) // PB)
         if p_eff <= 0:
             return False
+        dp = self.devprof
+        t_dp = 0.0
         plan = self.prefix_index.plan_insert(tokens[:p_eff])
         if plan is None:
             # Fully resident (adoption by reference — the sender skipped
@@ -1217,8 +1254,13 @@ class InferenceEngine:
         # try: a failure anywhere between plan and commit (no bucket
         # fits, a frame missing its scale planes, a device transfer
         # error) must abort the plan, or its pinned matched prefix and
-        # allocated blocks leak forever.
+        # allocated blocks leak forever. The probe's begin() sits inside
+        # for the same two reasons as _maybe_store_prefix: only a path
+        # that dispatches may close a pending dispatch gap, and an
+        # exception in it must abort the plan.
         try:
+            if dp.enabled:
+                t_dp = dp.begin()
             capacity = self.bucket_for(p_eff)
             m = plan.matched_len
             k_row = np.zeros((c.num_layers, 1, capacity, c.num_kv_heads,
@@ -1259,6 +1301,10 @@ class InferenceEngine:
             plan.abort()
             raise
         plan.commit()
+        if dp.enabled:
+            # Adoption's device work: host→device row transfer + the
+            # one-dispatch pool scatter.
+            dp.probe("adopt", self._pool_kv.lengths, t_dp)
         return True
 
     # ------------------------------------------------------------------
@@ -1303,9 +1349,13 @@ class InferenceEngine:
 
             cache = self._new_prefix_cache(bucket)
             if hit is not None:
+                dp = self.devprof
+                t_dp = dp.begin() if dp.enabled else 0.0
                 cache = self._insert_from_blocks(
                     cache, self._pool_kv,
                     self._bucket_ids(bucket, hit.blocks), jnp.int32(start))
+                if dp.enabled:
+                    dp.probe("seed_gather", cache.lengths, t_dp)
                 hit.release()  # gather dispatched; blocks free to evict
                 self.prefix_index.note_reuse(1, start)
             elif self.prefix_index is not None:
@@ -1333,10 +1383,14 @@ class InferenceEngine:
         chunk = jnp.asarray(job.ids[:, c0:c0 + C])
         valid = jnp.asarray([min(C, job.suffix_len - c0)], jnp.int32)
         last = job.done_chunks == job.n_chunks - 1
+        dp = self.devprof
+        t_dp = dp.begin() if dp.enabled else 0.0
         if not last:
             job.cache = self._chunk_step(self.params, chunk, job.cache,
                                          valid)
             job.done_chunks += 1
+            if dp.enabled:
+                dp.probe("chunk", job.cache.lengths, t_dp)
             return None
         last_idx = jnp.asarray([job.suffix_len - 1 - c0], jnp.int32)
         toks, cache = self._chunk_final(
@@ -1350,6 +1404,8 @@ class InferenceEngine:
             self.state, cache, jnp.asarray([job.slot], jnp.int32),
             jnp.asarray([job.true_len], jnp.int32), toks,
             job.temp, job.top_p, job.top_k, job.decode_key)
+        if dp.enabled:
+            dp.probe("chunk", self.state.last_token, t_dp)
         # The finished buffer holds the FULL prompt's KV — scatter its
         # unresident whole blocks into the pool before it is dropped.
         # Completed chunked prefills are exactly the long shared
@@ -1604,9 +1660,13 @@ class InferenceEngine:
         if draft.shape != (self.max_slots, k):
             raise EngineError(
                 f"draft shape {draft.shape} != {(self.max_slots, k)}")
+        dp = self.devprof
+        t_dp = dp.begin() if dp.enabled else 0.0
         self.state, toks, n_emit = self._verify(
             self.params, self.state, jnp.asarray(draft, jnp.int32),
             jnp.asarray(n_draft, jnp.int32))
+        if dp.enabled:
+            dp.probe("verify", toks, t_dp)
         return np.asarray(toks), np.asarray(n_emit)
 
     def decode_steps_dispatch(self) -> jax.Array:
@@ -1615,8 +1675,17 @@ class InferenceEngine:
         enqueue block N+1 and only then block on block N's tokens, so the
         host-side work (transfer, detokenize, emit) overlaps block N+1's
         device execution (SURVEY §7 hard-part 3: double-buffered token
-        fetch)."""
+        fetch).
+
+        A firing symprof probe (tpu.profile_sample) deliberately syncs
+        THIS dispatch before returning — draining the pipeline is what
+        makes the following dispatch gap a true device-idle sample; the
+        1-in-N cadence bounds the serialization cost."""
+        dp = self.devprof
+        t_dp = dp.begin() if dp.enabled else 0.0
         self.state, toks = self._decode(self.params, self.state)
+        if dp.enabled:
+            dp.probe("decode_block", toks, t_dp)
         return toks
 
     def decode_steps(self) -> np.ndarray:
@@ -1789,6 +1858,8 @@ class InferenceEngine:
             speculative=SpecConfig.from_knob(
                 getattr(tpu_cfg, "speculative", None)),
             fused_dequant=bool(getattr(tpu_cfg, "fused_dequant", False)),
+            profile_sample=int(
+                getattr(tpu_cfg, "profile_sample", 0) or 0),
             # "disagg" is the BACKEND's role (it spawns a prefill and a
             # decode host, each of which sees its own tier role here);
             # an engine can only be one tier or unified.

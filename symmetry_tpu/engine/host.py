@@ -39,6 +39,10 @@ Protocol: JSON lines.
             reply carries this process's utils/metrics.py families —
             the provider merges them tier-labeled into its Prometheus
             exposition and the peer-wire metrics reply)
+           {"op": "profile", "duration_s": float?, "dir": str?}
+            (on-demand jax.profiler capture, utils/devprof.py: runs a
+            bounded device trace on its OWN thread — the serve loop
+            and every stream keep flowing — and replies when done)
            {"op": "stats"} | {"op": "shutdown"}
   stdout → {"op": "ready", "model": …}            (after warmup)
            {"op": "clock", "t0", "t": our monotonic at receipt}
@@ -59,6 +63,9 @@ Protocol: JSON lines.
             tier prefills it whole)
            {"op": "metrics", "role", "families": {…}}   (registry
             snapshot, utils/metrics.py shape)
+           {"op": "profile", "path"} | {"op": "profile", "error"}
+            (capture finished: the trace-artifact directory, or why
+            the capture could not run — e.g. one already in progress)
            {"op": "stats", …}   (scheduler counters incl. deferred_depth,
             prefill_jobs_active, the prefix_cache hit/miss/evict/bytes
             block when the shared-prefix KV cache is enabled, and the
@@ -89,6 +96,7 @@ Run: python -m symmetry_tpu.engine.host <config.yaml>
 from __future__ import annotations
 
 import json
+import os
 import sys
 import threading
 import time
@@ -424,6 +432,8 @@ class EngineHost:
                 self._write(m)
             elif op == HostOp.METRICS:
                 self._handle_metrics()
+            elif op == HostOp.PROFILE:
+                self._handle_profile(msg)
             elif op == HostOp.SHUTDOWN:
                 break
         self._scheduler.stop()
@@ -450,13 +460,50 @@ class EngineHost:
     def _handle_trace(self) -> None:
         """Span-ring snapshot: this process's host + scheduler rings,
         stamps on this process's clock (the provider adds its measured
-        offset when merging)."""
+        offset when merging), plus the symprof DEVICE track (probed
+        per-kind device spans + dispatch gaps) when tpu.profile_sample
+        is on — the device row that renders beside the request spans."""
         comps = [self.tracer.component("host")]
         trace_export = getattr(self._scheduler, "trace_export", None)
         if trace_export is not None:
             comps.append(trace_export())
+        devprof = getattr(self._engine, "devprof", None)
+        if devprof is not None and devprof.enabled:
+            comps.append(devprof.component("device"))
         self._write({"op": HostOp.TRACE, "clock": time.monotonic(),
                      "components": comps})
+
+    def _handle_profile(self, msg: dict) -> None:
+        """On-demand jax.profiler capture (utils/devprof.py): the
+        capture sleeps for its whole window, so it runs on its OWN
+        daemon thread — the serve loop keeps reading commands and the
+        engine keeps dispatching (the capture's entire point is to
+        observe live traffic). The reply is written when the capture
+        finishes; a concurrent capture request is refused loudly."""
+        import tempfile
+
+        from symmetry_tpu.utils.devprof import capture_device_profile
+
+        # `is None`, not `or`: an explicit duration_s of 0 means the
+        # minimal instant capture, not the 2 s default.
+        raw = msg.get("duration_s")
+        duration_s = 2.0 if raw is None else float(raw)
+        out_dir = str(msg.get("dir") or "") or os.path.join(
+            tempfile.gettempdir(), "symmetry_tpu_profiles")
+
+        def run() -> None:
+            try:
+                path = capture_device_profile(out_dir, duration_s)
+            except Exception as exc:  # noqa: BLE001 — reply, never crash
+                self._write({"op": HostOp.PROFILE, "error": str(exc)})
+                return
+            logger.info(f"device profile captured → {path} "
+                        f"({duration_s:.1f}s window)")
+            self._write({"op": HostOp.PROFILE, "path": path,
+                         "duration_s": duration_s})
+
+        threading.Thread(target=run, name="jax-profile",
+                         daemon=True).start()
 
     # --------------------------------------------------------------- submit
 

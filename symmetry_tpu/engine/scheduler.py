@@ -412,6 +412,13 @@ class Scheduler:
                 nbytes = int(wsb())
                 out["weight_bytes_per_step"] = nbytes
                 out["weight_stream_gbs"] = round(nbytes / step_s / 1e9, 1)
+        # symprof device-time attribution (utils/devprof.py,
+        # tpu.profile_sample): per-dispatch-kind DEVICE-duration
+        # percentiles + the dispatch-gap distribution/share, riding the
+        # same host stats op → provider engine block → bench JSON.
+        dp = getattr(self.engine, "devprof", None)
+        if dp is not None and dp.enabled:
+            out["devprof"] = dp.stats()
         # Shared-prefix KV cache counters (hit/miss/evict/bytes) ride the
         # same host stats op so they surface provider- and bench-side.
         pc_stats = getattr(self.engine, "prefix_cache_stats", None)

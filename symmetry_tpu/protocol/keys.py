@@ -50,6 +50,11 @@ class MessageKey:
                                               # snapshot (client, provider, host,
                                               # scheduler components) for the
                                               # Perfetto timeline export
+    PROFILE = "profileCapture"                # client ⇄ provider: trigger one
+                                              # bounded on-device jax.profiler
+                                              # capture (HostOp.PROFILE under-
+                                              # neath); the reply carries the
+                                              # trace-artifact path or an error
 
     # --- relay (NAT fallback: server splices client↔provider, payload
     #     stays end-to-end Noise-encrypted — the reference gets this leg
@@ -87,6 +92,12 @@ class HostOp:
                             # merges them tier-labeled into its own
                             # exposition and the MessageKey.METRICS
                             # reply — the swarm path needs no open port)
+    PROFILE = "profile"     # on-demand jax.profiler capture: the host
+                            # runs a bounded device trace off the
+                            # serve loop and echoes the artifact path
+                            # (or an error) back — triggered by the
+                            # provider wire op, SIGUSR1, or the SLO
+                            # burn-rate breach hook (utils/devprof.py)
     SHUTDOWN = "shutdown"   # graceful drain + exit
 
     # --- frames: host stdout → provider ---

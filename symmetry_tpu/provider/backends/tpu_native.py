@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import time
 import uuid
 from typing import Any, AsyncIterator
@@ -50,7 +51,8 @@ class _DecodeMember:
         self.reader: asyncio.Task | None = None
         self.clock_offset = 0.0
         self.waiters: dict[str, list[asyncio.Future]] = {
-            HostOp.STATS: [], HostOp.TRACE: [], HostOp.METRICS: []}
+            HostOp.STATS: [], HostOp.TRACE: [], HostOp.METRICS: [],
+            HostOp.PROFILE: []}
         self.down = asyncio.Event()
         self.dead = False
         self.engine_alive = True
@@ -186,6 +188,7 @@ class TpuNativeBackend(InferenceBackend):
         self._stats_waiters: list[asyncio.Future] = []
         self._trace_waiters: list[asyncio.Future] = []
         self._metrics_waiters: list[asyncio.Future] = []
+        self._profile_waiters: list[asyncio.Future] = []
         # --- engine-host supervision (process mode) -------------------
         sup = config.tpu.supervisor or {}
         self._sup_enabled = bool(sup.get("enabled", True))
@@ -721,7 +724,8 @@ class TpuNativeBackend(InferenceBackend):
             if not isinstance(msg, dict):
                 continue
             op = msg.get("op")
-            if op in (HostOp.STATS, HostOp.TRACE, HostOp.METRICS):
+            if op in (HostOp.STATS, HostOp.TRACE, HostOp.METRICS,
+                      HostOp.PROFILE):
                 if op == HostOp.STATS:
                     m.engine_alive = bool(msg.get("engine_alive", True))
                 waiters, m.waiters[op] = m.waiters[op], []
@@ -850,10 +854,12 @@ class TpuNativeBackend(InferenceBackend):
                 break
 
     async def _probe_member(self, m: _DecodeMember, op: str,
-                            timeout: float = 10.0) -> dict | None:
+                            timeout: float = 10.0,
+                            payload: dict | None = None) -> dict | None:
         if m.proc is None or m.dead:
             return None
-        return await self._probe(op, m.waiters[op], m.proc, timeout)
+        return await self._probe(op, m.waiters[op], m.proc, timeout,
+                                 payload=payload)
 
     async def _pool_heartbeat(self) -> None:
         """Pool watchdog + gauge feed: probe each decode member's stats
@@ -1157,6 +1163,14 @@ class TpuNativeBackend(InferenceBackend):
                     if not w.done():
                         w.set_result(msg)
                 continue
+            if op == HostOp.PROFILE:
+                # Capture-finished reply (arrives duration_s after the
+                # request — the host runs it off its serve loop).
+                waiters, self._profile_waiters = self._profile_waiters, []
+                for w in waiters:
+                    if not w.done():
+                        w.set_result(msg)
+                continue
             if op == HostOp.EVENTS:
                 # Batched frame: one pipe line carries every slot's delta
                 # for a decode block. Fan out in frame order — per-request
@@ -1301,7 +1315,7 @@ class TpuNativeBackend(InferenceBackend):
                           "emitted": self._journal.get(req_id),
                           "error": reason, "text": ""})
         for w in (self._stats_waiters + self._trace_waiters
-                  + self._metrics_waiters
+                  + self._metrics_waiters + self._profile_waiters
                   + self._prefill_stats_waiters
                   + self._prefill_trace_waiters
                   + self._prefill_metrics_waiters):
@@ -1310,6 +1324,11 @@ class TpuNativeBackend(InferenceBackend):
         self._stats_waiters.clear()
         self._trace_waiters.clear()
         self._metrics_waiters.clear()
+        # Profile waiters too: a capture in flight when the host dies
+        # must fail fast like every other probe — its generous
+        # duration+90s timeout would otherwise pin the provider's
+        # single-flight capture slot for minutes after the host is gone.
+        self._profile_waiters.clear()
         self._prefill_stats_waiters.clear()
         self._prefill_trace_waiters.clear()
         self._prefill_metrics_waiters.clear()
@@ -1635,17 +1654,20 @@ class TpuNativeBackend(InferenceBackend):
 
     async def _probe(self, op: str, waiters: list,
                      proc: asyncio.subprocess.Process | None,
-                     timeout: float) -> dict | None:
+                     timeout: float,
+                     payload: dict | None = None) -> dict | None:
         """One fresh op round-trip to a host; None on timeout/failure
         (a fire-and-forget probe would return the PREVIOUS probe's answer,
-        delaying wedge detection by a health-loop period)."""
+        delaying wedge detection by a health-loop period). `payload`
+        rides extra command fields (the profile op's duration/dir)."""
         import contextlib
 
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         waiters.append(fut)
         try:
             with contextlib.suppress(ConnectionError, OSError):
-                await self._host_send({"op": op}, proc=proc)
+                await self._host_send({"op": op, **(payload or {})},
+                                      proc=proc)
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
             return None
@@ -1754,6 +1776,55 @@ class TpuNativeBackend(InferenceBackend):
             if trace_export is not None:
                 return [trace_export()]  # same process — offset 0
         return []
+
+    async def capture_profile(self, duration_s: float = 2.0,
+                              out_dir: str | None = None) -> dict:
+        """On-demand jax.profiler capture on the serving engine
+        (HostOp.PROFILE): process mode forwards to the primary host —
+        the decode tier in disagg (where the steady-state decode loop
+        lives), the first live member in pool mode — and awaits the
+        capture-finished reply; inproc runs the capture in an executor
+        thread against this process's devices. Returns {"path"} on
+        success or {"error"} (capture already running, host down)."""
+        payload = {"duration_s": float(duration_s),
+                   **({"dir": out_dir} if out_dir else {})}
+        # Generous beyond the window: the process's FIRST capture pays
+        # the profiler's cold init (tens of seconds on a loaded host).
+        timeout = float(duration_s) + 90.0
+        if self._process_mode and self._pool_mode:
+            m0 = next((m for m in self._decode_members.values()
+                       if m.alive), None)
+            if m0 is None:
+                return {"error": "no live decode member"}
+            msg = await self._probe_member(m0, HostOp.PROFILE,
+                                           timeout=timeout,
+                                           payload=payload)
+            return ({k: v for k, v in msg.items() if k != "op"}
+                    if msg is not None
+                    else {"error": "profile probe failed (host down or timed out)"})
+        if self._process_mode:
+            if (self._proc is None or self._host_dead
+                    or self._proc.returncode is not None):
+                return {"error": "engine host is down"}
+            msg = await self._probe(HostOp.PROFILE, self._profile_waiters,
+                                    None, timeout, payload=payload)
+            return ({k: v for k, v in msg.items() if k != "op"}
+                    if msg is not None
+                    else {"error": "profile probe failed (host down or timed out)"})
+        # inproc: same process, same devices — capture right here, off
+        # the event loop (the capture sleeps for its whole window).
+        import tempfile
+
+        from symmetry_tpu.utils.devprof import capture_device_profile
+
+        target = out_dir or os.path.join(tempfile.gettempdir(),
+                                         "symmetry_tpu_profiles")
+        try:
+            path = await asyncio.get_running_loop().run_in_executor(
+                None, capture_device_profile, target, float(duration_s))
+        except Exception as exc:  # noqa: BLE001 — reply, never raise
+            return {"error": str(exc)}
+        return {"path": path, "duration_s": float(duration_s)}
 
     async def metrics_snapshots(self) -> list[dict]:
         """The engine tier's metrics-registry snapshots, tier-labeled —

@@ -111,6 +111,17 @@ class TpuConfig:
     # service time instead of growing with the backlog. 0 disables
     # queueing (shed the moment every slot is busy).
     max_queue: int | None = None
+    # symprof device-time attribution (utils/devprof.py): every Nth
+    # engine dispatch of each kind (prefill/chunk/decode_block/verify/
+    # adopt/seed_gather/scatter) is completion-probed — timestamped
+    # block_until_ready — yielding per-kind DEVICE-duration histograms
+    # and the dispatch-gap series (host idle between device blocks, the
+    # rounds-3/4 steady-wire suspect) in stats/metrics/the Perfetto
+    # device track. 0 (default) disables: one branch per dispatch,
+    # CI-asserted like the metrics registry. Sampling serializes 1
+    # dispatch in N, so keep N large enough that tok/s stays within 1%
+    # (BASELINE.md Round 15 pre-registers the A/B).
+    profile_sample: int = 0
     # Request-scoped tracing (utils/trace.py): bounded span/counter rings
     # in the scheduler and host, read through the host-pipe `trace` op and
     # exported as a Perfetto timeline (provider `trace` op, bench.py

@@ -220,6 +220,17 @@ class SymmetryProvider:
         self._m_flight_dumps = METRICS.counter(
             MetricName.PROVIDER_FLIGHT_DUMPS,
             "flight-recorder dumps written", labels=("reason",))
+        # On-demand device profiler (utils/devprof.py, HostOp.PROFILE):
+        # a bounded jax.profiler capture on the serving engine,
+        # triggered by the `profileCapture` wire op, SIGUSR1, or — when
+        # profiler.onSloBreach is set — the SLO burn hook beside the
+        # flight recorder. Config (all optional):
+        #   profiler: {dir, durationS, onSloBreach}
+        self._profiler_cfg = self.config.get("profiler") or {}
+        self._profile_running = False
+        self._m_profile_captures = METRICS.counter(
+            MetricName.PROFILE_CAPTURES,
+            "on-demand device profile captures", labels=("reason",))
         # Stream resumption: resumes served (accepted/refused) and the
         # recovery-latency headline — interruption to first CONTINUATION
         # token (the resume request's TTFT as this provider saw it).
@@ -273,6 +284,7 @@ class SymmetryProvider:
         await self._join_dht()
         self._start_puncher()
         self._install_sigusr2()
+        self._install_sigusr1()
         self._start_metrics_server()
 
     def _start_metrics_server(self) -> None:
@@ -343,6 +355,14 @@ class SymmetryProvider:
         if self.flight is not None:
             self._spawn(self._flight_dump(f"slo_burn_{event['slo']}",
                                           force=True))
+        if self._profiler_cfg.get("onSloBreach"):
+            # Opt-in: a capture serializes sampled dispatches for its
+            # whole window, so burning error budget has to be judged
+            # worth the heavier evidence explicitly. The flight dump
+            # above shows WHAT burned; this shows what the DEVICE was
+            # doing while it burned.
+            self._spawn(self._capture_profile(
+                f"slo_burn_{event['slo']}"))
 
     def _install_sigusr2(self) -> None:
         """SIGUSR2 → flight-recorder dump (operator-triggered capture of
@@ -361,6 +381,55 @@ class SymmetryProvider:
             self._sigusr2_installed = True
         except (NotImplementedError, ValueError, RuntimeError):
             logger.debug("SIGUSR2 flight-recorder trigger unavailable "
+                         "on this platform/thread")
+
+    async def _capture_profile(self, reason: str,
+                               duration_s: float | None = None) -> dict:
+        """Run one on-demand device profile capture through the backend
+        (HostOp.PROFILE underneath). Single-flight: a capture already
+        in progress returns a structured error instead of queueing —
+        jax.profiler refuses concurrent traces, and stacking windows
+        behind an operator's trigger would measure the wrong moment."""
+        fn = getattr(self.backend, "capture_profile", None)
+        if fn is None:
+            return {"error": "backend has no device profiler"}
+        if self._profile_running:
+            return {"error": "a profile capture is already running"}
+        self._profile_running = True
+        try:
+            out = await fn(
+                duration_s=float(
+                    duration_s if duration_s is not None
+                    else self._profiler_cfg.get("durationS", 2.0)),
+                out_dir=self._profiler_cfg.get("dir"))
+        except Exception as exc:  # noqa: BLE001 — diagnostics only
+            out = {"error": str(exc)}
+        finally:
+            self._profile_running = False
+        if out.get("path"):
+            self._m_profile_captures.inc(reason=reason)
+            logger.warning(f"device profile ({reason}) → {out['path']}")
+        else:
+            logger.warning(f"device profile ({reason}) failed: "
+                           f"{out.get('error')}")
+        return out
+
+    def _install_sigusr1(self) -> None:
+        """SIGUSR1 → on-demand device profile capture (the operator's
+        'what is the chip doing RIGHT NOW' trigger, the jax.profiler
+        analog of SIGUSR2's flight dump). Best-effort like SIGUSR2."""
+        self._sigusr1_installed = False
+        if getattr(self.backend, "capture_profile", None) is None:
+            return
+        import signal
+
+        try:
+            asyncio.get_running_loop().add_signal_handler(
+                signal.SIGUSR1,
+                lambda: self._spawn(self._capture_profile("sigusr1")))
+            self._sigusr1_installed = True
+        except (NotImplementedError, ValueError, RuntimeError):
+            logger.debug("SIGUSR1 profile-capture trigger unavailable "
                          "on this platform/thread")
 
     def _on_backend_restart(self, reason: str) -> None:
@@ -815,6 +884,33 @@ class SymmetryProvider:
                     # export — the request-tracing analog of METRICS.
                     await peer.send(MessageKey.TRACE,
                                     await self.gather_trace())
+                elif msg.key == MessageKey.PROFILE:
+                    # On-demand device profile: run one bounded
+                    # jax.profiler capture on the engine and reply with
+                    # the artifact path (or a structured error). SPAWNED
+                    # like an inference — the capture (plus the
+                    # process's first-capture cold init) spans tens of
+                    # seconds, and awaiting it inline would stall THIS
+                    # peer's whole message loop: submits unread, cancels
+                    # undelivered, pings unanswered for the window. The
+                    # window itself is clamped — durationS is
+                    # client-supplied and must not pin the single-flight
+                    # capture slot indefinitely.
+                    d = (msg.data or {}).get("durationS")
+                    try:
+                        d = min(float(d), 120.0) if d is not None else None
+                    except (TypeError, ValueError):
+                        d = None
+
+                    async def _profile_reply(peer=peer,
+                                             duration_s=d) -> None:
+                        out = await self._capture_profile(
+                            "wire", duration_s=duration_s)
+                        with contextlib.suppress(ConnectionError,
+                                                 OSError):
+                            await peer.send(MessageKey.PROFILE, out)
+
+                    self._spawn(_profile_reply())
                 elif msg.key == MessageKey.LEAVE:
                     break
         finally:

@@ -103,6 +103,20 @@ class MetricName:
     SCHED_DISPATCH = "sym_sched_dispatch_seconds"            # {kind}
     SCHED_TTFT = "sym_sched_ttft_seconds"
 
+    # --- symprof device-time attribution (utils/devprof.py; lives in
+    #     the host process beside the engine, tier-labeled through the
+    #     HostOp.METRICS probe). Device durations come from sampling
+    #     completion probes (`tpu.profile_sample`); the dispatch gap is
+    #     host idle between a probed device completion and the next
+    #     dispatch — the steady-wire suspect, measured on-device.
+    DEVICE_DISPATCH = "sym_device_dispatch_seconds"          # {kind}
+    DEVICE_PROBES = "sym_device_probes_total"                # {kind}
+    DISPATCH_GAP = "sym_dispatch_gap_seconds"
+    DISPATCH_GAP_SHARE = "sym_dispatch_gap_share"
+    # On-demand jax.profiler captures (provider wire op / SIGUSR1 / SLO
+    # burn hook → HostOp.PROFILE), booked by the provider per trigger.
+    PROFILE_CAPTURES = "sym_profile_captures_total"          # {reason}
+
     # --- radix prefix cache (engine/prefix_cache.py; lives in the host
     #     process, tier-labeled through the HostOp.METRICS probe)
     PREFIX_BLOCKS_IN_USE = "sym_prefix_blocks_in_use"

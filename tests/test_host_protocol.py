@@ -233,3 +233,59 @@ class TestTpuNativeMixedFrames:
 
         asyncio.new_event_loop().run_until_complete(
             asyncio.wait_for(main(), 30))
+
+
+class TestHostProfileOp:
+    """HostOp.PROFILE round-trip (PR-15): the capture runs on its own
+    thread (the serve loop must keep flowing for its whole window) and
+    the reply carries the artifact path — or a structured error when a
+    capture is already holding the single-flight window."""
+
+    def _wait_reply(self, capsys, timeout=120.0):
+        # Generous: the process's FIRST jax.profiler capture pays a
+        # cold-init cost of tens of seconds on a loaded box.
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            out = capsys.readouterr().out
+            if out.strip():
+                return [json.loads(line)
+                        for line in out.strip().splitlines()]
+            _time.sleep(0.05)
+        raise AssertionError("no profile reply on the pipe")
+
+    def test_profile_op_replies_with_artifact_path(self, capsys,
+                                                   tmp_path):
+        import os
+
+        host = EngineHost(config=None)
+        host._handle_profile({"op": "profile", "duration_s": 0.05,
+                              "dir": str(tmp_path)})
+        (reply,) = self._wait_reply(capsys)
+        assert reply["op"] == "profile"
+        assert reply.get("error") is None, reply
+        assert os.path.isdir(reply["path"])
+        assert str(tmp_path) in reply["path"]
+
+    def test_concurrent_capture_refused_as_error_reply(self, capsys,
+                                                       tmp_path):
+        import threading
+        import time as _time
+
+        from symmetry_tpu.utils.devprof import capture_device_profile
+
+        host = EngineHost(config=None)
+        hold = threading.Thread(target=capture_device_profile,
+                                args=(str(tmp_path),),
+                                kwargs={"duration_s": 0.8})
+        hold.start()
+        _time.sleep(0.2)
+        host._handle_profile({"op": "profile", "duration_s": 0.05,
+                              "dir": str(tmp_path)})
+        try:
+            (reply,) = self._wait_reply(capsys)
+        finally:
+            hold.join()
+        assert reply["op"] == "profile"
+        assert "already running" in (reply.get("error") or "")

@@ -520,3 +520,121 @@ class TestSymtop:
         assert rows[0]["tok_s"] == pytest.approx(100.0)
         # shed is a RATE between polls, not the lifetime total
         assert rows[0]["shed"] == pytest.approx(5.0)
+
+
+# ---------------------------------------- resume / pool family exposition
+
+
+RESUME_POOL_GOLDEN = """\
+# HELP sym_resume_requests_total resumes handled
+# TYPE sym_resume_requests_total counter
+sym_resume_requests_total{outcome="resumed"} 3
+sym_resume_requests_total{outcome="refused"} 1
+# HELP sym_resume_wasted_tokens_total overlap tokens dedup dropped
+# TYPE sym_resume_wasted_tokens_total counter
+sym_resume_wasted_tokens_total 17
+# HELP sym_resume_reused_tokens_total radix tokens resumes reused
+# TYPE sym_resume_reused_tokens_total counter
+sym_resume_reused_tokens_total{tier="decode"} 96
+# HELP sym_provider_flight_dumps_total flight-recorder dumps written
+# TYPE sym_provider_flight_dumps_total counter
+sym_provider_flight_dumps_total{reason="slo_burn_ttft"} 2
+# HELP sym_pool_placements_total lifetime placements
+# TYPE sym_pool_placements_total counter
+sym_pool_placements_total{node="p0",tier="prefill"} 5
+sym_pool_placements_total{node="p1",tier="prefill"} 3
+# HELP sym_pool_member_state membership state code
+# TYPE sym_pool_member_state gauge
+sym_pool_member_state{node="p0",tier="prefill"} 1
+sym_pool_member_state{node="p1",tier="prefill"} 3
+"""
+
+
+class TestResumePoolExposition:
+    """PR-15 satellite: the PR-11/14 families symtop now renders get the
+    same golden-exposition + parse-round-trip coverage the PR-10
+    scheduler/provider families have — a format drift in THESE names is
+    a silently-empty RESUME/DUMPS/STATE column, not an error."""
+
+    def _registry(self) -> MetricsRegistry:
+        r = MetricsRegistry()
+        r.counter(MetricName.PROVIDER_RESUMES, "resumes handled",
+                  labels=("outcome",)).inc(3, outcome="resumed")
+        r.counter(MetricName.PROVIDER_RESUMES, "resumes handled",
+                  labels=("outcome",)).inc(1, outcome="refused")
+        r.counter(MetricName.RESUME_WASTED_TOKENS,
+                  "overlap tokens dedup dropped").inc(17)
+        r.counter(MetricName.SCHED_RESUME_REUSED,
+                  "radix tokens resumes reused",
+                  labels=("tier",)).inc(96, tier="decode")
+        r.counter(MetricName.PROVIDER_FLIGHT_DUMPS,
+                  "flight-recorder dumps written",
+                  labels=("reason",)).inc(2, reason="slo_burn_ttft")
+        pool = r.counter(MetricName.POOL_PLACEMENTS, "lifetime placements",
+                         labels=("tier", "node"))
+        pool.inc(5, tier="prefill", node="p0")
+        pool.inc(3, tier="prefill", node="p1")
+        state = r.gauge(MetricName.POOL_MEMBER_STATE,
+                        "membership state code", labels=("tier", "node"))
+        state.set(1, tier="prefill", node="p0")   # healthy
+        state.set(3, tier="prefill", node="p1")   # lost
+        return r
+
+    def test_resume_pool_golden_exposition(self):
+        text = render_prometheus(
+            [{"snapshot": self._registry().snapshot(compact=True),
+              "labels": {}}])
+        assert text == RESUME_POOL_GOLDEN
+
+    def test_resume_pool_parse_round_trip(self):
+        r = self._registry()
+        fams = parse_prometheus_text(render_prometheus(
+            [{"snapshot": r.snapshot(compact=True), "labels": {}}]))
+        res = fams[MetricName.PROVIDER_RESUMES]
+        assert res["kind"] == "counter"
+        assert {s["labels"]["outcome"]: s["value"]
+                for s in res["series"]} == {"resumed": 3.0, "refused": 1.0}
+        (wasted,) = fams[MetricName.RESUME_WASTED_TOKENS]["series"]
+        assert wasted["value"] == 17.0
+        (reused,) = fams[MetricName.SCHED_RESUME_REUSED]["series"]
+        assert reused["labels"]["tier"] == "decode"
+        assert reused["value"] == 96.0
+        dumps = fams[MetricName.PROVIDER_FLIGHT_DUMPS]["series"]
+        assert dumps[0]["labels"]["reason"] == "slo_burn_ttft"
+        states = {s["labels"]["node"]: s["value"]
+                  for s in fams[MetricName.POOL_MEMBER_STATE]["series"]}
+        assert states == {"p0": 1.0, "p1": 3.0}
+
+    def test_symtop_resume_and_dump_columns(self):
+        """The provider row shows resumes/wasted/dumps; tier sub-rows
+        show resume admissions + reused tokens (the cheap-resume
+        contract reads straight off the table)."""
+        import tools.symtop as symtop
+
+        r = self._registry()
+        r.counter(MetricName.PROVIDER_TOKENS_OUT, "t").inc(100)
+        r.gauge(MetricName.PROVIDER_UPTIME, "u").set(10.0)
+        sched = MetricsRegistry()
+        sched.gauge(MetricName.SCHED_OCCUPANCY, "o").set(1)
+        sched.counter(MetricName.SCHED_RESUMES, "resume admissions").inc(2)
+        sched.counter(MetricName.SCHED_RESUME_REUSED,
+                      "reused").inc(96)
+        fams = symtop.families_from_snapshots([
+            {"snapshot": r.snapshot(compact=True), "labels": {}},
+            {"snapshot": sched.snapshot(compact=True),
+             "labels": {"tier": "decode"}},
+        ])
+        rows = symtop.build_rows("prov-a", fams, None, now=0.0)
+        assert rows[0]["resume"] == 4.0      # resumed + refused
+        assert rows[0]["wasted"] == 17.0
+        assert rows[0]["dumps"] == 2.0
+        tier = rows[1]
+        assert tier["tier"] == "decode"
+        assert tier["resume"] == 2.0
+        assert tier["reused"] == 96.0 * 2    # registry + sched snapshots
+        rows[0].pop("_sample", None)
+        table = symtop.render_table(rows)
+        header = table.splitlines()[0]
+        for col in ("RESUME", "WASTED", "REUSED", "DUMPS"):
+            assert col in header
+        assert "17" in table and "prov-a" in table

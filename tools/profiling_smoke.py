@@ -1,0 +1,237 @@
+#!/usr/bin/env python3
+"""CI smoke for the symprof layer + benchdiff (no TPU, no network).
+
+Phase 1 — device-time attribution on a live scheduler: a tiny inproc
+engine with `tpu.profile_sample` = 1 serves real scheduler traffic
+(plain prompts + a chunked long prompt); the devprof stats block must
+carry per-kind device-duration p50s and a dispatch-gap share, and the
+merged Perfetto export must contain a `device` process track with at
+least one slice per probed kind and no negative timestamps. The export
+is written to --out and uploaded as a workflow artifact.
+
+Phase 2 — benchdiff verdicts on a REAL capture: one `bench.py --smoke
+--profile-sample 1` run produces a stamped capture (asserting the
+bench-side devprof block on the way); benchdiff must exit 0 against an
+equal copy (markdown table emitted), 1 against a tampered-regression
+copy, and 2 against a fingerprint-mismatched copy.
+
+Phase 3 — the on-demand jax.profiler capture: one bounded
+capture_device_profile window must produce a non-empty trace directory
+and the single-flight guard must refuse a concurrent capture.
+
+Run: python tools/profiling_smoke.py [--out profiling_smoke_perfetto.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def log(msg: str) -> None:
+    print(f"[profiling_smoke] {msg}", flush=True)
+
+
+def phase1_device_track(out_path: str) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
+    from symmetry_tpu.engine.scheduler import GenRequest, Scheduler
+    from symmetry_tpu.engine.tokenizer import ByteTokenizer
+    from symmetry_tpu.models import init_params, preset
+    from symmetry_tpu.utils.trace import export_perfetto
+
+    cfg = preset("tiny")
+    params = init_params(cfg, jax.random.key(0), jnp.float32)
+    engine = InferenceEngine(
+        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=96,
+        prefill_buckets=(16, 48), cache_dtype=jnp.float32,
+        decode_block=2, prefill_chunk=16, profile_sample=1)
+    engine.warmup()
+    sched = Scheduler(engine, debug_invariants=True)
+
+    results: dict[int, list] = {0: [], 1: [], 2: []}
+    done = {i: threading.Event() for i in results}
+    prompts = [list(b"hello symprof"), list(b"second stream"),
+               # > prefill_chunk: drives the chunked-prefill path so the
+               # `chunk` dispatch kind gets probed too.
+               list(b"a long prompt that needs chunked prefill here..")]
+    for i, ids in enumerate(prompts):
+        def emit(ev, i=i):
+            results[i].append(ev)
+            if ev.done:
+                done[i].set()
+        sched.submit(GenRequest(prompt_ids=ids, sampling=SamplingParams(),
+                                max_new_tokens=12, emit=emit, id=f"r{i}"))
+    sched.start()
+    for ev in done.values():
+        assert ev.wait(180), "request did not complete"
+    sched.stop()
+
+    stats = sched.stats()
+    dev = stats.get("devprof")
+    assert dev, "scheduler stats carry no devprof block"
+    probes = dev.get("probes") or {}
+    for kind in ("prefill", "chunk", "decode_block"):
+        assert probes.get(kind, 0) >= 1, \
+            f"no completion probe fired for kind {kind!r}: {probes}"
+        p50 = (dev["device_s"].get(kind) or {}).get("p50")
+        assert p50 is not None and p50 >= 0, \
+            f"kind {kind!r} has no device-duration p50"
+    gap = dev.get("dispatch_gap_s") or {}
+    assert gap.get("count", 0) >= 1, "no dispatch-gap samples"
+    assert dev.get("gap_share") is not None, "no gap_share"
+    assert 0.0 <= dev["gap_share"] <= 1.0, dev["gap_share"]
+    log(f"devprof: probes={probes} gap_share={dev['gap_share']} "
+        f"gap_p50={gap.get('p50')}")
+
+    # The merged export: scheduler spans + the device track, exactly the
+    # components the host's `trace` op ships in process mode.
+    perfetto = export_perfetto([sched.trace_export(),
+                                engine.devprof.component("device")])
+    events = perfetto["traceEvents"]
+    pids = {e["args"]["name"]: e["pid"] for e in events
+            if e.get("name") == "process_name"}
+    assert "device" in pids, f"no device process track: {sorted(pids)}"
+    dev_pid = pids["device"]
+    dev_slices = [e for e in events
+                  if e.get("ph") == "X" and e.get("pid") == dev_pid]
+    kinds = {e["name"] for e in dev_slices}
+    for kind in ("prefill", "chunk", "decode_block", "dispatch_gap"):
+        assert kind in kinds, \
+            f"device track missing a {kind!r} slice: {sorted(kinds)}"
+    for e in events:
+        if e.get("ph") in ("X", "C"):
+            assert e["ts"] >= 0, f"negative timestamp: {e}"
+            assert e.get("dur", 0) >= 0, f"negative duration: {e}"
+    with open(out_path, "w") as fh:
+        json.dump(perfetto, fh)
+    log(f"phase 1 OK: device track with {len(dev_slices)} slices "
+        f"({sorted(kinds)}) → {out_path}")
+
+
+def phase2_benchdiff() -> None:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
+         "--profile-sample", "1"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode in (0, None) and out.stdout.strip(), \
+        f"bench --smoke failed rc={out.returncode}:\n{out.stderr[-2000:]}"
+    capture = json.loads(out.stdout.strip().splitlines()[-1])
+    # The acceptance contract: a profile_sample'd bench reports per-kind
+    # device p50s and a dispatch-gap share in its JSON, stamped.
+    assert capture.get("schema") == 1, capture.get("schema")
+    assert capture.get("config_fingerprint"), "capture is unstamped"
+    assert capture.get("config", {}).get("mode") == "smoke"
+    dev = capture.get("devprof") or {}
+    p50s = dev.get("device_p50_ms") or {}
+    assert p50s.get("prefill") is not None, p50s
+    assert p50s.get("decode_block") is not None, p50s
+    assert dev.get("gap_share") is not None, dev
+    log(f"bench --smoke devprof: p50s={p50s} gap_share={dev['gap_share']}")
+
+    tmp = tempfile.mkdtemp(prefix="benchdiff_smoke_")
+    base = os.path.join(tmp, "base.json")
+    with open(base, "w") as fh:
+        json.dump(capture, fh)
+
+    def run_diff(cand_obj: dict, *args: str) -> tuple[int, str]:
+        cand = os.path.join(tmp, "cand.json")
+        with open(cand, "w") as fh:
+            json.dump(cand_obj, fh)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "benchdiff.py"),
+             base, cand, *args],
+            capture_output=True, text=True, timeout=120)
+        return proc.returncode, proc.stdout + proc.stderr
+
+    # Equal capture → exit 0, with a markdown table.
+    rc, text = run_diff(capture)
+    assert rc == 0, f"equal-capture diff exited {rc}:\n{text}"
+    assert "| metric |" in text and "REGRESSED" not in text, text
+
+    # Tampered headline (half the tok/s) → exit 1, REGRESSED named.
+    worse = json.loads(json.dumps(capture))
+    worse["value"] = round(capture["value"] * 0.5, 1)
+    rc, text = run_diff(worse)
+    assert rc == 1, f"regressed diff exited {rc}:\n{text}"
+    assert "REGRESSED" in text, text
+
+    # Different config fingerprint → refused loudly, exit 2.
+    other = json.loads(json.dumps(capture))
+    other["config"] = {**other["config"], "slots": 99}
+    other["config_fingerprint"] = "feedfacefeedface"
+    rc, text = run_diff(other)
+    assert rc == 2, f"cross-config diff exited {rc} (want refusal):\n{text}"
+    assert "REFUSING" in text and "slots" in text, text
+    # ... unless forced (the deliberate knob-A/B path).
+    rc, text = run_diff(other, "--force")
+    assert rc in (0, 1), f"forced diff exited {rc}:\n{text}"
+    log("phase 2 OK: benchdiff exit codes 0/1/2 + markdown table")
+
+
+def phase3_capture() -> None:
+    from symmetry_tpu.utils.devprof import capture_device_profile
+
+    import jax
+    import jax.numpy as jnp
+
+    # A little device work for the window to observe.
+    def burn():
+        x = jnp.ones((64, 64))
+        for _ in range(20):
+            x = x @ x / 64.0
+        jax.block_until_ready(x)
+
+    tmp = tempfile.mkdtemp(prefix="profiling_smoke_jaxprof_")
+    t = threading.Thread(target=burn)
+    t.start()
+    path = capture_device_profile(tmp, duration_s=0.3)
+    t.join()
+    assert os.path.isdir(path), path
+    contents = [os.path.join(dp, f) for dp, _dn, fn in os.walk(path)
+                for f in fn]
+    assert contents, f"capture produced an empty trace dir: {path}"
+    # Single-flight guard: a concurrent capture must refuse, not queue.
+    hold = threading.Thread(
+        target=capture_device_profile, args=(tmp,), kwargs={"duration_s": 1.0})
+    hold.start()
+    time.sleep(0.2)
+    try:
+        capture_device_profile(tmp, duration_s=0.1)
+        raise AssertionError("concurrent capture was not refused")
+    except RuntimeError:
+        pass
+    finally:
+        hold.join()
+    log(f"phase 3 OK: jax.profiler capture → {path} "
+        f"({len(contents)} artifact file(s)); concurrent capture refused")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="profiling_smoke_perfetto.json")
+    args = ap.parse_args()
+    t0 = time.monotonic()
+    phase1_device_track(args.out)
+    phase2_benchdiff()
+    phase3_capture()
+    log(f"ALL PHASES OK in {time.monotonic() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,8 +46,9 @@ from symmetry_tpu.utils.metrics import (  # noqa: E402
 )
 
 COLUMNS = ("PROVIDER", "TIER", "TOK/S", "TTFT p50", "TTFT p99",
-           "QUEUE", "INFL", "OCC", "SHED", "LINK", "STATE", "SHARE")
-WIDTHS = (22, 10, 9, 9, 9, 7, 6, 5, 7, 6, 9, 6)
+           "QUEUE", "INFL", "OCC", "SHED", "RESUME", "WASTED", "REUSED",
+           "DUMPS", "LINK", "STATE", "SHARE")
+WIDTHS = (22, 10, 9, 9, 9, 7, 6, 5, 7, 7, 7, 7, 6, 6, 9, 6)
 
 # sym_pool_member_state gauge encoding (engine/disagg/pool.py
 # STATE_CODES) rendered back to the membership lifecycle names.
@@ -217,6 +218,15 @@ def build_rows(name: str, fams: dict,
         "in_flight": _value(fams, "sym_provider_in_flight"),
         "occupancy": None,
         "shed": shed_disp,
+        # Stream-resumption health (PR-14 families, lifetime totals):
+        # resumes served, overlap tokens the relay's dedup DROPPED
+        # (work the engine redid — should stay near zero), and the
+        # flight-recorder dump count (any nonzero DUMPS is a provider
+        # with post-mortem evidence waiting to be read).
+        "resume": _value(fams, "sym_resume_requests_total"),
+        "wasted": _value(fams, "sym_resume_wasted_tokens_total"),
+        "reused": None,
+        "dumps": _value(fams, "sym_provider_flight_dumps_total"),
         "link": (None if link is None else ("up" if link else "DOWN")),
         "state": None, "share": None,
         "_sample": {"t": now, "tok": tok, "shed": shed or 0.0},
@@ -237,6 +247,16 @@ def build_rows(name: str, fams: dict,
             "occupancy": _value(fams, "sym_sched_occupancy", tier=tier),
             "shed": _value(fams, "sym_sched_deadline_sheds_total",
                            tier=tier),
+            # Scheduler-side resume admissions and the radix tokens
+            # they reused instead of re-prefilling (reused > 0 is the
+            # cheap-resume contract; 0 with RESUME > 0 means resumes
+            # are paying full prefills — cache too small or misses).
+            "resume": _value(fams, "sym_resume_admissions_total",
+                             tier=tier),
+            "wasted": None,
+            "reused": _value(fams, "sym_resume_reused_tokens_total",
+                             tier=tier),
+            "dumps": None,
             "link": None,
         })
     rows.extend(_pool_rows(name, fams))
@@ -258,7 +278,9 @@ def render_table(rows: list[dict[str, Any]]) -> str:
     for r in rows:
         cells = (r["provider"], r["tier"] or "-", r["tok_s"],
                  r["ttft_p50"], r["ttft_p99"], r["queue"], r["in_flight"],
-                 r["occupancy"], r["shed"], r["link"] or "-",
+                 r["occupancy"], r["shed"], r.get("resume"),
+                 r.get("wasted"), r.get("reused"), r.get("dumps"),
+                 r["link"] or "-",
                  r.get("state") or "-", r.get("share") or "-")
         out.append("  ".join(_fmt_cell(c, w)
                              for c, w in zip(cells, WIDTHS)))
