@@ -126,7 +126,7 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
               max_seq: int, dtype_name: str, mesh_model: int,
               block: int = 1, quant: str | None = None,
               kv_quant: bool = False, fused_dequant: bool = False,
-              profile_sample: int = 0) -> dict:
+              profile_sample: int = 0, pipeline_depth: int = 1) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -180,20 +180,37 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
     import numpy as np
 
     # One warm dispatch, then measure. `steps` counts decode steps; each
-    # dispatch advances `block` of them. Double-buffered like the serving
-    # scheduler: block N+1 is dispatched before syncing block N's tokens,
-    # so the host round-trip rides behind device compute.
+    # dispatch advances `block` of them. Pipelined like the serving
+    # scheduler (--pipeline-depth, default 1 = the historical double
+    # buffer: block N+1 dispatched before syncing block N's tokens):
+    # `depth` blocks stay in flight, the oldest is synced once the
+    # pipeline is full. Per-iteration host wall is sampled so the bench
+    # JSON carries the dispatch-thread-per-block number the scheduler's
+    # stats() splits out (here there is no emit work, so this is the
+    # floor: dispatch + sync cost alone).
+    from collections import deque
+
     engine.decode_steps()
     n_disp = max(1, steps // block)
+    depth = max(1, pipeline_depth)
+    in_flight: deque = deque()
+    iter_walls: list[float] = []
     t0 = time.perf_counter()
-    pending = None
     for _ in range(n_disp):
-        nxt = engine.decode_steps_dispatch()
-        if pending is not None:
-            np.asarray(pending)
-        pending = nxt
-    np.asarray(pending)
+        t_it = time.perf_counter()
+        in_flight.append(engine.decode_steps_dispatch())
+        if len(in_flight) > depth:
+            np.asarray(in_flight.popleft())
+        iter_walls.append(time.perf_counter() - t_it)
+    while in_flight:
+        np.asarray(in_flight.popleft())
     dt = time.perf_counter() - t0
+    walls = sorted(iter_walls)
+    disp_wall = {
+        "p50": round(walls[len(walls) // 2], 6),
+        "p99": round(walls[min(len(walls) - 1,
+                               int(len(walls) * 0.99))], 6),
+    }
 
     done_steps = n_disp * block
     tok_s = slots * done_steps / dt
@@ -231,6 +248,8 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
         "decode_step_ms": round(1e3 * step_s, 2),
         "weight_bytes_per_step": weight_bytes,
         "weight_stream_gbs": round(weight_bytes / step_s / 1e9, 1),
+        "pipeline_depth": depth,
+        "dispatch_thread_block_s": disp_wall,
         **({"devprof": devprof_block} if devprof_block else {}),
     }
 
@@ -540,7 +559,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             disagg_pool: tuple[int, int] | None = None,
             multi_turn: int = 1,
             metrics_out: str | None = None,
-            profile_sample: int = 0) -> dict:
+            profile_sample: int = 0,
+            pipeline_depth: int | None = None) -> dict:
     """The NORTH-STAR measurement (BASELINE.json metric): aggregate WIRE
     tok/s and p50/p99 TTFT through the full serving path — server +
     tpu_native provider + N concurrent streaming clients over TCP
@@ -606,6 +626,11 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 **({"speculative": {"k_draft": draft_k}}
                    if speculative else {}),
                 **({"fused_dequant": True} if fused_dequant else {}),
+                # --pipeline-depth: in-flight decode blocks on the
+                # scheduler (1 = the pre-pipeline double buffer, the
+                # depth A/B baseline; unset = the config default).
+                **({"pipeline_depth": pipeline_depth}
+                   if pipeline_depth is not None else {}),
                 # Disaggregated prefill/decode: the provider runs a
                 # prefill host + decode host pair with KV handoff
                 # (engine/disagg/); handoff counters land in the JSON's
@@ -1197,6 +1222,29 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                 print(f"[bench] decode step {diag['decode_step_ms']} ms | "
                       f"weight stream {wb / 1e6:.0f} MB/step @ "
                       f"{diag.get('weight_stream_gbs')} GB/s effective",
+                      file=sys.stderr)
+            # Overlapped-scheduler split (round-16): how much of the
+            # engine thread's wall was spent on the dispatch loop proper
+            # vs work the emit worker absorbed, plus the configured
+            # pipeline depth — the A/B number for depth 1 vs 2 rides
+            # every BENCH_r*.json engine block.
+            if engine_stats.get("pipeline_depth") is not None:
+                diag["pipeline_depth"] = engine_stats["pipeline_depth"]
+                diag["dispatch_thread_s"] = _rnd(
+                    engine_stats.get("dispatch_thread_s"))
+                diag["offloaded_s"] = _rnd(engine_stats.get("offloaded_s"))
+                dtb = engine_stats.get("dispatch_thread_block_s") or {}
+                if dtb:
+                    diag["dispatch_thread_block_p50_s"] = _rnd(
+                        dtb.get("p50"), 5)
+                    diag["dispatch_thread_block_p99_s"] = _rnd(
+                        dtb.get("p99"), 5)
+                print(f"[bench] pipeline depth "
+                      f"{diag['pipeline_depth']} | dispatch thread "
+                      f"{diag['dispatch_thread_s']}s | offloaded "
+                      f"{diag['offloaded_s']}s | dispatch-thread block "
+                      f"p50/p99 {diag.get('dispatch_thread_block_p50_s')}/"
+                      f"{diag.get('dispatch_thread_block_p99_s')}s",
                       file=sys.stderr)
             print(
                 "[bench] engine: "
@@ -1795,6 +1843,17 @@ def main() -> None:
                          "for serving — measured same throughput as 64 "
                          "with 2x lower TTFT/inter-chunk latency — and "
                          "64 for --engine/--smoke)")
+    ap.add_argument("--pipeline-depth", type=int, default=None,
+                    metavar="N",
+                    help="decode blocks kept in flight on the device "
+                         "(tpu.pipeline_depth). 1 = the pre-pipeline "
+                         "double buffer, the A/B baseline; 2 (the config "
+                         "default) overlaps host emit/admission under "
+                         "device compute. --engine mode pipelines its "
+                         "dispatch loop to the same depth and reports "
+                         "dispatch_thread_block_s; unset keeps each "
+                         "mode's default (1 for --engine/--smoke, config "
+                         "default for --e2e)")
     ap.add_argument("--quant", default="int8", choices=("none", "int8"),
                     help="weight quantization")
     ap.add_argument("--kv-quant", default="int8", choices=("none", "int8"),
@@ -1960,7 +2019,8 @@ def main() -> None:
                          quant=None if args.quant == "none" else args.quant,
                          kv_quant=args.kv_quant == "int8",
                          fused_dequant=args.fused_dequant,
-                         profile_sample=args.profile_sample)
+                         profile_sample=args.profile_sample,
+                         pipeline_depth=args.pipeline_depth or 1)
 
     # Capture identity (stamp_result): the RESOLVED knobs that shape the
     # measurement — benchdiff refuses to diff two captures whose
@@ -1977,17 +2037,20 @@ def main() -> None:
 
     def engine_fp(preset: str, slots: int, steps: int, prompt_len: int,
                   max_seq: int, dtype: str, block: int, mesh_model: int,
-                  quant, kv_quant, fused_dequant: bool) -> dict:
+                  quant, kv_quant, fused_dequant: bool,
+                  pipeline_depth: int = 1) -> dict:
         return {"preset": preset, "slots": slots, "steps": steps,
                 "prompt_len": prompt_len, "max_seq": max_seq,
                 "dtype": dtype, "block": block, "mesh_model": mesh_model,
                 "quant": quant, "kv_quant": kv_quant,
                 "fused_dequant": fused_dequant,
+                "pipeline_depth": pipeline_depth,
                 "profile_sample": args.profile_sample}
 
     if mode == "smoke":
         fp_cfg = engine_fp("tiny", 2, 8, 16, 64, "float32", 2, 1,
-                           None, None, False)
+                           None, None, False,
+                           pipeline_depth=args.pipeline_depth or 1)
     elif mode == "chaos":
         fp_cfg = {"preset": args.preset, "clients": args.clients,
                   "slots": args.slots, "max_new": args.max_new,
@@ -1998,7 +2061,8 @@ def main() -> None:
         fp_cfg = engine_fp(args.preset, args.slots, args.steps,
                            args.prompt_len, args.max_seq, args.dtype,
                            args.block, args.mesh_model, args.quant,
-                           args.kv_quant, args.fused_dequant)
+                           args.kv_quant, args.fused_dequant,
+                           pipeline_depth=args.pipeline_depth or 1)
     elif mode == "proxy":
         fp_cfg = {"clients": args.clients, "max_new": args.max_new,
                   "proxy_delay": args.proxy_delay}
@@ -2010,6 +2074,7 @@ def main() -> None:
             "dtype": args.dtype, "block": args.block,
             "quant": args.quant, "kv_quant": args.kv_quant,
             "fused_dequant": args.fused_dequant,
+            "pipeline_depth": args.pipeline_depth,
             "shared_prefix": args.shared_prefix,
             "prefix_cache_mb": args.prefix_cache_mb,
             "speculative": args.speculative,
@@ -2031,7 +2096,8 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
         result = run_bench("tiny", slots=2, steps=8, prompt_len=16,
                            max_seq=64, dtype_name="float32", mesh_model=1,
-                           block=2, profile_sample=args.profile_sample)
+                           block=2, profile_sample=args.profile_sample,
+                           pipeline_depth=args.pipeline_depth or 1)
     elif args.chaos:
         result = run_chaos(
             args.preset, clients=args.clients, slots=args.slots,
@@ -2079,7 +2145,8 @@ def main() -> None:
                 disagg_pool=pool_mn,
                 multi_turn=args.multi_turn,
                 metrics_out=args.metrics_out,
-                profile_sample=args.profile_sample)
+                profile_sample=args.profile_sample,
+                pipeline_depth=args.pipeline_depth)
 
         try:
             result = e2e_attempt(args.max_seq, args.max_new)

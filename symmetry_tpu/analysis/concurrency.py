@@ -13,6 +13,17 @@ characteristic failure this checker makes static:
   C202  attribute mutated from more than one thread entry point with at
         least one mutation site not under a lock — the lost-update race
         on shared counters/maps
+  C203  an UNBOUNDED queue.Queue/SimpleQueue used as a cross-thread
+        channel (put sites and get sites reachable from different
+        thread contexts) — a consumer that stalls lets the producer
+        grow it without limit, which in a serving process is an OOM
+        with a delay fuse. Bounded construction (any nonzero maxsize)
+        is the fix: the blocking put IS the backpressure. Queues whose
+        depth is bounded upstream (a provider admission cap, a
+        handshake window) are baseline entries with that argument
+        written down. asyncio.Queue is exempt — its producers and
+        consumers share the loop thread, and flow control there is the
+        loop's problem, not a thread-safety one.
 
 Thread entry points are inferred per class:
 
@@ -82,6 +93,34 @@ _LOCKISH = ("lock", "mutex", "cond")
 # drown the real races.
 _MUTATOR_METHODS = {"append", "add", "pop", "remove", "discard", "clear",
                     "update", "extend", "insert", "setdefault", "popitem"}
+
+# C203: thread-queue constructors. asyncio.Queue is excluded at the
+# call-name level (loop-internal flow control, not a thread channel).
+_QUEUE_TYPES = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+
+# Queue handoff verbs, split by side: the channel counts as cross-thread
+# when put-side and get-side contexts are not the same single thread.
+_QUEUE_PUTS = {"put", "put_nowait"}
+_QUEUE_GETS = {"get", "get_nowait"}
+
+
+def _queue_bounded(call: ast.Call, leaf: str) -> bool:
+    """Is this queue construction bounded? SimpleQueue has no maxsize at
+    all. Queue's maxsize (first positional or keyword) bounds it iff
+    positive; absent or constant <= 0 means infinite. A COMPUTED
+    maxsize (maxsize=max(1, n)) is taken as bounded — the checker
+    prefers silence to noise on expressions it cannot evaluate."""
+    if leaf == "SimpleQueue":
+        return False
+    size: ast.AST | None = call.args[0] if call.args else None
+    for kw in call.keywords:
+        if kw.arg == "maxsize":
+            size = kw.value
+    if size is None:
+        return False
+    if isinstance(size, ast.Constant):
+        return isinstance(size.value, (int, float)) and size.value > 0
+    return True
 
 
 def _lock_name(expr: ast.AST) -> str | None:
@@ -171,6 +210,13 @@ class _ClassModel:
         # mutation unit -> list of (context, line, held-lock names)
         self.mutations: dict[str, list[tuple[str, int,
                                              frozenset[str]]]] = {}
+        # C203: queue attr -> (construction line, bounded?), and
+        # queue attr -> [(side "put"/"get", context, line)]. Ops are
+        # recorded for EVERY attr that quacks like a queue and filtered
+        # against the constructed set at verdict time, so dict .get()
+        # noise never reaches a finding.
+        self.queues: dict[str, tuple[int, bool]] = {}
+        self.queue_ops: dict[str, list[tuple[str, str, int]]] = {}
 
     def contexts(self) -> dict[str, set[str]]:
         """Entry-context sets per context (method or escaped closure):
@@ -290,6 +336,16 @@ def _build_model(cls: ast.ClassDef) -> _ClassModel:
                     if unit is not None:
                         model.mutations.setdefault(unit, []).append(
                             (owner, node.lineno, held))
+                # self.q.put(...) / self.q.get(...) — queue handoff
+                # sites for the C203 cross-thread-channel verdict
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in (_QUEUE_PUTS | _QUEUE_GETS)):
+                    unit = _self_attr(node.func.value)
+                    if unit is not None:
+                        side = ("put" if node.func.attr in _QUEUE_PUTS
+                                else "get")
+                        model.queue_ops.setdefault(unit, []).append(
+                            (side, owner, node.lineno))
             if isinstance(node, ast.Attribute):
                 # a bound-method reference that is NOT the callee of a
                 # call escapes → foreign-context entry point. Async
@@ -305,6 +361,23 @@ def _build_model(cls: ast.ClassDef) -> _ClassModel:
                                        ast.FunctionDef)):
                     model.roots.add(attr)
             if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                # self.q = queue.Queue(...) — remember the channel and
+                # whether its construction bounded it (C203)
+                value = getattr(node, "value", None)
+                if isinstance(value, ast.Call):
+                    cn = call_name(value)
+                    leaf = cn.split(".")[-1] if cn else ""
+                    if (leaf in _QUEUE_TYPES and cn is not None
+                            and "asyncio" not in cn):
+                        qtargets = (node.targets
+                                    if isinstance(node, ast.Assign)
+                                    else [node.target])
+                        for t in qtargets:
+                            qattr = _self_attr(t)
+                            if qattr is not None:
+                                model.queues[qattr] = (
+                                    node.lineno,
+                                    _queue_bounded(value, leaf))
                 targets = (node.targets if isinstance(node, ast.Assign)
                            else [node.target])
                 for t in targets:
@@ -380,6 +453,35 @@ def _check_cross_thread(sf: SourceFile) -> list[Finding]:
                          f"(first: {node.name}.{m}) — guard with a "
                          f"lock or record the ownership argument in "
                          f"the baseline")))
+        # C203: an unbounded queue whose put side and get side are
+        # reachable from different thread contexts is a cross-thread
+        # channel with no backpressure.
+        for attr, (line, bounded) in sorted(model.queues.items()):
+            if bounded:
+                continue
+            put_labels: set[str] = set()
+            get_labels: set[str] = set()
+            for side, owner, _ln in model.queue_ops.get(attr, []):
+                labels = ctx.get(owner, set())
+                if side == "put":
+                    put_labels |= labels
+                else:
+                    get_labels |= labels
+            if not put_labels or not get_labels:
+                continue
+            if len(put_labels | get_labels) < 2:
+                continue  # one thread talking to itself: no backlog race
+            findings.append(Finding(
+                checker=NAME, code="C203", path=sf.rel, line=line,
+                symbol=f"{node.name}.{attr}",
+                message=(f"self.{attr} is an unbounded queue crossing "
+                         f"thread contexts (put: "
+                         f"{', '.join(sorted(put_labels))}; get: "
+                         f"{', '.join(sorted(get_labels))}) — a stalled "
+                         f"consumer grows it without limit; construct "
+                         f"with a nonzero maxsize so the blocking put "
+                         f"is the backpressure, or record the upstream "
+                         f"bound in the baseline")))
     return findings
 
 
@@ -393,7 +495,8 @@ def check(project: Project) -> list[Finding]:
 
 SPEC = CheckerSpec(
     name=NAME,
-    doc="cross-thread mutation without a lock; blocking calls in async",
+    doc="cross-thread mutation without a lock; blocking calls in async; "
+        "unbounded cross-thread queues",
     run=check,
-    codes=("C201", "C202"),
+    codes=("C201", "C202", "C203"),
 )

@@ -61,6 +61,12 @@ def derive_role_config(base: dict[str, Any], role: str) -> dict[str, Any]:
     tpu["role"] = role
     if role == "decode" and not tpu.get("prefix_cache_mb"):
         tpu["prefix_cache_mb"] = DEFAULT_DECODE_PREFIX_MB
+    if role == "prefill" and "pipeline_depth" not in overrides:
+        # A prefill tier never decodes: there are no blocks to keep in
+        # flight, so the emit worker would idle next to admission-only
+        # traffic. Depth 1 keeps its emit path inline (override-able
+        # per tier via tpu.disagg.prefill.pipeline_depth).
+        tpu["pipeline_depth"] = 1
     cfg["tpu"] = tpu
     if faults:
         merged = dict(cfg.get("faults") or {})

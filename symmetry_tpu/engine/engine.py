@@ -1642,18 +1642,18 @@ class InferenceEngine:
                     # concurrent-peak probe above).
                     np.asarray(toks)
 
-    def verify_step(self, draft: np.ndarray, n_draft: np.ndarray
-                    ) -> tuple[np.ndarray, np.ndarray]:
-        """Run ONE speculative verify dispatch: `draft` [B, k_draft] holds
-        each slot's proposed continuation tokens, `n_draft` [B] how many
-        are real (0 = no proposal; the slot advances one plain token).
-        Returns (tokens [1+k, B], n_emit [B]) on the host — tokens[:n, b]
-        with n = n_emit[b] are slot b's emitted run for this dispatch.
-
-        Synchronous by design: the NEXT dispatch's drafts are built from
-        this dispatch's output, so there is nothing to overlap — the
-        scheduler falls back to double-buffered plain blocks whenever no
-        slot has a proposal."""
+    def verify_step_dispatch(self, draft: np.ndarray, n_draft: np.ndarray
+                             ) -> tuple[jax.Array, jax.Array]:
+        """Dispatch ONE speculative verify WITHOUT syncing: `draft`
+        [B, k_draft] holds each slot's proposed continuation tokens,
+        `n_draft` [B] how many are real (0 = no proposal; the slot
+        advances one plain token). Returns (tokens [1+k, B], n_emit [B])
+        as device futures — the scheduler parks them in its pipeline and
+        syncs them an iteration later, so admission/emit host work
+        overlaps the verify's device execution exactly like a plain
+        decode block (pre-pipeline, the same-iteration sync ate the
+        overlap). The next PROPOSAL still waits for the sync: drafts are
+        built from this dispatch's output."""
         if self.spec is None:
             raise EngineError("speculative decoding is not enabled")
         k = self.spec.k_draft
@@ -1667,6 +1667,14 @@ class InferenceEngine:
             jnp.asarray(n_draft, jnp.int32))
         if dp.enabled:
             dp.probe("verify", toks, t_dp)
+        return toks, n_emit
+
+    def verify_step(self, draft: np.ndarray, n_draft: np.ndarray
+                    ) -> tuple[np.ndarray, np.ndarray]:
+        """Synchronous verify: dispatch + host transfer in one call
+        (tests and non-pipelined callers). tokens[:n, b] with
+        n = n_emit[b] are slot b's emitted run for this dispatch."""
+        toks, n_emit = self.verify_step_dispatch(draft, n_draft)
         return np.asarray(toks), np.asarray(n_emit)
 
     def decode_steps_dispatch(self) -> jax.Array:

@@ -334,6 +334,8 @@ class EngineHost:
         t_warmup = time.perf_counter() - t1
         self._scheduler = Scheduler(
             sched_engine, emit_batch=self._emit_batch,
+            pipeline_depth=int(getattr(self._config.tpu,
+                                       "pipeline_depth", 2)),
             handoff=(self._handoff_sink if self._role == "prefill"
                      else None))
         # tpu.tracing=False empties every ring (the bench A/B knob); the

@@ -146,6 +146,8 @@ class Scheduler:
                  prefill_chunks_per_block: int = 4,
                  admit_groups_per_block: int = 4,
                  admit_seconds_per_block: float = 0.65,
+                 pipeline_depth: int = 2,
+                 emit_queue_blocks: int = 8,
                  emit_batch: Callable[
                      [list[tuple[GenRequest, TokenEvent]]], None]
                  | None = None,
@@ -181,6 +183,34 @@ class Scheduler:
         # per-event through req.emit otherwise (AsyncSession, tests).
         self._emit_batch = emit_batch
         self._pending_events: list[tuple[GenRequest, TokenEvent]] = []
+        # Overlapped pipeline (ROADMAP item 2): keep up to `pipeline_depth`
+        # decode blocks dispatched-but-unsynced between iterations, so the
+        # host's per-block work (detokenize, event encode, pipe emit,
+        # bookkeeping) overlaps device execution instead of serializing
+        # with it. Depth 1 reproduces the pre-pipeline double-buffer loop
+        # exactly (the A/B baseline).
+        self._depth = max(1, int(pipeline_depth))
+        # Emit/bookkeep offload (depth >= 2): everything that is not a
+        # device dispatch — push_many detokenize, TokenEvent construction,
+        # stage-stamp decoration, emit_batch/req.emit delivery — runs on a
+        # dedicated worker thread fed per-block job batches through a
+        # BOUNDED queue. The bound is the backpressure contract: a slow
+        # pipe consumer makes the blocking put below stall the dispatch
+        # thread rather than queue events without limit. All events flow
+        # through the queue while offload is on (never a mix of inline and
+        # queued delivery), so per-request wire order is exactly the
+        # engine-thread production order. `_emit_offload` is written ONLY
+        # by start() before the threads exist; everywhere else reads it.
+        self._emit_queue: queue.Queue[list[tuple] | None] = queue.Queue(
+            maxsize=max(1, int(emit_queue_blocks)))
+        self._emit_thread: threading.Thread | None = None
+        self._emit_offload = False
+        self._block_jobs: list[tuple] = []
+        # Worker-owned counters (merged into stats() reads): the worker
+        # never touches self.metrics — key ownership stays single-thread.
+        self._wmetrics = {"offloaded_s": 0.0, "emit_flushes": 0,
+                          "emit_events": 0}
+        self._live_depth = 0
         # Vectorized terminal scan over each [K, B] block needs the EOS
         # set as an array once, not a per-token set probe.
         self._eos_arr = np.array(sorted(engine.tokenizer.eos_ids),
@@ -268,7 +298,13 @@ class Scheduler:
                         # wall spent in verify dispatch+sync.
                         "spec_verify_blocks": 0, "spec_drafted": 0,
                         "spec_accepted": 0, "spec_rolled_back": 0,
-                        "spec_tokens": 0, "spec_verify_s": 0.0}
+                        "spec_tokens": 0, "spec_verify_s": 0.0,
+                        # Dispatch-thread wall: non-idle loop-iteration
+                        # seconds on the engine thread. Its counterpart,
+                        # offloaded_s (emit-worker wall), lives in
+                        # _wmetrics — the split is the CPU-verifiable
+                        # proxy for dispatch_gap_share -> ~0.
+                        "dispatch_thread_s": 0.0}
         from symmetry_tpu.utils.metrics import METRICS, MetricName
         from symmetry_tpu.utils.trace import Histogram, Tracer
 
@@ -309,6 +345,19 @@ class Scheduler:
         self._m_resume_reused = METRICS.counter(
             MetricName.SCHED_RESUME_REUSED,
             "radix-cache tokens resume admissions reused")
+        # The overlap split: time the dispatch thread actually spends per
+        # non-idle iteration vs time the emit worker spends delivering the
+        # offloaded per-block work. At depth >= 2 the first should approach
+        # the bare dispatch cost; the second absorbs everything else.
+        self._m_dispatch_thread = METRICS.histogram(
+            MetricName.SCHED_DISPATCH_THREAD,
+            "dispatch-thread wall per non-idle loop iteration")
+        self._m_offloaded = METRICS.histogram(
+            MetricName.SCHED_OFFLOADED,
+            "emit-worker wall per delivered job batch")
+        self._m_pipeline_depth = METRICS.gauge(
+            MetricName.SCHED_PIPELINE_DEPTH,
+            "decode blocks in flight between loop iterations")
 
         # Request-scoped tracing (dispatch granularity — never per token):
         # every device dispatch (prefill/chunk/decode block/verify) and
@@ -328,16 +377,35 @@ class Scheduler:
         self._ttft_hist = Histogram()
         self._admit_hist = Histogram()
         self._adopt_hist = Histogram()
-        self._interval_hist = Histogram()
+        # Block-sync intervals are PER KIND, and an interval is observed
+        # only when the previous sync was the SAME kind: a decode_block ->
+        # decode_block interval estimates block cadence, a verify ->
+        # decode_block interval spans a one-forward dispatch and would
+        # poison the percentiles (the old single histogram forced the
+        # decode-floor metrics to be omitted whenever drafting was on).
+        self._interval_hists = {"decode_block": Histogram(),
+                                "verify": Histogram()}
+        self._dispatch_thread_hist = Histogram()
         # Per-slot tokens emitted by each verify dispatch (1 = nothing
         # accepted, 1 + k_draft = the whole proposal) — the distribution
         # that says whether speculation is paying for its dispatches.
         self._spec_emit_hist = Histogram()
         self._last_sync_done: float | None = None
+        self._last_sync_kind: str | None = None
 
     # ------------------------------------------------------------- lifecycle
 
     def start(self) -> None:
+        if self._depth > 1:
+            # Offload engages only while the worker is actually running:
+            # white-box tests (and the engine-death path after join) drive
+            # scheduler internals without start() and must keep the
+            # inline emit path.
+            self._emit_thread = threading.Thread(
+                target=self._emit_worker_run, name="emit-worker",
+                daemon=True)
+            self._emit_offload = True
+            self._emit_thread.start()
         self._thread = threading.Thread(target=self._run, name="engine-loop",
                                         daemon=True)
         self._thread.start()
@@ -391,21 +459,39 @@ class Scheduler:
         out["queue_depth"] = self._inbox.qsize() + len(self._deferred)
         out["engine_ttft_s"] = self._ttft_hist.to_dict()
         out["admit_dispatch_s"] = self._admit_hist.to_dict()
-        out["block_interval_s"] = self._interval_hist.to_dict()
+        out["block_interval_s"] = self._interval_hists["decode_block"].to_dict()
+        if self._interval_hists["verify"].count:
+            out["verify_interval_s"] = self._interval_hists["verify"].to_dict()
+        # The overlap split (the tentpole's CPU-verifiable target): wall
+        # the dispatch thread spends per non-idle iteration vs wall the
+        # emit worker spends on the offloaded per-block work, plus the
+        # configured and LIVE pipeline depth and the emit-queue backlog.
+        out["pipeline_depth"] = self._depth
+        out["pipeline_live_depth"] = self._live_depth
+        # 6 decimals, not 4: a tiny-model CPU run's whole offloaded wall
+        # is tens of microseconds, and the smoke asserts it is nonzero.
+        out["offloaded_s"] = round(self._wmetrics["offloaded_s"], 6)
+        out["emit_flushes"] = (self.metrics["emit_flushes"]
+                               + self._wmetrics["emit_flushes"])
+        out["emit_events"] = (self.metrics["emit_events"]
+                              + self._wmetrics["emit_events"])
+        out["emit_queue_depth"] = self._emit_queue.qsize()
+        if self._dispatch_thread_hist.count:
+            out["dispatch_thread_block_s"] = (
+                self._dispatch_thread_hist.to_dict())
         # Decode-floor metrics (the convert-wall number, in EVERY driver
         # bench capture instead of only the engine-only bench): per-step
         # decode wall from the block-interval p50 (intervals spanning
         # admissions land in the upper percentiles, so p50 is the
         # steady-state estimate), and the weight bytes that step must
         # stream — their ratio is the effective weight-stream HBM GB/s.
-        # Speculative mode interleaves ONE-forward verify dispatches into
-        # the same interval histogram, so interval/decode_block would be
-        # wrong by up to decode_block× there — the metrics are omitted
-        # rather than reported poisoned (the convert-wall A/B runs with
-        # drafting off).
-        iv_p50 = self._interval_hist.percentile(50)
+        # Intervals are per-kind and same-kind-only, so speculative
+        # verify dispatches no longer poison the decode_block histogram —
+        # the metrics hold with drafting on (pre-pipeline they had to be
+        # omitted in speculative mode).
+        iv_p50 = self._interval_hists["decode_block"].percentile(50)
         wsb = getattr(self.engine, "weight_stream_bytes", None)
-        if iv_p50 and self._drafter is None:
+        if iv_p50:
             step_s = iv_p50 / self.engine.decode_block
             out["decode_step_ms"] = round(1e3 * step_s, 3)
             if wsb is not None:
@@ -416,9 +502,15 @@ class Scheduler:
         # tpu.profile_sample): per-dispatch-kind DEVICE-duration
         # percentiles + the dispatch-gap distribution/share, riding the
         # same host stats op → provider engine block → bench JSON.
+        # Annotated with the pipeline depth: a probe's sync serializes
+        # behind every in-flight block, so at depth >= 2 the next gap
+        # sample measures the post-drain refill — gap_share is then an
+        # UPPER bound on true device idle, and consumers must read it
+        # against this depth (the documented accounting rule).
         dp = getattr(self.engine, "devprof", None)
         if dp is not None and dp.enabled:
-            out["devprof"] = dp.stats()
+            out["devprof"] = dict(dp.stats())
+            out["devprof"]["pipeline_depth"] = self._depth
         # Shared-prefix KV cache counters (hit/miss/evict/bytes) ride the
         # same host stats op so they surface provider- and bench-side.
         pc_stats = getattr(self.engine, "prefix_cache_stats", None)
@@ -484,57 +576,213 @@ class Scheduler:
                         finish_reason="error", error=f"engine failure: {exc}"))
             self._flush_events()
             raise
+        finally:
+            # Runs AFTER the except block above, so the error events it
+            # queued are delivered before the worker sees the sentinel.
+            self._stop_emit_worker()
+
+    def _stop_emit_worker(self) -> None:
+        """Drain residual jobs, send the shutdown sentinel, and join the
+        emit worker. Engine-thread only (the loop's exit path)."""
+        if self._emit_thread is None:
+            return
+        if self._block_jobs:
+            jobs, self._block_jobs = self._block_jobs, []
+            self._emit_queue.put(jobs)
+        self._emit_queue.put(None)
+        self._emit_thread.join(timeout=10.0)
+
+    # ------------------------------------------------------ emit offload
+
+    def _emit_worker_run(self) -> None:
+        """Worker thread target: deliver job batches until the sentinel.
+
+        Every batch is exception-contained — a worker death with the
+        queue full would deadlock the dispatch thread's blocking put, so
+        nothing may escape this loop short of the sentinel."""
+        while True:
+            jobs = self._emit_queue.get()
+            if jobs is None:
+                return
+            try:
+                self._deliver_jobs(jobs)
+            except Exception as exc:  # noqa: BLE001 — worker must not die
+                log.error(f"emit worker batch failed: {exc}")
+
+    def _deliver_jobs(self, jobs: list[tuple]) -> None:
+        """Run one block's jobs (detokenize + event build) and deliver
+        the resulting events exactly like the inline _flush_events path:
+        one emit_batch call with a sink installed, else per-event
+        req.emit. Worker thread; books into _wmetrics only."""
+        t0 = time.monotonic()
+        batch: list[tuple[GenRequest, TokenEvent]] = []
+        for job in jobs:
+            try:
+                pair = self._run_job(job)
+            except Exception as exc:  # noqa: BLE001 — fail one, not the batch
+                log.error(f"emit job failed: {exc}")
+                continue
+            if pair is not None:
+                batch.append(pair)
+        if not batch:
+            return
+        self._wmetrics["emit_flushes"] += 1
+        self._wmetrics["emit_events"] += len(batch)
+        if self._emit_batch is not None:
+            try:
+                self._emit_batch(batch)
+            except Exception as exc:  # noqa: BLE001 — must never kill the worker
+                log.error(f"emit batch sink failed: {exc}")
+            self.tracer.record("emit_flush", t0, time.monotonic() - t0,
+                               events=len(batch))
+        else:
+            for req, ev in batch:
+                try:
+                    req.emit(ev)
+                except Exception as exc:  # noqa: BLE001
+                    log.error(
+                        f"emit callback failed for request {req.id}: {exc}")
+        dt = time.monotonic() - t0
+        self._wmetrics["offloaded_s"] += dt
+        self._m_offloaded.observe(dt)
+
+    def _submit_job(self, job: tuple) -> None:
+        """Route one emit/bookkeep job: buffered for the worker while
+        offload is on, else run inline right here (the pre-pipeline
+        behavior, byte-identical — depth 1 and un-started schedulers)."""
+        if self._emit_offload:
+            self._block_jobs.append(job)
+            return
+        pair = self._run_job(job)
+        if pair is not None:
+            self._pending_events.append(pair)
+
+    def _run_job(self, job: tuple
+                 ) -> tuple[GenRequest, TokenEvent] | None:
+        """Materialize one job into a deliverable (req, event) pair.
+
+        Jobs carry tokens_generated/emitted BY VALUE: the engine thread
+        keeps mutating the _ActiveSlot on later blocks while the worker
+        processes earlier ones. The slot's StreamDecoder and stages_sent
+        are owned by whichever side runs the jobs (exactly one — offload
+        never mixes), in per-request FIFO order."""
+        kind = job[0]
+        if kind == "run":
+            _k, active, run, last_tok, gen, emitted = job
+            text = active.decoder.push_many(
+                run.tolist() if hasattr(run, "tolist") else list(run))
+            if not text:
+                return None
+            return self._decorate(active, TokenEvent(
+                text=text, token_id=last_tok,
+                tokens_generated=gen, tokens_emitted=emitted))
+        if kind == "finish":
+            _k, active, run, tok, reason, ttft, gen, emitted = job
+            toks = run.tolist() if hasattr(run, "tolist") else list(run)
+            text = active.decoder.push_many(toks) if toks else ""
+            tail = text + active.decoder.flush()
+            return self._decorate(active, TokenEvent(
+                text=tail, token_id=tok, done=True, finish_reason=reason,
+                ttft_s=ttft, tokens_generated=gen, tokens_emitted=emitted))
+        if kind == "first":
+            _k, active, first, ttft = job
+            text = active.decoder.push(first)
+            if not text:
+                return None
+            return self._decorate(active, TokenEvent(
+                text=text, token_id=first, tokens_generated=1,
+                tokens_emitted=1, ttft_s=ttft))
+        if kind == "emit":
+            _k, active, ev = job
+            return self._decorate(active, ev)
+        # kind == "raw": pre-built event with no slot to decorate
+        # (admission errors, queued cancels, deadline sheds).
+        return job[1], job[2]
 
     def _loop_forever(self) -> None:
-        # Double-buffered decode (SURVEY §7 hard-part 3): one block is
-        # always in flight on the device while the host processes the
-        # previous block's tokens. `pending` = (device token array,
-        # slot snapshot at dispatch). The snapshot attributes each lane's
-        # tokens to the request that occupied it AT DISPATCH — a lane
-        # freed-and-reused between dispatch and processing must not leak
-        # the old request's block into the new one. The third element is
-        # the dispatch stamp (monotonic) so the processed block's span
-        # covers dispatch → device done, not just the sync.
-        pending: tuple[Any, dict[int, _ActiveSlot], float] | None = None
+        # Pipelined decode (SURVEY §7 hard-part 3, ROADMAP item 2): up to
+        # `pipeline_depth` blocks stay in flight on the device between
+        # iterations while the host processes the oldest one. Each pending
+        # entry is (kind, device tokens, slot snapshot at dispatch,
+        # dispatch stamp, extra) — the snapshot attributes each lane's
+        # tokens to the request that occupied it AT DISPATCH, so a lane
+        # freed-and-reused between dispatch and sync never leaks the old
+        # request's block into the new one (the stale-snapshot check in
+        # _process_block), and a slot freed at block N is never
+        # double-sampled by the already-in-flight block N+1: its lane
+        # tokens there are simply discarded. Depth 1 degenerates to the
+        # pre-pipeline double buffer: one dispatch ahead, processed the
+        # next iteration.
+        pending: deque[tuple] = deque()
         while True:
+            t_iter = time.perf_counter()
             self._spent_this_block = 0.0
-            # Dispatch block N+1 BEFORE this iteration's admission work:
-            # the decode block then sits at the FRONT of the device queue
-            # and admission prefills enqueue behind it, so a burst of
+            # Dispatch the next block BEFORE this iteration's admission
+            # work: decode blocks sit at the FRONT of the device queue and
+            # admission prefills enqueue behind them, so a burst of
             # arrivals never delays the block active streams are waiting
-            # on — the prefill lane is fully asynchronous to decode.
+            # on — the prefill lane is fully asynchronous to decode, and
+            # prefix-cache seed gathers/scatters (cached-path admission,
+            # decode-tier adoption) overlap every in-flight block.
             # (Measured motivation: steady wire throughput stuck at ~70%
             # of engine-only because prefill dispatches issued ahead of
             # the block stretched every block interval under continuous
             # admission — BASELINE.md rounds 3-4.) A slot admitted this
-            # iteration joins the NEXT block — its first token was
+            # iteration joins the NEXT dispatch — its first token was
             # already sampled by its prefill dispatch, so TTFT is
-            # untouched; only its second token waits the extra block.
+            # untouched; only its second token waits the extra block(s).
             #
-            # Speculative mode still syncs/verifies first: the drafter
-            # needs the freshest context, and a verify dispatch IS this
-            # iteration's block (see the spec notes below).
+            # Speculative mode drains the pipeline before proposing: the
+            # drafter extends continuations of the freshest emitted
+            # context. The verify dispatch itself then joins the pipeline
+            # like any block (the satellite fix for the old same-iteration
+            # early sync); at depth 1 it is still synced in-iteration —
+            # the pre-pipeline serial behavior, for the A/B.
+            did_dispatch = False
             did_verify = False
+            # Depth >= 2 syncs the OLDEST in-flight block FIRST — the
+            # loop body the tentpole asks for: sync oldest -> sample
+            # next -> dispatch. The pipeline still holds depth-1 newer
+            # blocks through the sync, so the device never idles, and
+            # every host decision below (drafter peek, verify drain,
+            # admission) sees a context only ONE block stale instead of
+            # `depth` — without this, the speculative peek at depth 2
+            # lags the device by two blocks and misfires both ways
+            # (drains that propose nothing, repetition spotted too late
+            # to verify). Depth 1 cannot sync first without a device
+            # bubble (nothing else would be in flight during the sync):
+            # it keeps the pre-pipeline dispatch-then-process double
+            # buffer at the bottom of the loop.
+            if self._depth > 1 and len(pending) >= self._depth:
+                self._process_pending(pending.popleft())
             if self._slots and self._drafter is not None:
-                if pending is not None and self._spec_peek():
-                    self._process_block(pending[0], pending[1],
-                                        dispatched_at=pending[2])
-                    pending = None
-                if self._slots and pending is None:
-                    did_verify = self._maybe_verify_block()
-            nxt = None
-            if self._slots and not did_verify:
-                nxt = (self.engine.decode_steps_dispatch(),
-                       dict(self._slots), time.monotonic())
+                if pending and self._spec_peek():
+                    while pending:
+                        self._process_pending(pending.popleft())
+                if self._slots and not pending:
+                    vb = self._maybe_verify_block()
+                    if vb is not None:
+                        pending.append(vb)
+                        did_dispatch = did_verify = True
+            if self._slots and not did_dispatch and len(pending) <= self._depth:
+                pending.append((
+                    "decode_block", self.engine.decode_steps_dispatch(),
+                    dict(self._slots), time.monotonic(), None))
                 self.metrics["steps"] += self.engine.decode_block
+                did_dispatch = True
             drained = self._admit_new()
-            if not self._slots and pending is None and not self._prefill_jobs:
+            if not self._slots and not pending and not self._prefill_jobs:
                 # Terminal/error events from the admission pass must reach
                 # their consumers BEFORE blocking on an empty inbox.
                 self._flush_events()
                 # Idle boundary: the next block interval would span the
                 # idle wait, which is not a serving stall.
                 self._last_sync_done = None
+                self._last_sync_kind = None
+                self._live_depth = 0
+                self._m_pipeline_depth.set(0)
+                self.metrics["dispatch_thread_s"] += (
+                    time.perf_counter() - t_iter)
                 if self._stopping.is_set() and drained:
                     return
                 # Idle: block until work arrives (no busy spin). Engines
@@ -555,27 +803,17 @@ class Scheduler:
                 # Hand the popped item straight to admission (re-putting it
                 # would reorder it BEHIND arrivals that raced in while we
                 # were blocked — inverted FIFO for the earliest request).
+                t_iter = time.perf_counter()
                 self._admit_new(carry=item)
                 self._flush_events()
+                self.metrics["dispatch_thread_s"] += (
+                    time.perf_counter() - t_iter)
                 continue
 
-            # (Block N+1 was dispatched above, before admission; syncing
-            # block N below then overlaps N+1's device execution — the
-            # double buffer — while the admission dispatches that just
-            # enqueued run after N+1, never ahead of it.)
-            #
-            # Speculative-mode note for the early-sync above: the drafter
-            # proposes continuations of the FRESHEST emitted context, so
-            # the in-flight plain block must sync before drafting, and a
-            # verify dispatch is processed in the same iteration (its
-            # output is the next proposals' context — there is nothing to
-            # overlap it with). That early sync costs the dispatch-
-            # before-sync overlap, so it is paid only when a PEEK at the
-            # current (one-block-stale) context says a proposal is likely
-            # — repetition that makes the fresh context match almost
-            # always makes the stale one match too. Non-repetitive
-            # traffic keeps the overlapped plain path, in the knob-off
-            # dispatch order exactly.
+            # (The next block was dispatched above, before admission;
+            # syncing the oldest in-flight block below then overlaps the
+            # newer blocks' device execution, while the admission
+            # dispatches that just enqueued run after them, never ahead.)
             #
             # Chunked prefills ride between decode dispatches: a bounded
             # number of chunk dispatches per block keeps long-prompt
@@ -588,21 +826,65 @@ class Scheduler:
             # first-token latency must not pay for block coalescing. One
             # extra pipe write per block at most: still O(1).
             self._flush_events()
-            if pending is not None:
-                self._process_block(pending[0], pending[1],
-                                    dispatched_at=pending[2])
-            pending = nxt
+            # Depth 1's process point (the pre-pipeline double buffer:
+            # dispatch block N+1 above, sync block N here), and both
+            # depths' drain path when nothing was dispatched (slots
+            # emptied or stopping). A depth-1 verify syncs in the same
+            # iteration — the pre-pipeline serial-verify behavior.
+            # Depth >= 2 already synced its oldest block at the TOP of
+            # the iteration, so len(pending) never exceeds depth here.
+            if pending and (len(pending) > self._depth or not did_dispatch
+                            or (did_verify and self._depth == 1)):
+                self._process_pending(pending.popleft())
             # Block boundary: everything this iteration produced (block
             # deltas, finishes) leaves as one batch — the O(1)-writes-
-            # per-block contract.
+            # per-block contract (one bounded-queue handoff per flush
+            # point while offload is on).
             self._flush_events()
+            self._live_depth = len(pending)
+            self._m_pipeline_depth.set(len(pending))
+            dt_iter = time.perf_counter() - t_iter
+            self.metrics["dispatch_thread_s"] += dt_iter
+            if did_dispatch:
+                self._m_dispatch_thread.observe(dt_iter)
+                self._dispatch_thread_hist.observe(dt_iter)
             if self._debug:
                 self._check_invariants()
+
+    def _process_pending(self, blk: tuple) -> None:
+        """Sync + process one in-flight pipeline entry (FIFO order).
+
+        Verify entries book their speculative accounting HERE, at sync
+        time — the dispatch ran up to `pipeline_depth` iterations ago,
+        overlapped with admission and emit work (spec_verify_s is
+        therefore dispatch -> sync wall, not pure device time)."""
+        kind, toks_dev, snapshot, t0m, extra = blk
+        if kind == "verify":
+            n_emit_dev, n_draft, proposed = extra
+            n_emit = np.asarray(n_emit_dev)
+            dt = time.monotonic() - t0m
+            accepted = int(np.sum(np.minimum(n_emit - 1, n_draft)))
+            self.tracer.record("verify_dispatch", t0m, dt,
+                               drafted=proposed, accepted=accepted)
+            self.metrics["spec_verify_blocks"] += 1
+            self.metrics["spec_verify_s"] += dt
+            self.metrics["spec_drafted"] += proposed
+            self.metrics["spec_accepted"] += accepted
+            self.metrics["spec_rolled_back"] += proposed - accepted
+            for slot in snapshot:
+                if n_draft[slot]:
+                    self._spec_emit_hist.observe(int(n_emit[slot]))
+                    self.metrics["spec_tokens"] += int(n_emit[slot])
+            self._process_block(toks_dev, snapshot, n_valid=n_emit,
+                                dispatched_at=t0m, kind="verify")
+        else:
+            self._process_block(toks_dev, snapshot, dispatched_at=t0m)
 
     def _process_block(self, device_toks: Any,
                        snapshot: dict[int, _ActiveSlot],
                        n_valid: np.ndarray | None = None,
-                       dispatched_at: float | None = None) -> None:
+                       dispatched_at: float | None = None,
+                       kind: str = "decode_block") -> None:
         """Sync one decode block to host and stream its tokens out.
 
         Batched pass (the block-granular emit path): ONE vectorized EOS
@@ -630,12 +912,17 @@ class Scheduler:
         t1 = time.perf_counter()
         self.metrics["block_syncs"] += 1
         self.metrics["sync_s"] += t1 - t0
-        if self._last_sync_done is not None:
-            self._interval_hist.observe(t1 - self._last_sync_done)
+        # Same-kind-only intervals: a decode_block -> decode_block gap is
+        # block cadence; an interval whose predecessor was a verify spans
+        # a one-forward dispatch and lands in the verify histogram's
+        # cadence instead — neither poisons the other's percentiles.
+        if self._last_sync_done is not None and self._last_sync_kind == kind:
+            self._interval_hists[kind].observe(t1 - self._last_sync_done)
         self._last_sync_done = t1
+        self._last_sync_kind = kind
         if dispatched_at is not None:
             self._m_dispatch.observe(time.monotonic() - dispatched_at,
-                                     kind="decode_block")
+                                     kind=kind)
         # Block-boundary gauges: same cadence as the tracer's counter
         # tracks — a handful of registry ops per block, never per token.
         self._m_occupancy.set(len(self._slots))
@@ -646,7 +933,9 @@ class Scheduler:
             # tracks are stamped once per block — boundary-granular, so
             # the hot loop never pays more than a few ring appends.
             t1m = time.monotonic()
-            if dispatched_at is not None:
+            if dispatched_at is not None and kind == "decode_block":
+                # (Verify entries record their own verify_dispatch span
+                # in _process_pending.)
                 self.tracer.record("decode_block", dispatched_at,
                                    t1m - dispatched_at,
                                    slots=len(snapshot),
@@ -664,7 +953,7 @@ class Scheduler:
                 continue  # finished in an earlier block; lane is stale
             if active.req.cancelled():
                 # Discard the whole block remainder past the cancel.
-                self._finish(slot, active, "cancelled", None, "")
+                self._finish(slot, active, "cancelled", None, ())
                 continue
             # The request consumes tokens until the first EOS, its token
             # budget, or the block end — whichever comes first. An EOS at
@@ -690,14 +979,19 @@ class Scheduler:
             active.generated += consumed
             active.emitted += n_push
             block_tokens += n_push
-            text = (active.decoder.push_many(toks[:n_push, slot].tolist())
-                    if n_push else "")
-            # TWO dispatches may touch the cache before this slot is seen
-            # again (one already in flight + the next dispatch); a slot
-            # that can't absorb 2 more full writes must finish now (cache
-            # holds prompt_len + generated - 1 entries after this block;
-            # a write is K positions for a plain block, 1 + k_draft for a
-            # speculative verify).
+            # TWO dispatches' writes must stay within capacity after a
+            # continue decision — the next block's (whose tokens we may
+            # consume) plus one of margin (cache holds prompt_len +
+            # generated - 1 entries after this block; a write is K
+            # positions for a plain block, 1 + k_draft for a speculative
+            # verify). The coefficient is depth-INDEPENDENT: any block we
+            # continue INTO writes at <= c + writes <= c + 2*writes-worth
+            # of positions by induction, while deeper pipelines only add
+            # in-flight blocks whose tokens are discarded after a finish
+            # (their past-capacity scatters are dropped against a lane
+            # that is already released). Keeping the formula fixed keeps
+            # finish="length" decisions — and therefore token identity —
+            # bit-identical across pipeline depths.
             if finish is None and (
                     active.prompt_len + active.generated
                     + 2 * self._max_block_writes
@@ -707,14 +1001,18 @@ class Scheduler:
                 if self._drafter is not None:
                     # Consumed tokens extend the slot's n-gram index (its
                     # context must track the device's conditioning).
+                    # Engine-thread work: the next propose() reads it.
                     self._drafter.extend(slot, toks[:consumed, slot].tolist())
-                if text:
-                    self._emit(active, TokenEvent(
-                        text=text, token_id=last_tok,
-                        tokens_generated=active.generated,
-                        tokens_emitted=active.emitted))
+                if n_push:
+                    # Counts snapshotted by value: the engine thread keeps
+                    # advancing `active` on later blocks while the worker
+                    # detokenizes this one.
+                    self._submit_job(("run", active, toks[:n_push, slot],
+                                      last_tok, active.generated,
+                                      active.emitted))
             else:
-                self._finish(slot, active, finish, last_tok, text)
+                self._finish(slot, active, finish, last_tok,
+                             toks[:n_push, slot])
         self.metrics["tokens"] += block_tokens
         if block_tokens:
             self._m_tokens.inc(block_tokens)
@@ -730,12 +1028,16 @@ class Scheduler:
             and self._drafter.propose(slot)
             for slot, active in self._slots.items())
 
-    def _maybe_verify_block(self) -> bool:
+    def _maybe_verify_block(self) -> tuple | None:
         """Collect every active slot's n-gram proposal; when at least one
-        slot has a draft, run ONE verify dispatch (fixed [B, 1+k] shape)
-        and process its ragged output through the block pipeline. Returns
-        False — letting the caller fall back to a plain decode block —
-        when nothing was proposed."""
+        slot has a draft, issue ONE verify dispatch (fixed [B, 1+k]
+        shape) and return it as a pipeline entry — it is synced and its
+        ragged output processed through the block pipeline like any
+        in-flight block, so the host work between dispatch and sync
+        overlaps the verify's device execution (the old path synced
+        immediately, eating the overlap). Returns None — letting the
+        caller fall back to a plain decode block — when nothing was
+        proposed."""
         engine = self.engine
         k = engine.spec.k_draft
         draft = np.zeros((engine.max_slots, k), np.int32)
@@ -750,28 +1052,19 @@ class Scheduler:
                 n_draft[slot] = len(prop)
                 proposed += len(prop)
         if not proposed:
-            return False
+            return None
         snapshot = dict(self._slots)
         t0m = time.monotonic()
-        t0 = time.perf_counter()
-        toks, n_emit = engine.verify_step(draft, n_draft)
-        dt = time.perf_counter() - t0
-        accepted = int(np.sum(np.minimum(n_emit - 1, n_draft)))
-        self.tracer.record("verify_dispatch", t0m, dt,
-                           drafted=proposed, accepted=accepted)
-        self._m_dispatch.observe(dt, kind="verify")
-        self.metrics["spec_verify_blocks"] += 1
-        self.metrics["spec_verify_s"] += dt
-        self.metrics["spec_drafted"] += proposed
-        self.metrics["spec_accepted"] += accepted
-        self.metrics["spec_rolled_back"] += proposed - accepted
+        dispatch = getattr(engine, "verify_step_dispatch", None)
+        if dispatch is not None:
+            toks, n_emit = dispatch(draft, n_draft)
+        else:
+            # Engine (or test fake) without the async surface: the
+            # synchronous host arrays ride the pipeline unchanged
+            # (np.asarray at sync time is idempotent).
+            toks, n_emit = engine.verify_step(draft, n_draft)
         self.metrics["steps"] += 1  # one forward advanced every lane
-        for slot in snapshot:
-            if n_draft[slot]:
-                self._spec_emit_hist.observe(int(n_emit[slot]))
-                self.metrics["spec_tokens"] += int(n_emit[slot])
-        self._process_block(toks, snapshot, n_valid=n_emit)
-        return True
+        return ("verify", toks, snapshot, t0m, (n_emit, n_draft, proposed))
 
     def _admit_new(self, carry: GenRequest | None = None) -> bool:
         """Place queued requests into free slots. Returns True if inbox
@@ -1164,7 +1457,7 @@ class Scheduler:
                                              len(self._slots))
         active.generated = 1
         if first in self.engine.tokenizer.eos_ids:
-            self._finish(slot, active, "stop", first, "")
+            self._finish(slot, active, "stop", first, ())
             return
         active.emitted = 1
         self.metrics["tokens"] += 1
@@ -1180,17 +1473,12 @@ class Scheduler:
                 or active.prompt_len + active.generated
                 + 2 * self._max_block_writes
                 > self.engine.slot_capacity + 1):
-            text = active.decoder.push(first)
-            self._finish(slot, active, "length", first, text)
+            self._finish(slot, active, "length", first, (first,))
             return
         if self._drafter is not None and req.speculative is not False:
             self._drafter.begin(slot, req.prompt_ids, first)
-        text = active.decoder.push(first)
-        if text:
-            self._emit(active, TokenEvent(
-                text=text, token_id=first, tokens_generated=1,
-                tokens_emitted=1,
-                ttft_s=active.first_token_at - req.enqueued_at))
+        self._submit_job(("first", active, first,
+                          active.first_token_at - req.enqueued_at))
 
     def _handoff_request(self, slot: int, req: GenRequest,
                          first: int) -> None:
@@ -1230,8 +1518,14 @@ class Scheduler:
             self.engine.release_slot(slot)
 
     def _finish(self, slot: int, active: _ActiveSlot, reason: str,
-                tok: int | None, text: str) -> None:
-        tail = text + active.decoder.flush()
+                tok: int | None, run) -> None:
+        """Terminal for an active slot. `run` is the token-id sequence
+        (numpy slice or tuple) still to be pushed through the decoder
+        ahead of the flush — the push itself is emit work and rides the
+        finish job, off-thread while offload is on. Slot accounting
+        (free list, engine release, drafter release, eviction counters)
+        stays on the engine thread: the lane must be reusable by the
+        very next admission pass."""
         ttft = (active.first_token_at - active.req.enqueued_at
                 if active.first_token_at else None)
         if self.tracer.enabled and active.first_token_at is not None:
@@ -1240,10 +1534,8 @@ class Scheduler:
                                request_id=active.req.id,
                                trace_id=active.req.trace_id,
                                tokens=active.generated, finish=reason)
-        self._emit(active, TokenEvent(
-            text=tail, token_id=tok, done=True, finish_reason=reason,
-            ttft_s=ttft, tokens_generated=active.generated,
-            tokens_emitted=active.emitted))
+        self._submit_job(("finish", active, run, tok, reason, ttft,
+                          active.generated, active.emitted))
         del self._slots[slot]
         self._free.append(slot)
         if self._drafter is not None:
@@ -1253,11 +1545,19 @@ class Scheduler:
         self._m_evictions.inc()
 
     def _emit(self, active: _ActiveSlot, ev: TokenEvent) -> None:
+        """Queue a pre-built event for an active slot (stage decoration
+        happens where the job runs, preserving per-request order)."""
+        self._submit_job(("emit", active, ev))
+
+    def _decorate(self, active: _ActiveSlot, ev: TokenEvent
+                  ) -> tuple[GenRequest, TokenEvent]:
         if not active.stages_sent:
             # First event of the request: attach the per-stage admission
             # stamps (host recv → placement pick → first token). The host
             # adds its pipe-out stamp, the provider the relay stamp — the
             # full TTFT chain then reads out per stage in bench.py.
+            # stages_sent is owned by whichever side runs the jobs
+            # (exactly one; see _run_job).
             active.stages_sent = True
             ev.stages = {
                 "recv": active.req.enqueued_at,
@@ -1270,17 +1570,26 @@ class Scheduler:
             ev.tokens_reused = active.req.reused_tokens
             if active.req.resume_offset > 0:
                 ev.resumed_from = active.req.resume_offset
-        self._emit_cb(active.req, ev)
+        return active.req, ev
 
     def _emit_cb(self, req: GenRequest, ev: TokenEvent) -> None:
-        """Buffer an event for the next block-boundary flush. All emits
-        happen on the engine thread, so the buffer needs no lock."""
-        self._pending_events.append((req, ev))
+        """Queue a pre-built event with no slot attached (admission
+        errors, queued cancels, deadline sheds). All job submissions
+        happen on the engine thread, so the buffers need no lock."""
+        self._submit_job(("raw", req, ev))
 
     def _flush_events(self) -> None:
-        """Deliver everything buffered since the last block boundary: one
+        """Block-boundary flush. Offload on: hand the buffered jobs to
+        the emit worker as ONE bounded-queue put (blocking when the queue
+        is full — the backpressure that bounds memory under a slow
+        pipe). Offload off: deliver everything buffered inline — one
         emit_batch call when a sink is installed (→ one host-pipe frame
         per block), else per-event req.emit delivery."""
+        if self._emit_offload:
+            if self._block_jobs:
+                jobs, self._block_jobs = self._block_jobs, []
+                self._emit_queue.put(jobs)
+            return
         if not self._pending_events:
             return
         batch, self._pending_events = self._pending_events, []

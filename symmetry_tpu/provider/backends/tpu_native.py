@@ -350,7 +350,9 @@ class TpuNativeBackend(InferenceBackend):
         # Compile the decode program before taking traffic: the first
         # request must never stall every stream on a fresh XLA compile.
         await asyncio.to_thread(sched_engine.warmup)
-        self._scheduler = Scheduler(sched_engine)
+        self._scheduler = Scheduler(
+            sched_engine,
+            pipeline_depth=int(getattr(tpu_cfg, "pipeline_depth", 2)))
         self._scheduler.start()
         log.info(
             f"tpu_native engine up (inproc): model={self._model_name} "

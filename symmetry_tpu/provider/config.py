@@ -103,6 +103,18 @@ class TpuConfig:
     # 64 at the llama3-8b/128-slot point (double-buffered dispatch hides
     # the round-trips) with ~2x lower TTFT and inter-chunk latency.
     decode_block: int = 16
+    # Scheduler pipeline depth: decode blocks kept dispatched-but-unsynced
+    # between loop iterations. At >= 2 the scheduler also moves every
+    # non-dispatch per-block cost (detokenize, event encode, pipe emit,
+    # bookkeeping) onto a bounded-queue emit worker, so the dispatch
+    # thread's iteration approaches the bare dispatch cost (the
+    # dispatch-gap fix, ROADMAP item 2). 1 = the pre-pipeline
+    # double-buffer loop with inline emit, the A/B baseline. Token
+    # streams are identical across depths (greedy and seeded); a deeper
+    # pipeline only trades per-token wire latency (up to depth-1 extra
+    # blocks of buffering) for steady throughput. Prefill-tier hosts in
+    # disagg mode force 1 — they never decode.
+    pipeline_depth: int = 2
     # Requests allowed to QUEUE beyond the decode slots before the
     # provider sheds new inference with a structured busy error (clients
     # fail over; the router steers by reported queue depth). None → one

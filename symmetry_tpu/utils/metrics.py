@@ -102,6 +102,15 @@ class MetricName:
     SCHED_HANDOFFS = "sym_sched_handoffs_total"
     SCHED_DISPATCH = "sym_sched_dispatch_seconds"            # {kind}
     SCHED_TTFT = "sym_sched_ttft_seconds"
+    # Overlapped-pipeline split (tpu.pipeline_depth): wall the dispatch
+    # thread spends per non-idle loop iteration vs wall the emit worker
+    # spends delivering the offloaded per-block work, plus the live
+    # in-flight block count between iterations. dispatch_thread -> ~the
+    # bare dispatch cost is the CPU-verifiable proxy for
+    # sym_dispatch_gap_share -> ~0 on the chip.
+    SCHED_DISPATCH_THREAD = "sym_sched_dispatch_thread_s"
+    SCHED_OFFLOADED = "sym_sched_offloaded_s"
+    SCHED_PIPELINE_DEPTH = "sym_sched_pipeline_depth"
 
     # --- symprof device-time attribution (utils/devprof.py; lives in
     #     the host process beside the engine, tier-labeled through the

@@ -400,6 +400,102 @@ class TestConcurrency:
         assert codes(fs) == {"C202"}
         assert "stats['requests']" in fs[0].symbol
 
+    def test_unbounded_cross_thread_queue_flags(self, tmp_path):
+        # C203 TP: main-thread producers, worker-thread consumer, no
+        # maxsize — the slow-consumer OOM shape.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/s.py": (
+                'import queue, threading\n'
+                'class Loop:\n'
+                '    def __init__(self):\n'
+                '        self.inbox = queue.Queue()\n'
+                '    def start(self):\n'
+                '        threading.Thread(target=self._run).start()\n'
+                '    def _run(self):\n'
+                '        while True:\n'
+                '            self.inbox.get()\n'
+                '    def submit(self, item):\n'
+                '        self.inbox.put(item)\n'),
+        })
+        fs = run(root)
+        assert codes(fs) == {"C203"}
+        assert fs[0].symbol == "Loop.inbox"
+        assert "unbounded" in fs[0].message
+
+    def test_bounded_emit_queue_handoff_clean(self, tmp_path):
+        # C203 TN: the scheduler's emit-worker shape — a bounded queue
+        # (nonzero maxsize, even computed) between the dispatch thread
+        # and the emit worker, sentinel None shutdown included. The
+        # blocking put IS the designed backpressure; nothing to flag.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/s.py": (
+                'import queue, threading\n'
+                'class Sched:\n'
+                '    def __init__(self, emit_queue_blocks=8):\n'
+                '        self._emit_queue = queue.Queue(\n'
+                '            maxsize=max(1, int(emit_queue_blocks)))\n'
+                '    def start(self):\n'
+                '        threading.Thread(\n'
+                '            target=self._emit_worker_run).start()\n'
+                '        threading.Thread(target=self._run).start()\n'
+                '    def _run(self):\n'
+                '        self._emit_queue.put(["job"])\n'
+                '        self._emit_queue.put(None)\n'
+                '    def _emit_worker_run(self):\n'
+                '        while True:\n'
+                '            jobs = self._emit_queue.get()\n'
+                '            if jobs is None:\n'
+                '                return\n'),
+        })
+        assert run(root) == []
+
+    def test_single_thread_and_asyncio_queues_clean(self, tmp_path):
+        # C203 TN ×2: an unbounded queue both produced and consumed by
+        # the SAME worker thread (a private work list — no cross-thread
+        # backlog), and an asyncio.Queue (loop-internal flow control,
+        # out of scope for a thread checker).
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/s.py": (
+                'import queue, threading\n'
+                'class Loop:\n'
+                '    def __init__(self):\n'
+                '        self.todo = queue.Queue()\n'
+                '    def start(self):\n'
+                '        threading.Thread(target=self._run).start()\n'
+                '    def _run(self):\n'
+                '        self.todo.put(1)\n'
+                '        self.todo.get()\n'),
+            "symmetry_tpu/provider/p.py": (
+                'import asyncio\n'
+                'class Relay:\n'
+                '    def __init__(self):\n'
+                '        self.frames = asyncio.Queue()\n'
+                '    def handle(self):\n'
+                '        self.frames.put_nowait(b"x")\n'
+                '    async def pump(self):\n'
+                '        return await self.frames.get()\n'),
+        })
+        assert run(root) == []
+
+    def test_simplequeue_cross_thread_flags(self, tmp_path):
+        # SimpleQueue cannot be bounded at all — crossing threads, it
+        # is always the C203 shape.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/engine/s.py": (
+                'import queue, threading\n'
+                'class Loop:\n'
+                '    def __init__(self):\n'
+                '        self.out = queue.SimpleQueue()\n'
+                '    def start(self):\n'
+                '        threading.Thread(target=self._run).start()\n'
+                '    def _run(self):\n'
+                '        self.out.put(1)\n'
+                '    def drain(self):\n'
+                '        return self.out.get()\n'),
+        })
+        fs = run(root)
+        assert codes(fs) == {"C203"}
+
     def test_nested_async_blocking_reported_once(self, tmp_path):
         root = write_tree(tmp_path, {
             "symmetry_tpu/network/n.py": (

@@ -1,0 +1,340 @@
+"""Overlapped scheduler pipeline (tpu.pipeline_depth): edge semantics.
+
+The pipelined dispatch loop keeps up to `pipeline_depth` decode blocks
+in flight on the device and moves detokenize/event-build/delivery onto
+a bounded-queue emit worker. These tests pin the seams the overlap
+opens:
+
+  - token identity: a real tiny CPU engine must produce byte-identical
+    streams (greedy AND seeded sampled) at depth 1 (the pre-pipeline
+    double buffer) and depth 2, with zero steady-state recompiles
+    between traffic waves (compile_cache_sizes pinned).
+  - the dispatch->sync window: a cancel landing while a block is in
+    flight discards the block remainder; a slot freed at block N is
+    never double-sampled by the already-in-flight block N+1 (the stale
+    snapshot check); an inbox deadline expiring under a busy pipeline
+    sheds as "expired" without touching active streams.
+  - the emit worker: engine-loop death with events still queued fails
+    every stream open (no hung client); the bounded queue is the
+    backpressure contract — a slow sink stalls the dispatch thread
+    instead of letting it run unboundedly ahead.
+
+White-box cases drive scheduler internals on a fake engine (no JAX, no
+engine thread) exactly like test_scheduler_emit.py; the threaded cases
+start the real loop against a fake device.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from symmetry_tpu.engine.engine import SamplingParams
+from symmetry_tpu.engine.scheduler import GenRequest, Scheduler
+from symmetry_tpu.engine.tokenizer import ByteTokenizer
+
+
+class FakeEngine:
+    """The scheduler-facing engine contract, minus the device."""
+
+    def __init__(self, slots=4, block=4, capacity=4096, buckets=(16, 32)):
+        self.max_slots = slots
+        self.decode_block = block
+        self.slot_capacity = capacity
+        self.tokenizer = ByteTokenizer()
+        self.prefill_buckets = buckets
+        self.dispatches = 0
+        self.released: list[int] = []
+
+    def bucket_for(self, n):
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt length {n} exceeds largest bucket")
+
+    def prefill_batches_for(self, bucket):
+        return (4,)
+
+    def prefill_and_insert(self, slot, ids, sampling):
+        return ord("A")
+
+    def prefill_and_insert_many(self, group):
+        return [ord("A")] * len(group)
+
+    def decode_steps_dispatch(self):
+        self.dispatches += 1
+        return np.full((self.decode_block, self.max_slots), ord("b"),
+                       dtype=np.int32)
+
+    def release_slot(self, slot):
+        self.released.append(slot)
+
+    def slot_length(self, slot):
+        return 0
+
+
+def submit(sched, prompt: bytes, max_new=100, cancelled=None,
+           deadline_at=None, emit=None):
+    sched.submit(GenRequest(
+        prompt_ids=list(prompt), sampling=SamplingParams(),
+        max_new_tokens=max_new, emit=emit or (lambda ev: None),
+        cancelled=cancelled or (lambda: False), id=prompt.decode(),
+        deadline_at=deadline_at))
+
+
+def events_of(batches, req_id):
+    return [ev for batch in batches for req, ev in batch
+            if req.id == req_id]
+
+
+class TestDispatchSyncWindow:
+    """Races in the window a pipelined block spends in flight."""
+
+    def test_cancel_between_dispatch_and_sync_discards_block(self):
+        """The cancel lands AFTER the block's dispatch snapshot was
+        taken and BEFORE its sync: the whole block is discarded, the
+        stream finishes "cancelled", the slot frees."""
+        eng = FakeEngine(slots=1)
+        batches: list = []
+        sched = Scheduler(eng, emit_batch=batches.append)
+        cancelled: list = []
+        submit(sched, b"r0", cancelled=lambda: bool(cancelled))
+        sched._admit_new()
+        sched._flush_events()
+        toks = eng.decode_steps_dispatch()
+        snapshot = dict(sched._slots)  # the dispatch point
+        cancelled.append(True)         # ...block now in flight
+        tokens_before = sched.metrics["tokens"]
+        sched._process_pending(
+            ("decode_block", toks, snapshot, time.monotonic(), None))
+        sched._flush_events()
+        (ev,) = events_of(batches[-1:], "r0")
+        assert ev.done and ev.finish_reason == "cancelled"
+        assert ev.text == "" and ev.token_id is None
+        assert sched.metrics["tokens"] == tokens_before
+        assert not sched._slots and 0 in eng.released
+
+    def test_freed_slot_never_double_sampled_by_in_flight_block(self):
+        """Depth 2's hard invariant: r0 hits EOS in block N while block
+        N+1 (dispatched before N synced, same snapshot) is already in
+        flight; r1 then takes the freed slot. Block N+1's lane tokens
+        for that slot belong to NOBODY — they must be discarded, never
+        appended to r0 (done) or leaked into r1 (not in the snapshot)."""
+        eng = FakeEngine(slots=1, block=4)
+        batches: list = []
+        sched = Scheduler(eng, emit_batch=batches.append)
+        submit(sched, b"r0")
+        sched._admit_new()
+        sched._flush_events()
+        snapshot = dict(sched._slots)
+        toks_n = eng.decode_steps_dispatch()
+        toks_n[1, 0] = ByteTokenizer.EOS  # r0 stops mid-block N
+        toks_n1 = eng.decode_steps_dispatch()  # N+1, in flight behind N
+        sched._process_pending(
+            ("decode_block", toks_n, snapshot, time.monotonic(), None))
+        sched._flush_events()
+        (ev,) = events_of(batches[-1:], "r0")
+        assert ev.done and ev.finish_reason == "stop" and ev.text == "b"
+        # The freed slot is re-admitted before block N+1 syncs.
+        submit(sched, b"r1")
+        sched._admit_new()
+        sched._flush_events()
+        assert 0 in sched._slots and sched._slots[0].req.id == "r1"
+        tokens_before = sched.metrics["tokens"]
+        n_batches = len(batches)
+        sched._process_pending(
+            ("decode_block", toks_n1, snapshot, time.monotonic(), None))
+        sched._flush_events()
+        # Stale lane discarded wholesale: no event for anyone, no tokens
+        # booked, r1's stream untouched by a block dispatched before it
+        # existed.
+        assert len(batches) == n_batches
+        assert sched.metrics["tokens"] == tokens_before
+        assert not events_of(batches[n_batches:], "r1")
+        assert sched._slots[0].req.id == "r1"
+
+    def test_deadline_expires_while_pipeline_busy_sheds_expired(self):
+        """A queued request whose deadline passes while blocks are in
+        flight is shed at its admission pass with finish "expired" —
+        active streams never see it occupy a slot."""
+        eng = FakeEngine(slots=2)
+        batches: list = []
+        sched = Scheduler(eng, emit_batch=batches.append)
+        submit(sched, b"r0")
+        sched._admit_new()
+        sched._flush_events()
+        submit(sched, b"late", deadline_at=time.monotonic() - 0.01)
+        sched._admit_new()
+        sched._flush_events()
+        (ev,) = events_of(batches, "late")
+        assert ev.done and ev.finish_reason == "expired"
+        assert ev.error and "deadline" in ev.error
+        # Only r0 ever held the slot.
+        assert len(sched._slots) == 1
+        assert sched._slots[0].req.id == "r0"
+
+
+class TestEmitWorkerFaults:
+    def test_loop_death_with_queued_events_fails_streams_open(self):
+        """The engine loop dies mid-traffic with the emit queue
+        non-empty (slow sink): every open stream must still receive a
+        terminal error event — the worker drains before shutdown, no
+        client hangs."""
+
+        class DyingEngine(FakeEngine):
+            def decode_steps_dispatch(self):
+                if self.dispatches >= 3:
+                    raise RuntimeError("device lost")
+                return super().decode_steps_dispatch()
+
+        eng = DyingEngine(slots=2, block=4)
+        done = {"r0": threading.Event(), "r1": threading.Event()}
+        finals: dict[str, object] = {}
+
+        def sink(batch):
+            time.sleep(0.05)  # keep the emit queue non-empty at death
+            for req, ev in batch:
+                if ev.done:
+                    finals[req.id] = ev
+                    done[req.id].set()
+
+        sched = Scheduler(eng, pipeline_depth=2, emit_queue_blocks=2,
+                          emit_batch=sink)
+        submit(sched, b"r0", max_new=1000)
+        submit(sched, b"r1", max_new=1000)
+        sched.start()
+        for rid, ev in done.items():
+            assert ev.wait(30), f"{rid} hung after engine death"
+        for rid, ev in finals.items():
+            assert ev.finish_reason == "error", (rid, ev)
+            assert "device lost" in (ev.error or ""), (rid, ev)
+        sched._thread.join(10)
+        assert not sched._thread.is_alive()
+        sched._emit_thread.join(10)
+        assert not sched._emit_thread.is_alive()
+
+    def test_bounded_queue_backpressures_dispatch_thread(self):
+        """emit_queue_blocks=1 + a slow sink: the dispatch thread must
+        STALL on the full queue rather than run unboundedly ahead —
+        dispatched-but-undelivered blocks stay within the pipeline
+        depth + the queue bound + the in-progress batch, and the stream
+        arrives complete and in order anyway."""
+        eng = FakeEngine(slots=1, block=4)
+        lead: list[int] = []
+        sink_calls = [0]
+        batches: list = []
+
+        def sink(batch):
+            time.sleep(0.02)
+            lead.append(eng.dispatches - sink_calls[0])
+            sink_calls[0] += 1
+            batches.append(list(batch))
+
+        sched = Scheduler(eng, pipeline_depth=2, emit_queue_blocks=1,
+                          emit_batch=sink)
+        done = threading.Event()
+        submit(sched, b"r0", max_new=121,
+               emit=lambda ev: done.set() if ev.done else None)
+        sched.start()
+        # The done event reaches the sink too (emit_batch delivery);
+        # poll the collected batches for it.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(ev.done for ev in events_of(batches, "r0")):
+                break
+            time.sleep(0.01)
+        sched.stop()
+        evs = events_of(batches, "r0")
+        assert evs and evs[-1].done and evs[-1].finish_reason == "length"
+        # Completeness + order under backpressure: 1 activation token +
+        # 120 block tokens, in production order.
+        assert "".join(ev.text for ev in evs) == "A" + "b" * 120
+        gens = [ev.tokens_generated for ev in evs]
+        assert gens == sorted(gens) and gens[-1] == 121
+        # The backpressure bound: in-flight on device (<= depth) +
+        # queued (<= emit_queue_blocks) + the batch being delivered +
+        # the engine thread's current block buffer.
+        assert max(lead) <= 2 + 1 + 2, f"dispatch ran ahead: {max(lead)}"
+
+
+class TestDepthTokenIdentity:
+    """Real tiny CPU engine: the A/B invariant the tentpole pins."""
+
+    @pytest.fixture(scope="class")
+    def setup(self):
+        import jax
+        import jax.numpy as jnp
+
+        from symmetry_tpu.models import init_params, preset
+
+        cfg = preset("tiny")
+        params = init_params(cfg, jax.random.key(0), jnp.float32)
+        return cfg, params
+
+    def _run_depth(self, cfg, params, depth):
+        import jax.numpy as jnp
+
+        from symmetry_tpu.engine.engine import InferenceEngine
+
+        engine = InferenceEngine(
+            cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=96,
+            prefill_buckets=(16, 48), cache_dtype=jnp.float32,
+            decode_block=4)
+        sched = Scheduler(engine, debug_invariants=True,
+                          pipeline_depth=depth)
+        reqs = [
+            (list(b"pipeline greedy one"), SamplingParams(), 16),
+            (list(b"greedy two"), SamplingParams(), 16),
+            (list(b"seeded sampled"),
+             SamplingParams(temperature=0.8, top_k=8, seed=7), 16),
+        ]
+        sched.start()
+        sigs = []
+        try:
+            for wave in range(2):
+                results = {i: [] for i in range(len(reqs))}
+                done = {i: threading.Event() for i in range(len(reqs))}
+                for i, (ids, sampling, max_new) in enumerate(reqs):
+                    def emit(ev, i=i):
+                        results[i].append(ev)
+                        if ev.done:
+                            done[i].set()
+                    sched.submit(GenRequest(
+                        prompt_ids=list(ids), sampling=sampling,
+                        max_new_tokens=max_new, emit=emit,
+                        id=f"w{wave}r{i}"))
+                for i, ev in done.items():
+                    assert ev.wait(120), f"depth {depth} r{i} hung"
+                sigs.append({
+                    i: ("".join(ev.text for ev in evs),
+                        [ev.token_id for ev in evs
+                         if ev.token_id is not None],
+                        evs[-1].tokens_generated,
+                        evs[-1].finish_reason)
+                    for i, evs in results.items()})
+                if wave == 0:
+                    sizes_w1 = engine.compile_cache_sizes()
+        finally:
+            sched.stop()
+        # Zero steady-state recompiles: wave 2 re-ran the same traffic
+        # shapes and must not have grown any jit cache.
+        assert engine.compile_cache_sizes() == sizes_w1
+        stats = sched.stats()
+        assert stats["pipeline_depth"] == depth
+        return sigs, stats
+
+    def test_identity_and_split_depth_1_vs_2(self, setup):
+        cfg, params = setup
+        sigs1, stats1 = self._run_depth(cfg, params, 1)
+        sigs2, stats2 = self._run_depth(cfg, params, 2)
+        assert sigs1 == sigs2
+        # The emit split: depth 1 keeps the inline pre-pipeline path
+        # (zero offloaded wall), depth 2's worker carried real work.
+        assert stats1["offloaded_s"] == 0
+        assert stats2["offloaded_s"] > 0
+        for stats in (stats1, stats2):
+            assert stats["dispatch_thread_s"] > 0
+            assert stats["dispatch_thread_block_s"]["p50"] is not None
+            assert "pipeline_live_depth" in stats
+            assert "emit_queue_depth" in stats

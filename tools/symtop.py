@@ -46,9 +46,9 @@ from symmetry_tpu.utils.metrics import (  # noqa: E402
 )
 
 COLUMNS = ("PROVIDER", "TIER", "TOK/S", "TTFT p50", "TTFT p99",
-           "QUEUE", "INFL", "OCC", "SHED", "RESUME", "WASTED", "REUSED",
-           "DUMPS", "LINK", "STATE", "SHARE")
-WIDTHS = (22, 10, 9, 9, 9, 7, 6, 5, 7, 7, 7, 7, 6, 6, 9, 6)
+           "QUEUE", "INFL", "OCC", "GAP%", "DEPTH", "SHED", "RESUME",
+           "WASTED", "REUSED", "DUMPS", "LINK", "STATE", "SHARE")
+WIDTHS = (22, 10, 9, 9, 9, 7, 6, 5, 5, 5, 7, 7, 7, 7, 6, 6, 9, 6)
 
 # sym_pool_member_state gauge encoding (engine/disagg/pool.py
 # STATE_CODES) rendered back to the membership lifecycle names.
@@ -245,6 +245,18 @@ def build_rows(name: str, fams: dict,
             "queue": _value(fams, "sym_sched_queue_depth", tier=tier),
             "in_flight": None,
             "occupancy": _value(fams, "sym_sched_occupancy", tier=tier),
+            # Dispatch-gap share (devprof, tier-labeled gauge): fraction
+            # of on-device wall the accelerator sat idle between
+            # dispatches — THE number the pipelined scheduler drives
+            # toward zero. At pipeline depth >= 2 the probe's sync
+            # serializes behind every in-flight block, so this reads as
+            # an UPPER bound (scheduler stats() carries the same note).
+            "gap": _fmt_pct(_value(fams, "sym_dispatch_gap_share",
+                                   tier=tier)),
+            # Live pipeline depth (blocks in flight after the last
+            # scheduler iteration): 0 = idle tier, steady < configured
+            # depth = the pipeline never fills (admission-bound).
+            "depth": _value(fams, "sym_sched_pipeline_depth", tier=tier),
             "shed": _value(fams, "sym_sched_deadline_sheds_total",
                            tier=tier),
             # Scheduler-side resume admissions and the radix tokens
@@ -263,6 +275,10 @@ def build_rows(name: str, fams: dict,
     return rows
 
 
+def _fmt_pct(v: float | None) -> str | None:
+    return None if v is None else f"{v * 100:.0f}%"
+
+
 def _fmt_cell(v: Any, width: int) -> str:
     if v is None:
         s = "-"
@@ -278,7 +294,8 @@ def render_table(rows: list[dict[str, Any]]) -> str:
     for r in rows:
         cells = (r["provider"], r["tier"] or "-", r["tok_s"],
                  r["ttft_p50"], r["ttft_p99"], r["queue"], r["in_flight"],
-                 r["occupancy"], r["shed"], r.get("resume"),
+                 r["occupancy"], r.get("gap"), r.get("depth"),
+                 r["shed"], r.get("resume"),
                  r.get("wasted"), r.get("reused"), r.get("dumps"),
                  r["link"] or "-",
                  r.get("state") or "-", r.get("share") or "-")
