@@ -648,11 +648,22 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                                  if disagg_transport == "tcp"
                                  else "mem://bench-disagg"),
                         **({"inline": True} if not disagg_pool else {}),
+                        # Pool × --multi-turn: tighten the heartbeat so
+                        # gossiped radix summaries land BETWEEN a
+                        # session's turns (default 5s would outlive a
+                        # short bench window and every turn-2 placement
+                        # would score cold).
                         **({"pool": {"prefill": disagg_pool[0],
-                                     "decode": disagg_pool[1]}}
+                                     "decode": disagg_pool[1],
+                                     **({"heartbeat_s": 0.5}
+                                        if multi_turn > 1 else {})}}
                            if disagg_pool else {})}}
                    if disagg and (disagg_transport or disagg_pool)
                    else {}),
+                # Same reason: recompute the gossiped summary faster
+                # than the tightened heartbeat asks for it.
+                **({"prefix_gossip_s": 0.25}
+                   if disagg_pool and multi_turn > 1 else {}),
                 # tracing=False empties the engine-side span rings — the
                 # A/B knob for proving the recorder's overhead stays
                 # under 1% of greedy decode tok/s (--no-trace vs default

@@ -61,6 +61,15 @@ def derive_role_config(base: dict[str, Any], role: str) -> dict[str, Any]:
     tpu["role"] = role
     if role == "decode" and not tpu.get("prefix_cache_mb"):
         tpu["prefix_cache_mb"] = DEFAULT_DECODE_PREFIX_MB
+    if role == "prefill" and not tpu.get("prefix_cache_mb"):
+        # The prefill tier's radix cache is what session-affine pool
+        # routing monetizes: turn N+1 of a conversation re-placed on
+        # the member holding turn N's prefix KV skips that prefill
+        # work entirely, and the cache summary it gossips is the
+        # router's affinity signal. Same geometry constraints the
+        # decode default already imposes (prefix_block divides every
+        # bucket), so no config that ran disagg before can newly fail.
+        tpu["prefix_cache_mb"] = DEFAULT_DECODE_PREFIX_MB
     if role == "prefill" and "pipeline_depth" not in overrides:
         # A prefill tier never decodes: there are no blocks to keep in
         # flight, so the emit worker would idle next to admission-only
@@ -88,6 +97,12 @@ class HandoffBroker:
         #               the pair, a pool member id in pool mode)
         self._pending: dict[str, tuple[dict[str, Any], float,
                                        str | None]] = {}
+        # Per-DESTINATION ledger accounting (pool topology): blocks
+        # covered / actually shipped per adopting member, so the smoke
+        # and symtop can see that warm handoffs to a specific member
+        # ship only tail blocks. Keyed by the member id adopt_op was
+        # told; the fixed pair books under "decode".
+        self.member_ledger: dict[str, dict[str, int]] = {}
         self.counters = {"submitted": 0, "handoff_frames": 0,
                          "handoff_bytes": 0, "prefix_tokens": 0,
                          "routing_only": 0, "dropped": 0,
@@ -97,6 +112,10 @@ class HandoffBroker:
                          # were adopted by reference on the decode tier
                          # (the incremental-handoff savings).
                          "blocks": 0, "blocks_shipped": 0,
+                         # Warm handoffs: frames that shipped strictly
+                         # fewer blocks than their manifest covered —
+                         # the destination already held the rest.
+                         "warm_frames": 0,
                          # The WIRE leg of the handoff (serialize time
                          # lives host-side in handoff_stats): pipe hop
                          # for the local pair, chunked link transfer in
@@ -205,14 +224,22 @@ class HandoffBroker:
     def pending(self) -> int:
         return len(self._pending)
 
+    def is_pending(self, request_id: str) -> bool:
+        """True while a submit awaits its handoff frame — lets callers
+        route the adopting member BEFORE adopt_op pops the entry."""
+        return request_id in self._pending
+
     # ------------------------------------------------------------ handoff
 
-    def adopt_op(self, handoff: dict[str, Any]) -> dict[str, Any] | None:
+    def adopt_op(self, handoff: dict[str, Any],
+                 member: str | None = None) -> dict[str, Any] | None:
         """One prefill-host `handoff` op → the decode-host `adopt` op,
         with the remembered request state re-attached and the deadline
-        rebased by the prefill-tier time already spent. None when the
-        request is unknown (already cancelled/failed — drop the frame,
-        nobody is waiting)."""
+        rebased by the prefill-tier time already spent. `member` is the
+        adopting decode member (pool mode) — its per-member ledger
+        books the blocks covered vs shipped. None when the request is
+        unknown (already cancelled/failed — drop the frame, nobody is
+        waiting)."""
         req_id = str(handoff.get("id", ""))
         entry = self._pending.pop(req_id, None)
         if entry is None:
@@ -247,8 +274,22 @@ class HandoffBroker:
                                    request_id=req_id, bytes=nbytes)
         p = int(handoff.get("p", 0))
         self.counters["prefix_tokens"] += p
-        self.counters["blocks"] += int(handoff.get("blocks", 0))
-        self.counters["blocks_shipped"] += int(handoff.get("shipped", 0))
+        blocks = int(handoff.get("blocks", 0))
+        shipped = int(handoff.get("shipped", 0))
+        self.counters["blocks"] += blocks
+        self.counters["blocks_shipped"] += shipped
+        if blocks and shipped < blocks:
+            self.counters["warm_frames"] += 1
+        led = self.member_ledger.setdefault(
+            member or "decode",
+            {"frames": 0, "bytes": 0, "blocks": 0, "blocks_shipped": 0,
+             "warm_frames": 0})
+        led["frames"] += 1
+        led["bytes"] += nbytes
+        led["blocks"] += blocks
+        led["blocks_shipped"] += shipped
+        if blocks and shipped < blocks:
+            led["warm_frames"] += 1
         if p == 0:
             self.counters["routing_only"] += 1
         op: dict[str, Any] = {"op": HostOp.ADOPT, "id": req_id,
@@ -275,4 +316,8 @@ class HandoffBroker:
         out["pending"] = len(self._pending)
         out["prefill_tier_s"] = self.prefill_tier_hist.to_dict()
         out["wire_s"] = self.wire_hist.to_dict()
+        if self.member_ledger:
+            out["per_member"] = {m: dict(v)
+                                 for m, v in sorted(
+                                     self.member_ledger.items())}
         return out

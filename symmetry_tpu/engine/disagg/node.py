@@ -326,7 +326,12 @@ class PrefillNode:
             return
         meta = {"id": str(msg.get("id", "")), "p": int(msg.get("p", 0)),
                 "prompt_len": int(msg.get("prompt_len", 0)),
-                "nbytes": len(frame)}
+                "nbytes": len(frame),
+                # Ledger accounting rides to the receiving broker: the
+                # manifest's block count vs the blocks whose payload is
+                # actually in this frame (the warm-handoff savings).
+                "blocks": int(msg.get("blocks", 0)),
+                "shipped": int(msg.get("shipped", 0))}
         self.stats["handoffs_pumped"] += 1
         ok = await plink.send_handoff(meta, frame)
         if not ok:

@@ -38,13 +38,21 @@ makes every membership/placement rule unit-testable in microseconds:
                                               gauges as the control
                                               signal)
 
-Placement policy: least-loaded healthy member — score is the member's
-live in-flight count plus its last-reported queue-depth gauge, burn rate
-as the tie-break (scale AWAY from the tier that is burning SLO budget),
-then lifetime placements (round-robin among idle equals). A pool of one
-degenerates exactly to the pair: the single member takes every placement
-while healthy, and its loss leaves nothing to re-place onto — the caller
-sheds structured-retryable, the PR 7/9 behavior.
+Placement policy: cache-aware least-loaded — each member's base score
+is its live in-flight count plus its last-reported queue-depth gauge,
+MINUS the predicted radix-cache hit (in blocks) for this request on
+that member, weighted by `tpu.pool_affinity_weight` and decayed by the
+age of the member's last gossiped cache summary (SGLang's cache-aware
+load balancing, PAPERS.md). Burn rate is the tie-break (scale AWAY
+from the tier burning SLO budget), then lifetime placements
+(round-robin among idle equals). The affinity term degrades, never
+wedges: no request digests, no gossiped summary, a stale summary, or
+gauges older than two heartbeat periods (a member that stopped
+reporting) all collapse the score to pure load — exactly the pre-PR-17
+policy. A pool of one degenerates exactly to the pair: the single
+member takes every placement while healthy, and its loss leaves
+nothing to re-place onto — the caller sheds structured-retryable, the
+PR 7/9 behavior.
 
 Membership states (one-way transitions except rejoin):
 
@@ -114,7 +122,8 @@ class PoolMember:
 
     __slots__ = ("member_id", "tier", "state", "in_flight", "placements",
                  "queue_depth", "burn_rate", "node_id", "joined_at",
-                 "state_since", "losses", "restarts")
+                 "state_since", "losses", "restarts", "summary",
+                 "summary_at", "gauges_at", "hit_blocks")
 
     def __init__(self, member_id: str, tier: str) -> None:
         self.member_id = member_id
@@ -129,6 +138,15 @@ class PoolMember:
         self.state_since = self.joined_at
         self.losses = 0                    # times this member went lost
         self.restarts = 0                  # per-member respawns (decode)
+        # Cache-affinity state: the member's last gossiped radix-cache
+        # summary (digest set), when it arrived, when the load gauges
+        # last arrived (None = never — a member that stopped gossiping
+        # must fall out of affinity scoring, not coast on stale data),
+        # and the lifetime predicted-hit blocks banked by placements.
+        self.summary: frozenset[str] | None = None
+        self.summary_at: float | None = None
+        self.gauges_at: float | None = None
+        self.hit_blocks = 0
 
     @property
     def placeable(self) -> bool:
@@ -148,6 +166,9 @@ class PoolMember:
                 "queue_depth": self.queue_depth,
                 "burn_rate": round(self.burn_rate, 4),
                 "losses": self.losses, "restarts": self.restarts,
+                "hit_blocks": self.hit_blocks,
+                "summary_digests": (len(self.summary)
+                                    if self.summary is not None else 0),
                 "state_age_s": round(
                     time.monotonic() - self.state_since, 3)}
 
@@ -159,15 +180,36 @@ class PoolRouter:
     link callbacks, the readers, and stream() all live there) — same
     no-locking contract as the broker."""
 
-    def __init__(self) -> None:
+    def __init__(self, *, heartbeat_s: float = 5.0,
+                 affinity_weight: float = 1.0,
+                 clock=time.monotonic) -> None:
+        # Affinity knobs: heartbeat_s sets the staleness clock for the
+        # gossiped summaries AND the gauge-age cutoff (2 periods);
+        # affinity_weight scales predicted-hit blocks against load
+        # (queue slots) — 0 turns cache-aware placement off entirely.
+        # `clock` is injectable so staleness decay is test-drivable.
+        self.heartbeat_s = max(float(heartbeat_s), 0.001)
+        self.affinity_weight = max(float(affinity_weight), 0.0)
+        self._clock = clock
         self._members: dict[str, PoolMember] = {}
         # request id -> member id, per tier (a request is assigned to at
         # most one prefill member pre-handoff, one decode member after).
         self._assigned: dict[str, str] = {}
         self._adopted: dict[str, str] = {}
+        # request id -> decode member chosen AT SUBMIT TIME (so the
+        # prefill tier can key its shipped-block ledger by the member
+        # the handoff will actually reach); consumed by route_decode.
+        self._planned: dict[str, str] = {}
+        # Per-member ledger epoch: bumped every time the member goes
+        # lost. The prefill tier tags ledger entries with the epoch it
+        # was told at submit; a bumped epoch invalidates every entry
+        # (the respawned member's cache is empty — skipping blocks it
+        # no longer holds would corrupt adoption).
+        self._ledger_epoch: dict[str, int] = {}
         self.counters = {"placements": 0, "re_placements": 0,
                          "drains": 0, "losses": 0, "joins": 0,
-                         "rejoins": 0}
+                         "rejoins": 0, "affinity_hit": 0,
+                         "affinity_cold": 0, "affinity_load_only": 0}
         self._m_members = METRICS.gauge(
             MetricName.POOL_MEMBERS, "pool members known (any state)",
             labels=("tier",))
@@ -186,6 +228,18 @@ class PoolRouter:
             "in-flight requests re-placed off a lost/drained member")
         self._m_drains = METRICS.counter(
             MetricName.POOL_DRAINS, "members drained (deliberate)")
+        self._m_predicted_hit = METRICS.counter(
+            MetricName.POOL_PREDICTED_HIT,
+            "predicted radix-hit blocks banked by affinity placements",
+            labels=("tier", "node"))
+        self._m_affinity = METRICS.counter(
+            MetricName.POOL_AFFINITY_PLACEMENTS,
+            "placements by affinity outcome (hit/cold/load_only)",
+            labels=("outcome",))
+        self._m_gossip_age = METRICS.gauge(
+            MetricName.POOL_GOSSIP_AGE,
+            "age of a member's last gossiped cache summary",
+            labels=("tier", "node"))
 
     # --------------------------------------------------------- membership
 
@@ -229,6 +283,16 @@ class PoolRouter:
             m.node_id = node_id
         if m.state == MemberState.LOST:
             self.counters["rejoins"] += 1
+            # A rejoined member is a NEW process with an empty cache and
+            # no load history. Pre-PR-17 the router kept trusting the
+            # pre-loss gauges forever; now everything resets and the
+            # member is load-only (gauges_at None) until its first fresh
+            # heartbeat stamps it back into affinity scoring.
+            m.queue_depth = 0.0
+            m.burn_rate = 0.0
+            m.gauges_at = None
+            m.summary = None
+            m.summary_at = None
         elif m.state == MemberState.JOINING:
             self.counters["joins"] += 1
         self._set_state(m, MemberState.HEALTHY)
@@ -254,6 +318,15 @@ class PoolRouter:
         if m.state != MemberState.LOST:
             m.losses += 1
             self.counters["losses"] += 1
+            # Its cache died with it: invalidate the gossiped summary
+            # (no more affinity pulls toward a cold respawn) and bump
+            # the ledger epoch so the prefill tier drops every
+            # shipped-block entry keyed to this member.
+            m.summary = None
+            m.summary_at = None
+            m.gauges_at = None
+            self._ledger_epoch[member_id] = (
+                self._ledger_epoch.get(member_id, 0) + 1)
         self._set_state(m, MemberState.LOST)
         ids = sorted(m.in_flight)
         m.in_flight.clear()
@@ -262,31 +335,120 @@ class PoolRouter:
                 self._assigned.pop(req_id, None)
             if self._adopted.get(req_id) == member_id:
                 self._adopted.pop(req_id, None)
+            if self._planned.get(req_id) == member_id:
+                self._planned.pop(req_id, None)
         return ids
+
+    def ledger_epoch(self, member_id: str) -> int:
+        """Current shipped-block-ledger epoch for a member (0 until its
+        first loss). Rides each submit so the prefill host can detect a
+        member respawn and drop that member's ledger."""
+        return self._ledger_epoch.get(member_id, 0)
 
     # --------------------------------------------------------- placement
 
+    def predicted_hit(self, m: PoolMember,
+                      digests: list[str] | None) -> int:
+        """Predicted radix-cache hit depth (blocks) for a request with
+        these causal block digests on this member: the longest
+        CONTIGUOUS leading run of the request's digests present in the
+        member's gossiped summary. Contiguous because the radix tree
+        can only serve a prefix — digest k without digests 0..k-1 is
+        unreachable KV. 0 whenever the signal is unusable: no digests,
+        no summary, or gauges older than two heartbeat periods (the
+        member stopped reporting; its summary describes a past life)."""
+        if (not digests or m.summary is None
+                or not self._gauges_fresh(m)):
+            return 0
+        hit = 0
+        for d in digests:
+            if d not in m.summary:
+                break
+            hit += 1
+        return hit
+
+    def _gauges_fresh(self, m: PoolMember) -> bool:
+        return (m.gauges_at is not None
+                and self._clock() - m.gauges_at
+                <= 2.0 * self.heartbeat_s)
+
+    def _summary_decay(self, m: PoolMember) -> float:
+        """Staleness decay on the gossiped summary: halves every two
+        heartbeat periods, so a member that keeps gossiping scores near
+        full weight and one whose summary is aging fades smoothly out
+        of affinity instead of flapping."""
+        if m.summary_at is None:
+            return 0.0
+        age = max(self._clock() - m.summary_at, 0.0)
+        return 0.5 ** (age / (2.0 * self.heartbeat_s))
+
     def _pick(self, tier: str,
-              exclude: set[str] | frozenset = frozenset()
-              ) -> PoolMember | None:
+              exclude: set[str] | frozenset = frozenset(),
+              digests: list[str] | None = None
+              ) -> tuple[PoolMember | None, int]:
+        """Best placeable member of `tier` and its predicted-hit depth
+        (blocks). Score = load − affinity_weight × decay × hit, so one
+        decayed hit block outbids one queue slot at weight 1 — then the
+        original burn/placements/id tie-break. With no usable affinity
+        signal every hit term is 0 and this IS the pre-PR-17 policy."""
         live = [m for m in self._members.values()
                 if m.tier == tier and m.placeable
                 and m.member_id not in exclude]
         if not live:
-            return None
-        return min(live, key=PoolMember.score)
+            return None, 0
+        use_affinity = bool(digests) and self.affinity_weight > 0.0
+        # Outstanding decode plans are load the member WILL carry (the
+        # handoff lands there) — without them every concurrent submit
+        # would plan the same idle member by id tie-break.
+        planned: dict[str, int] = {}
+        for mid in self._planned.values():
+            planned[mid] = planned.get(mid, 0) + 1
+        best: PoolMember | None = None
+        best_key: tuple | None = None
+        best_hit = 0
+        for m in live:
+            hit = self.predicted_hit(m, digests) if use_affinity else 0
+            key = (len(m.in_flight) + m.queue_depth
+                   + planned.get(m.member_id, 0)
+                   - self.affinity_weight * self._summary_decay(m) * hit,
+                   m.burn_rate, m.placements, m.member_id)
+            if best_key is None or key < best_key:
+                best, best_key, best_hit = m, key, hit
+        return best, best_hit
+
+    def _book_affinity(self, m: PoolMember, tier: str, hit: int,
+                       digests: list[str] | None) -> None:
+        """Account one placement's affinity outcome: `hit` (the summary
+        predicted cached blocks on the winner), `cold` (a signal
+        existed but predicted nothing — e.g. turn 1, or the warm member
+        died), `load_only` (no usable signal at all)."""
+        if not digests or self.affinity_weight <= 0.0:
+            outcome = "load_only"
+        elif hit > 0:
+            outcome = "hit"
+            m.hit_blocks += hit
+            self._m_predicted_hit.inc(hit, tier=tier, node=m.member_id)
+        else:
+            outcome = "cold"
+        self.counters[f"affinity_{outcome}"] = (
+            self.counters.get(f"affinity_{outcome}", 0) + 1)
+        self._m_affinity.inc(outcome=outcome)
 
     def place(self, request_id: str, *,
+              digests: list[str] | None = None,
               exclude: set[str] | frozenset = frozenset()) -> str | None:
-        """Least-loaded healthy PREFILL member for one request; None
-        when no member is placeable (caller sheds retryable). ASSIGNS
-        only — the caller confirms with record_placement() once the
-        submit actually reached the member, so a refused send (walked
-        past via `exclude` + release()) never inflates the ledger or
-        skews the round-robin tie-break."""
-        m = self._pick(PREFILL, exclude)
+        """Best healthy PREFILL member for one request — cache-affine
+        when `digests` (the request's causal block digests) are given,
+        least-loaded otherwise; None when no member is placeable
+        (caller sheds retryable). ASSIGNS only — the caller confirms
+        with record_placement() once the submit actually reached the
+        member, so a refused send (walked past via `exclude` +
+        release()) never inflates the ledger or skews the round-robin
+        tie-break."""
+        m, hit = self._pick(PREFILL, exclude, digests)
         if m is None:
             return None
+        self._book_affinity(m, PREFILL, hit, digests)
         old = self._assigned.get(request_id)
         if old is not None and old != m.member_id:
             prev = self._members.get(old)
@@ -296,6 +458,25 @@ class PoolRouter:
         m.in_flight.add(request_id)
         self._refresh_gauges(m)
         return m.member_id
+
+    def plan_decode(self, request_id: str,
+                    digests: list[str] | None = None) -> str | None:
+        """Choose (but do not yet book) the decode member this request's
+        handoff should land on — cache-affine against the DECODE tier's
+        gossiped summaries. Called at submit time so the prefill host
+        can key its shipped-block ledger by the actual destination;
+        route_decode() consumes the plan when the handoff arrives (and
+        re-picks if that member died in between). None when no decode
+        member is placeable (single-decode pools always plan the one)."""
+        m, _hit = self._pick(DECODE, frozenset(), digests)
+        if m is None:
+            self._planned.pop(request_id, None)
+            return None
+        self._planned[request_id] = m.member_id
+        return m.member_id
+
+    def planned_decode(self, request_id: str) -> str | None:
+        return self._planned.get(request_id)
 
     def record_placement(self, request_id: str, *,
                          replacement: bool = False) -> None:
@@ -313,13 +494,24 @@ class PoolRouter:
             self.counters["re_placements"] += 1
             self._m_replacements.inc()
 
-    def route_decode(self, request_id: str) -> str | None:
-        """DECODE member for one handed-off request, chosen by the
-        queue-depth/burn-rate gauges; releases the prefill assignment
-        (the migration left that tier). None when no decode member is
-        placeable."""
+    def route_decode(self, request_id: str, *,
+                     prefer: str | None = None) -> str | None:
+        """DECODE member for one handed-off request; releases the
+        prefill assignment (the migration left that tier). Prefers the
+        member planned at submit time (`prefer` or the stored plan) —
+        the one the shipped-block ledger was keyed against — falling
+        back to the gauge-scored pick when that member is no longer
+        placeable. None when no decode member is placeable."""
         self._release_assigned(request_id)
-        m = self._pick(DECODE)
+        planned = self._planned.pop(request_id, None)
+        prefer = prefer or planned
+        m: PoolMember | None = None
+        if prefer is not None:
+            cand = self._members.get(prefer)
+            if cand is not None and cand.tier == DECODE and cand.placeable:
+                m = cand
+        if m is None:
+            m, _hit = self._pick(DECODE)
         if m is None:
             return None
         self._adopted[request_id] = m.member_id
@@ -352,6 +544,7 @@ class PoolRouter:
     def note_done(self, request_id: str) -> None:
         """Request ended (any outcome): release whatever it held."""
         self._release_assigned(request_id)
+        self._planned.pop(request_id, None)
         member_id = self._adopted.pop(request_id, None)
         if member_id is not None:
             m = self._members.get(member_id)
@@ -366,7 +559,10 @@ class PoolRouter:
                       burn_rate: float | None = None) -> None:
         """Feed one member's load gauges (scheduler queue depth off its
         stats probe; SLO burn rate from the provider's monitor) — the
-        placement signal beyond the router's own in-flight counts."""
+        placement signal beyond the router's own in-flight counts.
+        Stamps the gauge age: a member whose stamp falls more than two
+        heartbeat periods behind drops out of affinity scoring (its
+        summary describes a cache we can no longer see)."""
         m = self._members.get(member_id)
         if m is None:
             return
@@ -374,6 +570,28 @@ class PoolRouter:
             m.queue_depth = max(float(queue_depth), 0.0)
         if burn_rate is not None:
             m.burn_rate = max(float(burn_rate), 0.0)
+        m.gauges_at = self._clock()
+        if m.summary_at is not None:
+            self._m_gossip_age.set(
+                round(max(self._clock() - m.summary_at, 0.0), 3),
+                tier=m.tier, node=m.member_id)
+
+    def update_summary(self, member_id: str,
+                       summary: dict[str, Any] | None) -> None:
+        """Feed one member's gossiped radix-cache summary (the stats
+        rider harvested off its heartbeat probe). None means the member
+        answered without a summary (cache disabled, empty, or an old
+        binary) — keep the previous one aging out via decay rather than
+        flapping the affinity signal on every empty beat."""
+        m = self._members.get(member_id)
+        if m is None or summary is None:
+            return
+        digests = summary.get("digests")
+        if not isinstance(digests, (list, tuple)) or not digests:
+            return
+        m.summary = frozenset(str(d) for d in digests)
+        m.summary_at = self._clock()
+        self._m_gossip_age.set(0.0, tier=m.tier, node=m.member_id)
 
     def _refresh_gauges(self, m: PoolMember) -> None:
         self._m_state.set(STATE_CODES[m.state], tier=m.tier,
@@ -398,4 +616,5 @@ class PoolRouter:
                         DECODE: self.healthy_count(DECODE)},
             "in_flight": {PREFILL: len(self._assigned),
                           DECODE: len(self._adopted)},
+            "ledger_epochs": dict(sorted(self._ledger_epoch.items())),
         }

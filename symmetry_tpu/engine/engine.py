@@ -22,6 +22,7 @@ concurrency in one event loop (SURVEY §5.2).
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
 from typing import Any, NamedTuple
 
@@ -170,6 +171,8 @@ class InferenceEngine:
         prefill_token_budget: int | None = None,
         prefix_cache_bytes: int = 0,
         prefix_block_tokens: int = 16,
+        prefix_gossip_blocks: int = 64,
+        prefix_gossip_s: float = 2.0,
         speculative: SpecConfig | None = None,
         fused_dequant: bool = False,
         role: str = "unified",
@@ -345,6 +348,14 @@ class InferenceEngine:
         self.block_pool: BlockPool | None = None
         self.prefix_index: RadixIndex | None = None
         self._pool_kv = None
+        # Pool-gossip rider sizing/cadence (tpu.prefix_gossip_blocks /
+        # tpu.prefix_gossip_s): how many hot-path block digests the
+        # cache summary carries on each stats probe, and the minimum
+        # recompute interval (the summary walk is O(digests), but the
+        # stats probe fires per heartbeat per member — cache it).
+        self.prefix_gossip_blocks = int(prefix_gossip_blocks)
+        self.prefix_gossip_s = float(prefix_gossip_s)
+        self._gossip_cache: tuple[float, dict | None] | None = None
         if prefix_cache_bytes > 0 and self.prefix_align:
             # Only a BUILT pool constrains the bucket grid (the gather/
             # scatter programs index buckets in whole blocks); with the
@@ -1126,6 +1137,23 @@ class InferenceEngine:
         return (self.prefix_index.stats()
                 if self.prefix_index is not None else None)
 
+    def prefix_cache_summary(self) -> dict | None:
+        """Compact radix-cache summary for pool gossip (see
+        RadixIndex.summary) — recomputed at most every
+        `prefix_gossip_s` seconds so per-member heartbeat probes share
+        one walk. None when the cache or the gossip rider is off.
+        Called from the host's serve (stats) thread; the summary walk
+        itself is read-only and exception-guarded."""
+        if self.prefix_index is None or self.prefix_gossip_blocks <= 0:
+            return None
+        now = time.monotonic()
+        cached = self._gossip_cache
+        if cached is not None and now - cached[0] < self.prefix_gossip_s:
+            return cached[1]
+        s = self.prefix_index.summary(self.prefix_gossip_blocks)
+        self._gossip_cache = (now, s)
+        return s
+
     # ------------------------------------------------------------------
     # Disaggregated prefill/decode (engine side; wire format and broker
     # in engine/disagg/)
@@ -1863,6 +1891,13 @@ class InferenceEngine:
                 (getattr(tpu_cfg, "prefix_cache_mb", None) or 0) * 2**20),
             prefix_block_tokens=int(
                 getattr(tpu_cfg, "prefix_block_tokens", None) or 16),
+            prefix_gossip_blocks=int(
+                getattr(tpu_cfg, "prefix_gossip_blocks", None) or 0),
+            # is-None, not falsy-or: an explicit 0.0 means "recompute
+            # on every heartbeat probe", not the default cadence
+            prefix_gossip_s=float(
+                2.0 if getattr(tpu_cfg, "prefix_gossip_s", None) is None
+                else tpu_cfg.prefix_gossip_s),
             speculative=SpecConfig.from_knob(
                 getattr(tpu_cfg, "speculative", None)),
             fused_dequant=bool(getattr(tpu_cfg, "fused_dequant", False)),

@@ -170,25 +170,31 @@ class EngineHost:
                               # (asserted by the disagg smoke's
                               # warm-handoff leg).
                               "blocks": 0, "blocks_shipped": 0}
-        # Digests of blocks already shipped from this prefill host (LRU-
-        # bounded). A block in the ledger is OMITTED from later frames:
-        # the decode tier adopts it by reference from its radix tree, or
-        # — if it evicted the block since — shortens the adopted prefix
-        # and re-prefills a longer suffix (correct either way; the
-        # ledger is a bytes optimization, never a correctness input).
-        # Gated by tpu.handoff_ledger: the tpu_native LOCAL PAIR enables
-        # it (both hosts respawn as one unit, so the ledger cannot
-        # outlive the receiver's tree); pool mode (N decode members) and
-        # network mode (decode respawns independently of the remote
-        # prefill node) leave it off — a stale ledger would silently
-        # degrade every warm handoff to a full re-prefill.
+        # Digests of blocks already shipped from this prefill host,
+        # PER DESTINATION MEMBER (LRU-bounded per member). A block in a
+        # member's ledger is OMITTED from later frames to that member:
+        # it adopts the block by reference from its radix tree, or — if
+        # it evicted the block since — shortens the adopted prefix and
+        # re-prefills a longer suffix (correct either way; the ledger
+        # is a bytes optimization, never a correctness input). The
+        # submit op's "ledger" field names the planned destination and
+        # its ledger EPOCH (bumped by the router every time that member
+        # goes lost); an advanced epoch drops the member's entries —
+        # its respawned cache is empty, and while skipping blocks it no
+        # longer holds stays CORRECT (shorter adopted prefix), it would
+        # silently degrade every warm handoff to a full re-prefill.
+        # Submits without the field (the fixed pair, old providers)
+        # book under one default key — pool-of-1 degenerates to the
+        # pair semantics. Gated by tpu.handoff_ledger (default on).
         from collections import OrderedDict
 
         self._ledger_on = bool(getattr(config.tpu, "handoff_ledger",
                                        False)) if config is not None \
             else False
-        self._shipped: OrderedDict[str, None] = OrderedDict()
-        self._shipped_cap = 65536
+        self._shipped: dict[str, OrderedDict[str, None]] = {}
+        self._shipped_cap = 65536          # digests kept per member
+        self._ledger_epochs: dict[str, int] = {}
+        self._ledger_dest: dict[str, str] = {}  # req id -> member key
         self.adopt_stats = {"frames": 0, "bytes": 0, "adopted": 0,
                             "rejected": 0, "errors": 0,
                             "deserialize_s": 0.0}
@@ -416,6 +422,20 @@ class EngineHost:
                 # concurrently and iteration must not race a resize.
                 m["journal"] = {k: self._reported.get(k, 0)
                                 for k in list(self._reported)}
+                # Pool-gossip rider: the engine's radix-cache summary
+                # (hot-path block digests + depth histogram) rides every
+                # stats reply — the provider's PoolRouter harvests it
+                # off the heartbeat probe for cache-affine placement. A
+                # payload field on an existing op, not a new op: the
+                # wire contract (W101–W104) stays untouched, and members
+                # that predate the field simply gossip nothing (the
+                # router degrades to load-only for them).
+                summary = getattr(self._engine, "prefix_cache_summary",
+                                  None)
+                if summary is not None:
+                    ps = summary()
+                    if ps is not None:
+                        m["prefix_summary"] = ps
                 if self._role == "prefill":
                     m["handoff"] = {**self.handoff_stats,
                                     "serialize_s": round(
@@ -556,6 +576,25 @@ class EngineHost:
             seed=s.get("seed"),
             rng_skip=resume_offset,
         )
+        led = msg.get("ledger")
+        if self._role == "prefill" and isinstance(led, dict):
+            # Pool routing told us which decode member this request's
+            # handoff is planned for, and that member's ledger epoch.
+            # An advanced epoch means the member respawned since we
+            # last shipped to it: drop its ledger NOW, before this
+            # request's handoff would skip blocks an empty cache
+            # cannot adopt by reference.
+            member = str(led.get("member") or "decode")
+            epoch = int(led.get("epoch") or 0)
+            with self._wlock:
+                if epoch > self._ledger_epochs.get(member, 0):
+                    self._ledger_epochs[member] = epoch
+                    self._shipped.pop(member, None)
+                self._ledger_dest[req_id] = member
+                while len(self._ledger_dest) > self._shipped_cap:
+                    # Requests that end without a handoff (cancel,
+                    # deadline shed) leave their entry behind; bound it.
+                    self._ledger_dest.pop(next(iter(self._ledger_dest)))
         if self._role == "prefill":
             pb = self._engine.prefix_block or 0
             if pb and (len(prompt_ids) - 1) // pb == 0:
@@ -648,15 +687,22 @@ class EngineHost:
         pb = self._engine.prefix_block or 0
         skip: list[int] = []
         digests: list[str] = []
+        with self._wlock:
+            # _submit's pipe-reader thread writes this map; this method
+            # runs on the engine thread too (symlint C202).
+            member = self._ledger_dest.pop(req_id, "decode")
         if p > 0 and pb and self._ledger_on:
             # Incremental handoff: blocks whose digest this host already
-            # shipped are omitted from the payload (manifest-only). The
-            # ledger mutates under _wlock — this method runs on the
-            # engine thread AND the pipe-reader thread (fast path).
+            # shipped TO THIS DESTINATION are omitted from the payload
+            # (manifest-only). The ledger mutates under _wlock — this
+            # method runs on the engine thread AND the pipe-reader
+            # thread (fast path).
             digests = block_digests(prompt_ids, p, pb)
             with self._wlock:
-                skip = [j for j, d in enumerate(digests)
-                        if d in self._shipped]
+                ledger = self._shipped.get(member)
+                if ledger is not None:
+                    skip = [j for j, d in enumerate(digests)
+                            if d in ledger]
         frame = encode_kv_handoff(req_id, prompt_ids, p, arrays,
                                   kv_quant=self._engine.kv_quant,
                                   block_size=pb, skip=skip,
@@ -679,11 +725,15 @@ class EngineHost:
             if p == 0:
                 self.handoff_stats["routing_only"] += 1
             self.handoff_stats["serialize_s"] += dt
-            for d in digests:
-                self._shipped.pop(d, None)
-                self._shipped[d] = None  # most-recently-shipped last
-            while len(self._shipped) > self._shipped_cap:
-                self._shipped.popitem(last=False)
+            if digests:
+                from collections import OrderedDict
+
+                ledger = self._shipped.setdefault(member, OrderedDict())
+                for d in digests:
+                    ledger.pop(d, None)
+                    ledger[d] = None  # most-recently-shipped last
+                while len(ledger) > self._shipped_cap:
+                    ledger.popitem(last=False)
         self._m_handoff_frames.inc()
         self._m_handoff_bytes.inc(len(frame))
         self._m_handoff_serialize.observe(dt)

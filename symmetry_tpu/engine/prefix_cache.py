@@ -523,6 +523,59 @@ class RadixIndex:
     def note_miss(self, n_requests: int) -> None:
         self.stats_counters["misses"] += n_requests
 
+    def summary(self, max_digests: int = 64) -> dict[str, Any] | None:
+        """Compact cache summary for pool gossip: up to `max_digests`
+        block digests along the HOTTEST root→leaf paths (most recently
+        used first — the prefixes a session-affine router should chase)
+        plus a depth histogram of those paths, in blocks. Rides the
+        stats probe as a heartbeat payload field; the PoolRouter on the
+        provider side intersects a request's own digests against it to
+        predict hit depth.
+
+        Digests are the same causal blake2b-16 hexes as the handoff
+        manifests (`block_digests`), so router-side digests computed
+        from the routing tokenizer's prompt ids match exactly.
+
+        Unlike every mutating call, this may run OFF the engine thread
+        (the host's serve loop answers STATS while the engine thread
+        inserts/evicts). Reads are GIL-atomic snapshots but a racing
+        split/evict can garble one path — a garbled digest is only a
+        wrong routing hint, so the whole walk is exception-guarded:
+        degrade (None → load-only placement), never wedge."""
+        if max_digests <= 0:
+            return None
+        try:
+            digests: dict[str, None] = {}  # ordered de-dup
+            depths: dict[int, int] = {}
+            for leaf in reversed(list(self._leaves.values())):
+                if len(digests) >= max_digests:
+                    break
+                # Root-path tokens via the parent chain (leaf-upward,
+                # then reversed into prefix order).
+                parts: list[tuple[int, ...]] = []
+                node: RadixNode | None = leaf
+                while node is not None and node.parent is not None:
+                    parts.append(node.tokens)
+                    node = node.parent
+                tokens: list[int] = []
+                for part in reversed(parts):
+                    tokens.extend(part)
+                p = (len(tokens) // self.block_tokens) * self.block_tokens
+                if p == 0:
+                    continue
+                depth = p // self.block_tokens
+                depths[depth] = depths.get(depth, 0) + 1
+                for d in block_digests(tokens, p, self.block_tokens):
+                    digests.setdefault(d, None)
+            if not digests:
+                return None
+            return {"block_tokens": self.block_tokens,
+                    "digests": list(digests)[:max_digests],
+                    "depths": {str(k): v
+                               for k, v in sorted(depths.items())}}
+        except Exception:
+            return None
+
     def stats(self) -> dict[str, Any]:
         out: dict[str, Any] = dict(self.stats_counters)
         pool = self.pool

@@ -85,7 +85,20 @@ class HostOp:
     CANCEL = "cancel"       # abort one in-flight request by id
     CLOCK = "clock"         # clock-offset handshake probe (echoed back)
     TRACE = "trace"         # span-ring snapshot request (echoed back)
-    STATS = "stats"         # scheduler/emit counters probe (echoed back)
+    STATS = "stats"         # scheduler/emit counters probe (echoed
+                            # back). The reply doubles as the pool
+                            # gossip carrier: a host with a live radix
+                            # cache attaches a "prefix_summary" rider
+                            # (bounded block digests + depth histogram,
+                            # engine/prefix_cache.py summary()) that
+                            # the pool router harvests off its
+                            # heartbeat probes for cache-affine
+                            # placement — no new op, no extra wire
+                            # round-trip. Symmetrically, SUBMIT carries
+                            # an optional "ledger" rider ({member,
+                            # epoch}) telling the prefill host which
+                            # decode member's shipped-block ledger the
+                            # handoff should be keyed against.
     METRICS = "metrics"     # metrics-registry snapshot probe (echoed
                             # back with the host process's registry
                             # families + its tier role; the provider

@@ -164,6 +164,13 @@ class TpuNativeBackend(InferenceBackend):
         # first member spawns (they must not bail while start() is
         # still assembling the pool) and cleared first thing in stop().
         self._pool_active = False
+        # Cache-affine routing signal: a provider-side ROUTING tokenizer
+        # (same tokenizer files as the hosts', so it produces identical
+        # prompt ids → identical causal block digests to the gossiped
+        # cache summaries). Lazily built on the first pool placement;
+        # False = construction failed once — permanent load-only
+        # fallback, logged once, never retried per request.
+        self._route_tok: Any = None
         # The provider's SLO burn-rate monitor (attached after
         # construction): the pool heartbeat reads its live fast-window
         # burn and feeds PoolRouter.update_gauges — the placement
@@ -389,14 +396,8 @@ class TpuNativeBackend(InferenceBackend):
             # tier derives its own config on its own machine.
             self._cfg_path = write_cfg(derive_role_config(cfg, "decode"))
             if self._local_pair:
-                pre_cfg = derive_role_config(cfg, "prefill")
-                # Incremental handoff is sound ONLY for the local pair:
-                # the supervisor respawns both hosts as one unit, so the
-                # prefill host's shipped-block ledger can never outlive
-                # the decode tree it refers to. Pool/net modes keep it
-                # off (see TpuConfig.handoff_ledger).
-                pre_cfg["tpu"].setdefault("handoff_ledger", True)
-                self._prefill_cfg_path = write_cfg(pre_cfg)
+                self._prefill_cfg_path = write_cfg(
+                    derive_role_config(cfg, "prefill"))
         else:
             self._cfg_path = write_cfg(cfg)
         self._host_down = asyncio.Event()
@@ -529,10 +530,12 @@ class TpuNativeBackend(InferenceBackend):
         handoff = {"id": meta.get("id"), "p": int(meta.get("p", 0)),
                    "prompt_len": meta.get("prompt_len"),
                    "nbytes": len(frame),
+                   "blocks": int(meta.get("blocks", 0)),
+                   "shipped": int(meta.get("shipped", 0)),
                    "frame": base64.b64encode(frame).decode("ascii")}
         if "wire_s" in meta:
             handoff["wire_s"] = meta["wire_s"]
-        adopt = self._broker.adopt_op(handoff)
+        adopt = self._broker.adopt_op(handoff, member="decode")
         if adopt is None:
             return  # request already cancelled/failed — drop the frame
         try:
@@ -635,7 +638,13 @@ class TpuNativeBackend(InferenceBackend):
         from symmetry_tpu.engine.disagg.net import DecodeLink
         from symmetry_tpu.engine.disagg.pool import PoolRouter
 
-        self._pool = PoolRouter()
+        tpu = self._config.tpu
+        self._pool = PoolRouter(
+            heartbeat_s=(self._pool_cfg.heartbeat_s
+                         if self._pool_cfg.heartbeat_s > 0
+                         else self._heartbeat_s),
+            affinity_weight=float(
+                getattr(tpu, "pool_affinity_weight", 1.0)))
         self._pool_active = True
         members = [_DecodeMember(f"decode-{i}")
                    for i in range(self._pool_cfg.decode_count)]
@@ -921,6 +930,9 @@ class TpuNativeBackend(InferenceBackend):
                         with contextlib.suppress(ProcessLookupError):
                             m.proc.kill()  # reader EOF runs death path
                     continue
+                # Gossip rider first: update_gauges stamps the gossip-
+                # age gauge from the freshly-stored summary stamp.
+                self._pool.update_summary(m.id, msg.get("prefix_summary"))
                 self._pool.update_gauges(
                     m.id, queue_depth=msg.get("queue_depth"),
                     burn_rate=burn)
@@ -930,6 +942,8 @@ class TpuNativeBackend(InferenceBackend):
                         if isinstance(reply, dict) else None) or {}
                 if isinstance(host, dict) \
                         and host.get("queue_depth") is not None:
+                    self._pool.update_summary(
+                        member_id, host.get("prefix_summary"))
                     self._pool.update_gauges(
                         member_id, queue_depth=host["queue_depth"],
                         burn_rate=burn)
@@ -1003,18 +1017,71 @@ class TpuNativeBackend(InferenceBackend):
                 log.info(f"pool: re-placed {req_id} on {placed} "
                          f"after: {reason}")
 
+    def _routing_digests(self, submit: dict) -> list[str] | None:
+        """Causal block digests of a submit's prompt, computed
+        provider-side with a routing tokenizer — the request half of
+        the cache-affinity match (the member half is the gossiped
+        summary). Tokenization here is deterministic and identical to
+        the hosts' (same tokenizer files, pure chat template), so the
+        digests are exactly the ones a member's radix tree gossips.
+        None (load-only placement) on ANY failure: a routing hint must
+        never take down a submit."""
+        if self._route_tok is False:
+            return None
+        tpu = self._config.tpu
+        if float(getattr(tpu, "pool_affinity_weight", 1.0)) <= 0.0:
+            return None
+        if self._route_tok is None:
+            try:
+                from symmetry_tpu.engine.tokenizer import get_tokenizer
+
+                self._route_tok = get_tokenizer(
+                    getattr(tpu, "tokenizer_path", None))
+            except Exception as exc:  # noqa: BLE001 — degrade, never wedge
+                log.warning(f"pool: routing tokenizer unavailable "
+                            f"({exc}); placement stays load-only")
+                self._route_tok = False
+                return None
+        try:
+            from symmetry_tpu.engine.prefix_cache import block_digests
+
+            ids = self._route_tok.apply_chat_template(
+                submit.get("messages") or [])
+            bs = int(getattr(tpu, "prefix_block_tokens", 16) or 16)
+            # Same whole-block, suffix-keeps-one-token cap as the
+            # engine's lookup: affinity should chase reachable KV.
+            p = bs * ((len(ids) - 1) // bs)
+            if p <= 0:
+                return None
+            return block_digests(ids, p, bs)
+        except Exception:  # noqa: BLE001 — hint only
+            return None
+
     async def _pool_send_submit(self, req_id: str, submit: dict,
                                 *, replacement: bool = False
                                 ) -> str | None:
         """Place + send one submit over a healthy member's link; walks
         the member set on send failure (each failed member excluded for
         this request — its own down path re-places the REST of its
-        load). None when no healthy member accepted it."""
+        load). None when no healthy member accepted it. Placement is
+        cache-affine (the request's block digests vs each member's
+        gossiped summary), and the submit is stamped with the planned
+        decode member + its ledger epoch so the prefill host keys its
+        shipped-block ledger by the handoff's actual destination."""
         from symmetry_tpu.engine.disagg.net import LinkError
 
+        digests = self._routing_digests(submit)
+        planned = self._pool.plan_decode(req_id, digests)
+        if planned is not None:
+            submit["ledger"] = {
+                "member": planned,
+                "epoch": self._pool.ledger_epoch(planned)}
+        else:
+            submit.pop("ledger", None)
         exclude: set[str] = set()
         while True:
-            member_id = self._pool.place(req_id, exclude=exclude)
+            member_id = self._pool.place(req_id, digests=digests,
+                                         exclude=exclude)
             if member_id is None:
                 return None
             link = self._plinks.get(member_id)
@@ -1043,14 +1110,7 @@ class TpuNativeBackend(InferenceBackend):
         import base64
 
         req_id = str(meta.get("id", ""))
-        handoff = {"id": meta.get("id"), "p": int(meta.get("p", 0)),
-                   "prompt_len": meta.get("prompt_len"),
-                   "nbytes": len(frame),
-                   "frame": base64.b64encode(frame).decode("ascii")}
-        if "wire_s" in meta:
-            handoff["wire_s"] = meta["wire_s"]
-        adopt = self._broker.adopt_op(handoff)
-        if adopt is None:
+        if not self._broker.is_pending(req_id):
             # No pending migration: cancelled/failed — or a STALE
             # duplicate from a member that kept prefilling through a
             # link blip while the request was re-placed (and possibly
@@ -1059,12 +1119,27 @@ class TpuNativeBackend(InferenceBackend):
             if self._pool.assigned_to(req_id) == member_id:
                 self._pool.release(req_id)
             return
+        # Route the decode member BEFORE adopting so the broker can
+        # book the frame into that member's ledger; the event loop is
+        # single-threaded between the is_pending check and adopt_op, so
+        # the pending entry cannot vanish underneath us.
         self._pool_submits.pop(req_id, None)
         decode_id = self._pool.route_decode(req_id)
         m = self._decode_members.get(decode_id) if decode_id else None
         if m is None or not m.alive:
             self._shed_request(
                 req_id, "no decode member available for adoption")
+            return
+        handoff = {"id": meta.get("id"), "p": int(meta.get("p", 0)),
+                   "prompt_len": meta.get("prompt_len"),
+                   "nbytes": len(frame),
+                   "blocks": int(meta.get("blocks", 0)),
+                   "shipped": int(meta.get("shipped", 0)),
+                   "frame": base64.b64encode(frame).decode("ascii")}
+        if "wire_s" in meta:
+            handoff["wire_s"] = meta["wire_s"]
+        adopt = self._broker.adopt_op(handoff, member=decode_id)
+        if adopt is None:
             return
         try:
             await self._host_send(adopt, proc=m.proc)
@@ -2272,6 +2347,11 @@ class TpuNativeBackend(InferenceBackend):
                                 retry_after_s=(
                                     self._link_cfg.reconnect_base_s * 2))
                     elif self._net_mode:
+                        # Stamp the decode-side ledger epoch: a decode
+                        # host respawn dropped its KV, so the prefill
+                        # host must forget which blocks it shipped.
+                        submit["ledger"] = {"member": "decode",
+                                            "epoch": self._restarts}
                         await self._link.submit(submit)
                     else:
                         await self._host_send(submit,

@@ -74,18 +74,33 @@ class TpuConfig:
     # and a longer re-prefilled tail on handoff. Must divide every
     # prefill bucket (enforced only when the cache is enabled).
     prefix_block_tokens: int = 16
+    # Radix-cache summary gossip (pool routing): how many hot-path
+    # block digests each engine's cache summary carries on its stats
+    # probe — the PoolRouter's cache-affinity signal. 0 disables the
+    # rider (members gossip nothing; placement is load-only). ~32 B of
+    # wire per digest per heartbeat per member.
+    prefix_gossip_blocks: int = 64
+    # Minimum seconds between summary recomputes on the engine host —
+    # per-member heartbeat probes inside this window share one cached
+    # walk. Staleness decay in the router is governed by the POOL
+    # heartbeat_s, not this knob.
+    prefix_gossip_s: float = 2.0
+    # Cache-affinity weight in pool placement: predicted-hit blocks
+    # (from gossiped summaries, staleness-decayed) count this much
+    # against load (queue slots) when scoring members — at 1.0 one
+    # fresh predicted hit block outbids one queued request. 0 restores
+    # pure least-loaded placement.
+    pool_affinity_weight: float = 1.0
     # Prefill-role only: skip handoff-frame payloads for blocks this
-    # host already shipped (the receiver adopts them by reference from
-    # its radix tree). SOUND ONLY when the sender and its single decode
-    # peer live and die together — the tpu_native local pair sets it
-    # (the supervisor respawns both hosts as one unit, so the ledger
-    # can never outlive the receiver's tree). Pool mode (N decode
-    # members — a skipped block may be resident on a DIFFERENT member)
-    # and network mode (the decode host can respawn while the remote
-    # prefill node's ledger survives) leave it off: correctness would
-    # hold either way (the receiver adopts the longest covered prefix),
-    # but a stale ledger silently degrades KV reuse to full re-prefill.
-    handoff_ledger: bool = False
+    # host already shipped to the destination member (the receiver
+    # adopts them by reference from its radix tree). The ledger is
+    # per-destination and epoch-invalidated: pool routing stamps every
+    # submit with the planned decode member and its ledger epoch
+    # (bumped on member loss), so a respawned member's empty cache
+    # drops its ledger instead of silently degrading every warm
+    # handoff to a full re-prefill. Correctness never depends on it —
+    # the receiver adopts the longest covered prefix either way.
+    handoff_ledger: bool = True
     # Speculative decoding (engine/spec/): n-gram prompt-lookup drafting
     # with batched block verification. None/False disables it entirely —
     # the decode path and warmup compile set are then byte-identical to a

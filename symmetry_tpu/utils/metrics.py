@@ -166,6 +166,14 @@ class MetricName:
     POOL_PLACEMENTS = "sym_pool_placements_total"            # {tier,node}
     POOL_REPLACEMENTS = "sym_pool_replacements_total"
     POOL_DRAINS = "sym_pool_drains_total"
+    # Cache-aware placement (gossiped radix summaries as the signal):
+    # predicted hit depth actually banked per placement, placements
+    # split by whether affinity changed the answer, and the age of each
+    # member's last gossiped summary (the staleness-decay input).
+    POOL_PREDICTED_HIT = "sym_pool_predicted_hit_blocks"     # {tier,node}
+    POOL_AFFINITY_PLACEMENTS = (
+        "sym_pool_affinity_placements_total")                # {outcome}
+    POOL_GOSSIP_AGE = "sym_pool_gossip_age_seconds"          # {tier,node}
 
     # --- server registry (server/registry.py)
     SERVER_PROVIDERS_ONLINE = "sym_server_providers_online"

@@ -206,9 +206,11 @@ class TestBroker:
         # tier faults land TOP-LEVEL on that host only
         assert pre["faults"] == {"disagg.handoff": "crash"}
         assert "faults" not in dec
-        # decode tier gets a prefix-cache budget by default
+        # BOTH tiers get a prefix-cache budget by default: decode for
+        # adopt-by-reference, prefill so its radix tree has something
+        # to gossip for cache-affine pool routing
         assert dec["tpu"]["prefix_cache_mb"] == DEFAULT_DECODE_PREFIX_MB
-        assert "prefix_cache_mb" not in pre["tpu"]
+        assert pre["tpu"]["prefix_cache_mb"] == DEFAULT_DECODE_PREFIX_MB
         # neither derived config keeps the disagg mapping (a tier host
         # must not recurse)
         assert "disagg" not in pre["tpu"] and "disagg" not in dec["tpu"]
@@ -542,7 +544,7 @@ class TestDisaggIdentity:
 @pytest.mark.slow
 class TestBackendDisaggIdentity:
     @staticmethod
-    def _cfg(role, disagg_net=None):
+    def _cfg(role, disagg_net=None, tpu_extra=None):
         from symmetry_tpu.provider.config import ConfigManager
 
         return ConfigManager(config={
@@ -553,6 +555,7 @@ class TestBackendDisaggIdentity:
                     "max_batch_size": 4, "max_seq_len": 128,
                     "prefill_buckets": [32, 64], "prefill_chunk": 16,
                     "engine_isolation": "process", "role": role,
+                    **(tpu_extra or {}),
                     **({"disagg": disagg_net} if disagg_net else {})},
         })
 
@@ -642,6 +645,84 @@ class TestBackendDisaggIdentity:
         for member_id in ("prefill-0", "prefill-1",
                           "decode-0", "decode-1"):
             assert pb["members"][member_id]["placements"] == 1, pb
+
+    # A two-turn session: turn 2 extends turn 1, so after gossip the
+    # prefill member that served turn 1 advertises the shared prefix.
+    SESSION = ["tell me about disagg serving",
+               "tell me about disagg serving and why it helps"]
+
+    @classmethod
+    def _collect_session(cls, role, disagg_net=None, tpu_extra=None,
+                         settle_s=0.0):
+        import asyncio
+
+        from symmetry_tpu.provider.backends.base import InferenceRequest
+        from symmetry_tpu.provider.backends.tpu_native import (
+            TpuNativeBackend)
+
+        async def go():
+            backend = TpuNativeBackend(
+                cls._cfg(role, disagg_net, tpu_extra))
+            await backend.start()
+            try:
+                out = []
+                for content in cls.SESSION:
+                    text = []
+                    async for chunk in backend.stream(InferenceRequest(
+                            messages=[{"role": "user",
+                                       "content": content}],
+                            max_tokens=8, temperature=0.0)):
+                        if chunk.text:
+                            text.append(chunk.text)
+                    out.append("".join(text))
+                    if settle_s:
+                        # let the heartbeat carry the gossip rider
+                        await asyncio.sleep(settle_s)
+                stats = await backend.engine_stats()
+                return out, stats
+            finally:
+                await backend.stop()
+
+        return asyncio.new_event_loop().run_until_complete(
+            asyncio.wait_for(go(), 600))
+
+    def test_pool_2x2_affinity_token_identity(self):
+        """Affinity changes PLACEMENT, never tokens: the same two-turn
+        greedy session through a 2×2 pool is token-identical to
+        unified whether cache-affine routing is on or off — and with
+        it on, turn 2 is provably routed by predicted hit while the
+        weight-0 control stays load-only."""
+        unified, _ = self._collect_session("unified")
+        # settle 1s between turns in BOTH pool runs so the only
+        # difference is the affinity weight, not gossip timing
+        on, stats_on = self._collect_session(
+            "disagg",
+            disagg_net={"peer": "mem://pool-affinity-on",
+                        "pool": {"prefill": 2, "decode": 2,
+                                 "heartbeat_s": 0.3}},
+            tpu_extra={"prefix_gossip_s": 0.1,
+                       "pool_affinity_weight": 1.0},
+            settle_s=1.0)
+        off, stats_off = self._collect_session(
+            "disagg",
+            disagg_net={"peer": "mem://pool-affinity-off",
+                        "pool": {"prefill": 2, "decode": 2,
+                                 "heartbeat_s": 0.3}},
+            tpu_extra={"prefix_gossip_s": 0.1,
+                       "pool_affinity_weight": 0.0},
+            settle_s=1.0)
+        assert on == unified, \
+            "greedy session with affinity routing diverged from unified"
+        assert off == unified, \
+            "greedy session with affinity disabled diverged from unified"
+        pool_on = (stats_on.get("disagg") or {}).get("pool") or {}
+        pool_off = (stats_off.get("disagg") or {}).get("pool") or {}
+        assert pool_on.get("affinity_hit", 0) >= 1, pool_on
+        warm = [mid for mid, m in (pool_on.get("members") or {}).items()
+                if m.get("hit_blocks", 0) > 0]
+        assert warm, pool_on
+        assert pool_off.get("affinity_hit", 0) == 0, pool_off
+        assert pool_off.get("affinity_load_only", 0) >= 1, pool_off
 
     def test_network_mode_tcp_greedy_identity(self):
         """THE cross-machine acceptance contract: both tiers as real
@@ -1319,6 +1400,228 @@ class TestPoolRouter:
         m = st["members"]["p0"]
         assert {"tier", "state", "in_flight", "placements",
                 "queue_depth"} <= set(m)
+
+
+def _affinity_router(t, *, m_prefill=2, n_decode=2, heartbeat_s=1.0,
+                     weight=1.0):
+    """healthy_pool with an injectable clock (`t` is a one-element
+    list) so staleness decay and gauge-age tests control time."""
+    r = PoolRouter(heartbeat_s=heartbeat_s, affinity_weight=weight,
+                   clock=lambda: t[0])
+    for i in range(m_prefill):
+        r.add_member(f"p{i}", "prefill")
+        r.mark_healthy(f"p{i}")
+    for i in range(n_decode):
+        r.add_member(f"d{i}", "decode")
+        r.mark_healthy(f"d{i}")
+    return r
+
+
+def _blocks(n, bs=16, base=0):
+    from symmetry_tpu.engine.prefix_cache import block_digests
+
+    return block_digests([base + i for i in range(n * bs)], n * bs, bs)
+
+
+class TestPoolAffinity:
+    def test_predicted_hit_outbids_load(self):
+        t = [0.0]
+        r = _affinity_router(t)
+        digests = _blocks(4)
+        r.update_gauges("p0", queue_depth=0.0)
+        r.update_gauges("p1", queue_depth=3.0)
+        r.update_summary("p1", {"block_tokens": 16, "digests": digests})
+        # p1 carries 3 queue slots but a fresh 4-block predicted hit —
+        # at weight 1 the warm member wins.
+        assert r.place("s1", digests=digests) == "p1"
+        assert r.counters["affinity_hit"] == 1
+        assert r.get("p1").hit_blocks == 4
+        # no digests → pure load (p0 is empty)
+        assert r.place("s2") == "p0"
+        assert r.counters["affinity_load_only"] == 1
+
+    def test_weight_zero_restores_load_only(self):
+        t = [0.0]
+        r = _affinity_router(t, weight=0.0)
+        digests = _blocks(4)
+        r.update_gauges("p1", queue_depth=3.0)
+        r.update_summary("p1", {"block_tokens": 16, "digests": digests})
+        assert r.place("s1", digests=digests) == "p0"
+        assert r.counters["affinity_load_only"] == 1
+        assert r.counters["affinity_hit"] == 0
+
+    def test_hit_must_be_contiguous_from_block_zero(self):
+        t = [0.0]
+        r = _affinity_router(t)
+        digests = _blocks(4)
+        r.update_gauges("p1", queue_depth=0.0)
+        # p1 holds only the TAIL blocks: digest 0 is missing, so the
+        # radix tree can serve none of it — predicted hit 0, cold.
+        r.update_summary("p1", {"block_tokens": 16,
+                                "digests": digests[1:]})
+        assert r.predicted_hit(r.get("p1"), digests) == 0
+        r.place("s1", digests=digests)
+        assert r.counters["affinity_cold"] == 1
+
+    def test_summary_staleness_decays_to_load_only(self):
+        t = [0.0]
+        r = _affinity_router(t, heartbeat_s=1.0)
+        digests = _blocks(2)
+        r.update_gauges("p0", queue_depth=1.0)
+        r.update_summary("p0", {"block_tokens": 16, "digests": digests})
+        r.update_gauges("p1", queue_depth=0.0)
+        # fresh: p0's 2-block hit (decay 1.0) outbids one queue slot
+        assert r.place("s1", digests=digests) == "p0"
+        r.note_done("s1")
+        # summary ages 10 heartbeats (gauges kept fresh): decay
+        # 0.5^(10/2) ≈ 0.03 → hit term ~0.06 < 1 queue slot → p1 wins.
+        t[0] = 10.0
+        r.update_gauges("p0", queue_depth=1.0)
+        r.update_gauges("p1", queue_depth=0.0)
+        assert r.place("s2", digests=digests) == "p1"
+
+    def test_stale_gauges_exclude_member_from_affinity(self):
+        """Satellite-fix pin: a member that stops heartbeating keeps
+        its last summary, but once its gauges are older than two
+        heartbeat periods the summary describes a cache we can no
+        longer see — affinity scoring must ignore it."""
+        t = [0.0]
+        r = _affinity_router(t, heartbeat_s=1.0)
+        digests = _blocks(3)
+        r.update_gauges("p0", queue_depth=0.0)
+        r.update_summary("p0", {"block_tokens": 16, "digests": digests})
+        assert r.predicted_hit(r.get("p0"), digests) == 3
+        t[0] = 2.5  # > 2 × heartbeat since the last gauge stamp
+        assert r.predicted_hit(r.get("p0"), digests) == 0
+        r.place("s1", digests=digests)
+        assert r.counters["affinity_hit"] == 0
+        assert r.counters["affinity_cold"] == 1
+
+    def test_rejoin_resets_gauges_and_summary(self):
+        """Satellite-fix pin: a rejoined member is a NEW process — the
+        pre-loss gauges and summary must not be trusted forever. Until
+        its first fresh heartbeat it scores load-only."""
+        t = [0.0]
+        r = _affinity_router(t)
+        digests = _blocks(2)
+        r.update_gauges("p0", queue_depth=9.0)
+        r.update_summary("p0", {"block_tokens": 16, "digests": digests})
+        r.on_lost("p0")
+        r.mark_healthy("p0")
+        m = r.get("p0")
+        assert m.summary is None and m.summary_at is None
+        assert m.gauges_at is None and m.queue_depth == 0.0
+        assert r.predicted_hit(m, digests) == 0
+
+    def test_member_loss_bumps_ledger_epoch_and_drops_summary(self):
+        t = [0.0]
+        r = _affinity_router(t)
+        digests = _blocks(2)
+        r.update_gauges("d0", queue_depth=0.0)
+        r.update_summary("d0", {"block_tokens": 16, "digests": digests})
+        assert r.ledger_epoch("d0") == 0
+        r.on_lost("d0")
+        assert r.ledger_epoch("d0") == 1
+        assert r.get("d0").summary is None
+        # idempotent loss: no double bump
+        r.on_lost("d0")
+        assert r.ledger_epoch("d0") == 1
+        # rejoin serves again but the epoch stays advanced — the
+        # prefill tier must drop every pre-loss ledger entry.
+        r.mark_healthy("d0")
+        assert r.ledger_epoch("d0") == 1
+
+    def test_gossip_rider_round_trip(self):
+        """RadixIndex.summary() → update_summary → predicted_hit: the
+        digests a member's real radix tree gossips are exactly the ones
+        a grown session's routing digests match against."""
+        from symmetry_tpu.engine.prefix_cache import (
+            BlockPool, RadixIndex, block_digests)
+
+        pool = BlockPool(64, 16, 256)
+        idx = RadixIndex(pool)
+        session = list(range(48))  # 3 whole blocks
+        plan = idx.plan_insert(session)
+        assert plan is not None
+        plan.commit()
+        s = idx.summary(64)
+        assert s is not None and s["block_tokens"] == 16
+        t = [0.0]
+        r = _affinity_router(t, m_prefill=2)
+        r.update_gauges("p0", queue_depth=0.0)
+        r.update_summary("p0", s)
+        # the session grown by another turn still matches its cached
+        # whole blocks contiguously
+        grown = session + list(range(100, 120))
+        bs = s["block_tokens"]
+        p = (len(grown) // bs) * bs
+        req = block_digests(grown, p, bs)
+        assert r.predicted_hit(r.get("p0"), req) == 3
+        # an unrelated session shares nothing
+        other = block_digests(list(range(500, 548)), 48, bs)
+        assert r.predicted_hit(r.get("p0"), other) == 0
+
+    def test_summary_cap_and_empty_walks(self):
+        from symmetry_tpu.engine.prefix_cache import BlockPool, RadixIndex
+
+        pool = BlockPool(64, 16, 256)
+        idx = RadixIndex(pool)
+        assert idx.summary(64) is None  # empty tree gossips nothing
+        plan = idx.plan_insert(list(range(64)))
+        plan.commit()
+        assert idx.summary(0) is None  # rider disabled
+        s = idx.summary(2)
+        assert len(s["digests"]) == 2  # bounded payload
+
+    def test_planned_decode_consumed_and_survives_loss(self):
+        t = [0.0]
+        r = _affinity_router(t)
+        planned = r.plan_decode("s1")
+        assert planned in ("d0", "d1")
+        assert r.planned_decode("s1") == planned
+        r.place("s1")
+        # the handoff routes to the member the ledger was keyed for
+        assert r.route_decode("s1") == planned
+        assert r.planned_decode("s1") is None  # plan consumed
+        # a plan whose member dies re-picks the survivor
+        planned2 = r.plan_decode("s2")
+        r.place("s2")
+        r.on_lost(planned2)
+        got = r.route_decode("s2")
+        assert got is not None and got != planned2
+
+    def test_outstanding_plans_count_as_load(self):
+        """Concurrent submits must spread: a plan books load the
+        member WILL carry, or every burst would pile onto one member
+        by id tie-break."""
+        t = [0.0]
+        r = _affinity_router(t)
+        a = r.plan_decode("s1")
+        b = r.plan_decode("s2")
+        assert {a, b} == {"d0", "d1"}
+
+    def test_empty_gossip_beat_keeps_old_summary_aging(self):
+        t = [0.0]
+        r = _affinity_router(t)
+        digests = _blocks(2)
+        r.update_gauges("p0", queue_depth=0.0)
+        r.update_summary("p0", {"block_tokens": 16, "digests": digests})
+        # a beat with no rider (old binary / cache empty) must not
+        # flap the signal off — the stored summary keeps aging instead
+        r.update_summary("p0", None)
+        r.update_summary("p0", {"block_tokens": 16, "digests": []})
+        assert r.get("p0").summary is not None
+        assert r.predicted_hit(r.get("p0"), digests) == 2
+
+    def test_pool_of_one_affinity_is_pair_semantics(self):
+        t = [0.0]
+        r = _affinity_router(t, m_prefill=1, n_decode=1)
+        digests = _blocks(2)
+        # no summary yet: placement still works (cold), ledger epoch 0
+        assert r.place("s1", digests=digests) == "p0"
+        assert r.plan_decode("s2", digests) == "d0"
+        assert r.ledger_epoch("d0") == 0
+        assert r.counters["affinity_cold"] == 1
 
 
 class TestPoolConfig:

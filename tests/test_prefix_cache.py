@@ -676,3 +676,52 @@ class TestLifecycleLeakRegressions:
         pool = engine.prefix_index.pool
         # plan aborted: no pins held, every allocated block returned
         assert pool.pinned == 0 and pool.in_use == 0
+
+
+class TestGossipSummary:
+    """Engine-level contract for the pool-gossip rider cadence."""
+
+    def test_gossip_s_zero_means_always_fresh(self, setup):
+        cfg, params = setup
+        engine = InferenceEngine(
+            cfg, params, ByteTokenizer(), max_slots=4, max_seq_len=64,
+            prefill_buckets=(16, 32), cache_dtype=jnp.float32,
+            prefill_chunk=8, prefix_cache_bytes=16 * 2**20,
+            prefix_block_tokens=8, prefix_gossip_blocks=8,
+            prefix_gossip_s=0.0)
+        # empty tree gossips nothing — and an explicit 0.0 cadence must
+        # not CACHE that None (a heartbeat probe right after the first
+        # insertion has to see the summary, not a stale empty walk)
+        assert engine.prefix_cache_summary() is None
+        plan = engine.prefix_index.plan_insert(list(range(16)))
+        assert plan is not None
+        plan.commit()
+        s = engine.prefix_cache_summary()
+        assert s is not None and s["block_tokens"] == 8
+        assert len(s["digests"]) == 2
+
+    def test_gossip_s_caches_the_walk(self, setup):
+        cfg, params = setup
+        engine = InferenceEngine(
+            cfg, params, ByteTokenizer(), max_slots=4, max_seq_len=64,
+            prefill_buckets=(16, 32), cache_dtype=jnp.float32,
+            prefill_chunk=8, prefix_cache_bytes=16 * 2**20,
+            prefix_block_tokens=8, prefix_gossip_blocks=8,
+            prefix_gossip_s=60.0)
+        assert engine.prefix_cache_summary() is None
+        plan = engine.prefix_index.plan_insert(list(range(16)))
+        plan.commit()
+        # within the cadence window the cached (empty) walk is reused
+        assert engine.prefix_cache_summary() is None
+
+    def test_gossip_blocks_zero_disables_rider(self, setup):
+        cfg, params = setup
+        engine = InferenceEngine(
+            cfg, params, ByteTokenizer(), max_slots=4, max_seq_len=64,
+            prefill_buckets=(16, 32), cache_dtype=jnp.float32,
+            prefill_chunk=8, prefix_cache_bytes=16 * 2**20,
+            prefix_block_tokens=8, prefix_gossip_blocks=0)
+        plan = engine.prefix_index.plan_insert(list(range(16)))
+        plan.commit()
+        # a populated tree still gossips nothing when the rider is off
+        assert engine.prefix_cache_summary() is None

@@ -62,6 +62,11 @@ POLICIES: list[tuple[re.Pattern, str, float]] = [
     (re.compile(r"per_slot_tok_s$"), "higher", 0.03),
     (re.compile(r"tok_s_(plain|speculative)$"), "higher", 0.05),
     (re.compile(r"(^|\.)speedup$"), "higher", 0.05),
+    # Multi-turn cache-affinity payoff: turn-1 TTFT (cold prefill) over
+    # turn-2+ TTFT (session lands on a member holding its radix
+    # prefix). The pool-routing headline — a regression here means
+    # follow-up turns stopped finding their cache.
+    (re.compile(r"turn2plus_speedup$"), "higher", 0.05),
     (re.compile(r"weight_stream_gbs$"), "higher", 0.05),
     (re.compile(r"acceptance_rate$"), "higher", 0.10),
     (re.compile(r"ttft[a-z0-9_]*_p\d+(_[a-z]+)?_s$"), "lower", 0.10),

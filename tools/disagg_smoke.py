@@ -36,6 +36,15 @@ them — and the smoke asserts:
   structured-retryable, reconnect with backoff, and complete the retry
   on the re-established link.
 
+  phase 4 (pool churn): a 2×1 elastic pool loses a prefill node under
+  load; everything completes via retryable shed + re-placement.
+
+  phase 5 (cache affinity): a 2×2 pool serves a multi-turn session —
+  turn 2 must affinity-route back to the member whose gossiped radix
+  summary covers the session prefix (counter asserted), the per-member
+  shipped-block ledger must make the warm handoff partial, and killing
+  the warm member must degrade to a clean cold re-place.
+
 Two modes for phases 1–2, same contracts:
   - full path (default): client → server → provider over the in-memory
     transport, recovery via client failover (ChatRestart sentinel);
@@ -498,6 +507,133 @@ async def run_pool_chaos() -> int:
     return 0
 
 
+async def run_pool_affinity() -> int:
+    """Phase 5: cache-affine session routing across a 2×2 pool. A
+    session's turn 1 lands cold somewhere; its gossiped radix summary
+    then makes turn 2 (same conversation, resubmitted full prefix)
+    affinity-route back to the member holding the cache (counter
+    asserted), and the per-member shipped-block ledger makes the warm
+    handoff ship fewer bytes than the cold one. Killing the warm member
+    must drop it to a clean cold re-place on the survivor — never an
+    error, never a stale-ledger skip against the respawn's empty cache."""
+    from symmetry_tpu.provider.backends.base import (
+        BackendRestartingError, InferenceRequest)
+    from symmetry_tpu.provider.backends.tpu_native import TpuNativeBackend
+    from symmetry_tpu.provider.config import ConfigManager
+
+    cfg = provider_config_dict()
+    cfg["name"] = "disagg-affinity-prov"
+    # 2×2 pool, fast heartbeat so summaries gossip between turns; the
+    # engine-side summary cache refreshes faster than the heartbeat
+    # asks. No fault seams in this phase.
+    cfg["tpu"]["disagg"] = {
+        "peer": "mem://pool-affinity", "reconnect_base_s": 0.2,
+        "pool": {"prefill": 2, "decode": 2, "heartbeat_s": 0.3},
+    }
+    cfg["tpu"]["prefix_gossip_s"] = 0.1
+
+    async def collect(backend, content):
+        text = []
+        async for chunk in backend.stream(InferenceRequest(
+                messages=[{"role": "user", "content": content}],
+                max_tokens=8, temperature=0.0)):
+            if chunk.text:
+                text.append(chunk.text)
+        return "".join(text)
+
+    async def collect_retrying(backend, content):
+        for _ in range(200):
+            try:
+                return await collect(backend, content)
+            except BackendRestartingError:
+                await asyncio.sleep(0.25)
+        raise AssertionError(f"{content!r} never completed")
+
+    async def pool_stats(backend):
+        stats = await backend.engine_stats()
+        return stats, (stats.get("disagg") or {}).get("pool") or {}
+
+    backend = TpuNativeBackend(ConfigManager(config=cfg))
+    try:
+        await backend.start()
+
+        # turn 1: cold — no summaries gossiped yet, so the placement
+        # books a non-hit outcome and ships the full frame.
+        text1 = await collect(backend, PROMPT)
+        assert text1, "affinity phase turn 1 streamed no text"
+        stats, pool = await pool_stats(backend)
+        assert pool.get("affinity_hit", 0) == 0, pool
+        assert (pool.get("affinity_cold", 0)
+                + pool.get("affinity_load_only", 0)) >= 1, pool
+        dg1 = stats.get("disagg") or {}
+        per1 = (dg1.get("per_member") or {})
+
+        # let the gossip land: a few heartbeats carry the prefill
+        # members' radix summaries (and the decode members') back to
+        # the router.
+        for _ in range(40):
+            _, pool = await pool_stats(backend)
+            if any((m.get("summary_digests") or 0) > 0
+                   for m in (pool.get("members") or {}).values()):
+                break
+            await asyncio.sleep(0.15)
+        members = pool.get("members") or {}
+        assert any((m.get("summary_digests") or 0) > 0
+                   for m in members.values()), \
+            f"no radix summary ever gossiped: {members}"
+
+        # turn 2: the same conversation grown by one exchange — the
+        # shared prefix must pull it back to the warm member.
+        text2 = await collect(backend, PROMPT + " and why it helps")
+        assert text2, "affinity phase turn 2 streamed no text"
+        stats, pool = await pool_stats(backend)
+        assert pool.get("affinity_hit", 0) >= 1, \
+            f"turn 2 was not affinity-routed: {pool}"
+        members = pool.get("members") or {}
+        warm = [mid for mid, m in members.items()
+                if m.get("tier") == "prefill" and m.get("hit_blocks", 0) > 0]
+        assert warm, f"no prefill member banked predicted hits: {members}"
+        # per-member ledger: the decode member the warm handoff reached
+        # shipped fewer blocks than the frame covers (the cold turn
+        # shipped everything).
+        dg2 = stats.get("disagg") or {}
+        per2 = dg2.get("per_member") or {}
+        warm_members = [
+            mid for mid, led in per2.items()
+            if (led.get("warm_frames", 0)
+                > (per1.get(mid) or {}).get("warm_frames", 0))]
+        assert warm_members, \
+            f"no per-member warm handoff: before={per1} after={per2}"
+
+        # kill the warm prefill member: the session must drop to a cold
+        # re-place on the survivor — completed stream, no adopt errors,
+        # and the loss accounted.
+        warm_idx = int(warm[0].rsplit("-", 1)[1])
+        await backend._inline_nodes[warm_idx].kill()
+        # Same session prompt re-asked: its warm member is gone, so the
+        # digests match nothing placeable — a cold re-place, not a
+        # stale-affinity pull toward the corpse.
+        text3 = await collect_retrying(backend,
+                                       PROMPT + " and why it helps")
+        assert text3, "post-kill turn streamed no text"
+        stats, pool = await pool_stats(backend)
+        members = pool.get("members") or {}
+        assert members.get(warm[0], {}).get("state") == "lost", members
+        assert pool.get("losses", 0) >= 1, pool
+        ad = stats.get("adopt") or {}
+        assert ad.get("errors", 0) == 0, \
+            f"stale ledger/summary corrupted adoption: {ad}"
+        print(f"disagg smoke: affinity phase — turn 2 affinity-routed "
+              f"(hit placements={pool.get('affinity_hit')}, predicted "
+              f"blocks on {warm[0]}={members.get(warm[0], {}).get('hit_blocks')}), "
+              f"warm handoff ledger {warm_members} shipped partial "
+              f"frames; killed {warm[0]} → cold re-place completed "
+              f"{len(text3)} chars with zero adopt errors")
+    finally:
+        await backend.stop()
+    return 0
+
+
 def main() -> int:
     try:
         import cryptography  # noqa: F401 — wire-path dependency probe
@@ -517,6 +653,9 @@ def main() -> int:
         if rc == 0:
             rc = loop.run_until_complete(
                 asyncio.wait_for(run_pool_chaos(), 900))
+        if rc == 0:
+            rc = loop.run_until_complete(
+                asyncio.wait_for(run_pool_affinity(), 900))
         return rc
     except AssertionError as exc:
         print(f"disagg smoke FAILED: {exc}", file=sys.stderr)

@@ -47,8 +47,8 @@ from symmetry_tpu.utils.metrics import (  # noqa: E402
 
 COLUMNS = ("PROVIDER", "TIER", "TOK/S", "TTFT p50", "TTFT p99",
            "QUEUE", "INFL", "OCC", "GAP%", "DEPTH", "SHED", "RESUME",
-           "WASTED", "REUSED", "DUMPS", "LINK", "STATE", "SHARE")
-WIDTHS = (22, 10, 9, 9, 9, 7, 6, 5, 5, 5, 7, 7, 7, 7, 6, 6, 9, 6)
+           "WASTED", "REUSED", "DUMPS", "LINK", "STATE", "SHARE", "HIT")
+WIDTHS = (22, 10, 9, 9, 9, 7, 6, 5, 5, 5, 7, 7, 7, 7, 6, 6, 9, 6, 6)
 
 # sym_pool_member_state gauge encoding (engine/disagg/pool.py
 # STATE_CODES) rendered back to the membership lifecycle names.
@@ -141,9 +141,13 @@ def _tiers(fams: dict) -> list[str]:
 def _pool_rows(name: str, fams: dict) -> list[dict[str, Any]]:
     """One sub-row per elastic-pool member (disagg M×N providers):
     membership state (joining/healthy/draining/lost), link health
-    derived from it, and the member's share of its tier's lifetime
-    placements — the live answer to 'who is taking the traffic and who
-    just churned'."""
+    derived from it, the member's share of its tier's lifetime
+    placements, and HIT — the radix-cache blocks affinity placement
+    predicted it would reuse there (a warm pool shows HIT climbing on
+    the members sessions keep landing on; all-zero HIT under multi-turn
+    load means gossip isn't arriving) — the live answer to 'who is
+    taking the traffic, who just churned, and is the cache-affine
+    router actually finding warm members'."""
     fam = fams.get("sym_pool_member_state")
     if fam is None:
         return []
@@ -167,6 +171,14 @@ def _pool_rows(name: str, fams: dict) -> list[dict[str, Any]]:
         key = (lab.get("tier", ""), lab.get("node", ""))
         placements[key] = placements.get(key, 0.0) + s["value"]
         totals[key[0]] = totals.get(key[0], 0.0) + s["value"]
+    hits: dict[tuple[str, str], float] = {}
+    hfam = fams.get("sym_pool_predicted_hit_blocks") or {"series": []}
+    for s in hfam["series"]:
+        if s.get("suffix"):
+            continue
+        lab = s["labels"]
+        key = (lab.get("tier", ""), lab.get("node", ""))
+        hits[key] = hits.get(key, 0.0) + s["value"]
     rows: list[dict[str, Any]] = []
     for (tier, node), code in sorted(states.items()):
         total = totals.get(tier, 0.0)
@@ -183,6 +195,7 @@ def _pool_rows(name: str, fams: dict) -> list[dict[str, Any]]:
                      else "DOWN" if state == "lost" else "-"),
             "state": state,
             "share": f"{share * 100:.0f}%" if share is not None else None,
+            "hit": hits.get((tier, node)),
         })
     return rows
 
@@ -298,7 +311,8 @@ def render_table(rows: list[dict[str, Any]]) -> str:
                  r["shed"], r.get("resume"),
                  r.get("wasted"), r.get("reused"), r.get("dumps"),
                  r["link"] or "-",
-                 r.get("state") or "-", r.get("share") or "-")
+                 r.get("state") or "-", r.get("share") or "-",
+                 r.get("hit"))
         out.append("  ".join(_fmt_cell(c, w)
                              for c, w in zip(cells, WIDTHS)))
     return "\n".join(out)
