@@ -72,6 +72,68 @@ def stamp_result(result: dict, config: dict, mode: str) -> dict:
     return result
 
 
+def arrival_times(kind: str, n: int, *, duration_s: float,
+                  seed: int = 0) -> list[float]:
+    """Deterministic arrival-offset traces for the open-loop workloads
+    (`--arrival`): n send offsets in [0, duration_s), sorted. Seeded so
+    every arm of a comparison bench (run_autoscale) replays the SAME
+    trace — the topology is the only variable.
+
+    - poisson: homogeneous Poisson arrivals (exponential inter-arrival
+      gaps at rate n/duration), rescaled to span the window exactly.
+    - diurnal: inhomogeneous Poisson with a sinusoidal intensity —
+      trough at both ends, one peak mid-trace at ~19x the trough rate
+      (lam(t) = 1 - 0.9*cos(2*pi*t/D)); sampled by inverting the
+      closed-form cumulative intensity. The day-curve in miniature:
+      the shape where a static topology must provision for the peak.
+    - burst: 4 near-simultaneous waves evenly spaced through the
+      window — the thundering-herd shape the autoscale smoke uses.
+    """
+    import math
+    import random
+
+    if n <= 0:
+        return []
+    rnd = random.Random(seed)
+    if kind == "poisson":
+        rate = n / max(duration_s, 1e-9)
+        t, out = 0.0, []
+        for _ in range(n):
+            t += rnd.expovariate(rate)
+            out.append(t)
+        scale = duration_s / max(out[-1], 1e-9)
+        return [x * scale for x in out]
+    if kind == "diurnal":
+        amp = 0.9
+
+        def cum(t: float) -> float:  # normalized cumulative intensity
+            return (t - amp * duration_s / (2 * math.pi)
+                    * math.sin(2 * math.pi * t / duration_s)) / duration_s
+
+        out = []
+        for i in range(n):
+            # Stratified uniforms keep the realized trace close to the
+            # intensity curve even at small n.
+            u = (i + rnd.random()) / n
+            lo, hi = 0.0, duration_s
+            for _ in range(48):
+                mid = (lo + hi) / 2
+                if cum(mid) < u:
+                    lo = mid
+                else:
+                    hi = mid
+            out.append((lo + hi) / 2)
+        return sorted(out)
+    if kind == "burst":
+        waves = 4
+        per = -(-n // waves)
+        jitter = 0.02 * duration_s / waves
+        return sorted((i // per + 0.5) * duration_s / waves
+                      + rnd.random() * jitter for i in range(n))
+    raise ValueError(f"unknown arrival kind {kind!r} "
+                     f"(want poisson|diurnal|burst)")
+
+
 import contextlib
 
 
@@ -289,6 +351,9 @@ def run_e2e_client_worker() -> int:
                           or [spec["prompt"]] * len(indices))
     max_new: int = spec["max_new"]
     stagger_s: float = spec["stagger_s"]
+    # Open-loop arrival trace (--arrival): per-session send offsets
+    # aligned with `indices`, overriding the linear stagger.
+    arrivals: list[float] | None = spec.get("arrivals")
     # Wave-level request controls: the speculative bench runs a greedy
     # (temperature 0) workload, wave A opting every request out of
     # drafting ("speculative": false) so the same provider measures the
@@ -299,7 +364,7 @@ def run_e2e_client_worker() -> int:
     async def main() -> list[dict]:
         ready = asyncio.Event()
 
-        async def one_client(i: int, prompt: str) -> dict:
+        async def one_client(i: int, prompt: str, delay_s: float) -> dict:
             client = SymmetryClient(Identity.from_name(f"bench-cli-{i}"),
                                     TcpTransport())
             details = await client.request_provider(
@@ -311,7 +376,7 @@ def run_e2e_client_worker() -> int:
             await ready.wait()
             # Global arrival order by GLOBAL index — the shards together
             # reproduce exactly the single-process arrival pattern.
-            await asyncio.sleep(i * stagger_s)
+            await asyncio.sleep(delay_s)
             t_send = _time.monotonic()
             t_first = None
             chars = 0
@@ -341,7 +406,10 @@ def run_e2e_client_worker() -> int:
 
         sessions_up = [0]
         all_connected = asyncio.Event()
-        tasks = [asyncio.ensure_future(one_client(i, prompts[k]))
+        tasks = [asyncio.ensure_future(one_client(
+                     i, prompts[k],
+                     arrivals[k] if arrivals is not None
+                     else i * stagger_s))
                  for k, i in enumerate(indices)]
         await asyncio.wait_for(all_connected.wait(), timeout=120)
         print(f"READY {len(indices)}", flush=True)
@@ -545,6 +613,297 @@ def run_chaos(preset_name: str, *, clients: int, slots: int, max_new: int,
     return asyncio.new_event_loop().run_until_complete(main())
 
 
+def run_autoscale(preset_name: str, *, clients: int, slots: int,
+                  max_new: int, prompt_chars: int, max_seq: int,
+                  dtype_name: str, block: int, bucket: int,
+                  arrival: str, duration_s: float, seed: int,
+                  slo_ttft_s: float, slo_chunk_s: float,
+                  objective: float, static_shapes: tuple[str, ...],
+                  max_members: int) -> dict:
+    """The SLO-goodput autoscaling bench (`--autoscale`): replay ONE
+    seeded arrival trace (default: the diurnal curve — trough, peak,
+    trough) against an autoscaled pool and against each static MxN
+    control, all in one invocation. The autoscaled arm starts at the
+    FIRST static shape — the hand-picked constant under test — and the
+    controller right-sizes it against the trace (floor 1x1, ceiling
+    tpu.autoscale.max_members). Every arm reports SLO attainment
+    (client-side TTFT + inter-chunk gap vs the targets), CHIP-SECONDS
+    (sum of pool-member alive time over the TRACE window — boot warmup
+    is excluded so arms compare provisioning, not compile-cache state;
+    members spawned mid-trace pay their whole life, warmup included),
+    and the headline GOODPUT: SLO-attaining tokens per chip-second.
+
+    The autoscaled arm runs the real closed loop: a SloMonitor observes
+    the same traffic (the bench performs the provider's exact observe
+    calls — TTFT on first delta, inter-chunk gaps as they arrive), the
+    pool heartbeat feeds burn rates + queue gauges + symprof busy-time
+    into PoolAutoscaler (engine/disagg/autoscale.py), and its decisions
+    spawn/drain real members mid-trace. The verdict the capture
+    records: does the autoscaled arm meet the SLOs with fewer
+    chip-seconds than every static shape that also meets them?
+
+    Backend-direct like disagg_smoke's fallback mode: the fleet drives
+    TpuNativeBackend in this process (engine hosts are still real
+    subprocesses) with no server/client wire between — this measures
+    topology economics, not wire throughput, and stays runnable where
+    the `cryptography` network dependency is absent. Tokens are counted
+    as streamed chars (exact under the byte tokenizer every preset here
+    serves)."""
+    import asyncio
+    import os as _os
+    import time as _time
+    import uuid as _uuid
+
+    # Engine hosts (including members the controller spawns mid-trace)
+    # inherit this env: a shared compile cache keeps every warmup after
+    # the first a warm start, so arm order and mid-trace spawns measure
+    # provisioning economics, not XLA compile variance.
+    _os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                           "/tmp/symmetry-tpu-disagg-smoke-cache")
+    _os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                           "0.3")
+
+    from symmetry_tpu.provider.backends.base import (
+        BackendError,
+        BackendRestartingError,
+        InferenceRequest,
+    )
+    from symmetry_tpu.provider.backends.tpu_native import TpuNativeBackend
+    from symmetry_tpu.provider.config import ConfigManager
+    from symmetry_tpu.utils.metrics import SloMonitor
+
+    def pct(vals, p):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1,
+                              max(0, -(-p * len(vals) // 100) - 1))], 4)
+
+    # One trace, every arm: the topology is the only variable.
+    offsets = arrival_times(arrival, clients, duration_s=duration_s,
+                            seed=seed)
+    prompts = [(f"req {i:04d} " + "the day curve rises and falls "
+                * 64)[:prompt_chars] for i in range(clients)]
+
+    async def run_arm(label: str, m: int, n: int,
+                      autoscaled: bool) -> dict:
+        tag = _uuid.uuid4().hex[:8]
+        backend = TpuNativeBackend(ConfigManager(config={
+            "name": f"scale-{label}", "public": False,
+            "serverKey": "00" * 32,
+            "modelName": f"{preset_name}:scale",
+            "apiProvider": "tpu_native",
+            "dataCollectionEnabled": False,
+            "tpu": {"model_preset": preset_name, "dtype": dtype_name,
+                    "max_batch_size": slots, "max_seq_len": max_seq,
+                    "prefill_buckets": [bucket],
+                    "decode_block": block,
+                    "role": "disagg",
+                    # Bench-tightened hysteresis (production defaults
+                    # are 30s/60s): dwell and cooldown scale down with
+                    # the compressed diurnal day, but the spawn
+                    # thresholds go UP, not down — one arrival clump in
+                    # the 5s fast window must not trigger a mid-trace
+                    # boot (whose compile steals the serving cores and
+                    # manufactures the very breaches it reacts to).
+                    # spawn_burn 1.5 = sustained 1.5x the error budget;
+                    # spawn_queue scales with the slot count (2x slots,
+                    # sustained): a queue the member batches through in
+                    # a couple of waves is throughput, not pressure —
+                    # only a backlog beyond that, or measured burn, is
+                    # allowed to buy a mid-trace boot.
+                    **({"autoscale": {"max_members": max_members,
+                                      "dwell_s": 4.0,
+                                      "churn_cooldown_s": 15.0,
+                                      "spawn_burn": 1.5,
+                                      "spawn_queue": max(2.0 * slots,
+                                                         4.0),
+                                      "spawn_queue_ticks": 8,
+                                      "drain_load": 0.25,
+                                      "drain_ticks": 12}}
+                       if autoscaled else {}),
+                    "disagg": {"peer": f"mem://scale-{tag}",
+                               "reconnect_base_s": 0.05,
+                               "pool": {"prefill": m, "decode": n,
+                                        "heartbeat_s": 0.5}}},
+        }))
+        await backend.start()
+        # The REAL sensor: the burn-rate monitor the pool heartbeat
+        # hands to the controller, fed with the provider's exact
+        # observe calls by the fleet below.
+        monitor = SloMonitor({"ttft_s": slo_ttft_s,
+                              "inter_chunk_s": slo_chunk_s,
+                              "objective": objective,
+                              "fast_window_s": 5.0,
+                              "slow_window_s": 60.0})
+        backend.attach_slo_monitor(monitor)
+
+        per_req: list[dict] = []
+
+        async def one(i: int) -> None:
+            await asyncio.sleep(offsets[i])
+            row = {"completed": False, "tokens": 0,
+                   "ttft": None, "max_gap": None}
+            t_send = _time.monotonic()
+            t_prev = None
+            gaps: list[float] = []
+            attempts = 0
+            while True:
+                try:
+                    async for chunk in backend.stream(InferenceRequest(
+                            messages=[{"role": "user",
+                                       "content": prompts[i]}],
+                            max_tokens=max_new, temperature=0.7,
+                            seed=i)):
+                        if not chunk.text:
+                            continue
+                        now = _time.monotonic()
+                        if row["ttft"] is None:
+                            row["ttft"] = now - t_send
+                            monitor.observe("ttft", row["ttft"])
+                        elif t_prev is not None:
+                            gaps.append(now - t_prev)
+                            monitor.observe("inter_chunk", gaps[-1])
+                        t_prev = now
+                        row["tokens"] += len(chunk.text)
+                    row["completed"] = True
+                    row["max_gap"] = max(gaps, default=0.0)
+                    monitor.observe("e2e", _time.monotonic() - t_send)
+                except BackendRestartingError as exc:
+                    # The provider/client retry loop in miniature:
+                    # structured-retryable sheds (member churn,
+                    # respawn windows) back off and resend.
+                    attempts += 1
+                    if attempts <= 6:
+                        await asyncio.sleep(exc.retry_after_s or 0.25)
+                        continue
+                    row["error"] = f"shed x{attempts}: {exc}"
+                except BackendError as exc:
+                    row["error"] = str(exc)
+                break
+            per_req.append(row)
+
+        # Chip-second accounting starts HERE: boot warmup is excluded
+        # (it would measure arm order and compile-cache state, not
+        # provisioning), but members the controller spawns mid-trace
+        # pay their whole life — warmup included — inside the window.
+        stats0 = await backend.engine_stats()
+        chip0 = float(((stats0.get("disagg") or {}).get("pool") or {})
+                      .get("chip_seconds") or 0.0)
+        t0 = _time.monotonic()
+        await asyncio.gather(*[one(i) for i in range(clients)])
+        wall = _time.monotonic() - t0
+        stats = await backend.engine_stats()
+        pool = (stats.get("disagg") or {}).get("pool") or {}
+        await backend.stop()
+
+        def good(r: dict) -> bool:
+            return (r["completed"] and r["ttft"] is not None
+                    and r["ttft"] <= slo_ttft_s
+                    and (r["max_gap"] or 0.0) <= slo_chunk_s)
+
+        goods = [r for r in per_req if good(r)]
+        tokens = sum(r["tokens"] for r in per_req)
+        good_tokens = sum(r["tokens"] for r in goods)
+        chip_s = max(
+            float(pool.get("chip_seconds") or 0.0) - chip0, 0.0)
+        attainment = len(goods) / max(len(per_req), 1)
+        asc = pool.get("autoscale") or {}
+        ttfts = [r["ttft"] for r in per_req if r["ttft"] is not None]
+        gaps = [r["max_gap"] for r in per_req
+                if r["max_gap"] is not None]
+        return {
+            "shape": label, "autoscaled": autoscaled,
+            "requests": clients,
+            "completed": sum(r["completed"] for r in per_req),
+            "failed": sum(not r["completed"] for r in per_req),
+            "wall_s": round(wall, 2),
+            "tokens": tokens, "good_tokens": good_tokens,
+            "slo_attainment": round(attainment, 4),
+            "meets_slo": attainment >= objective,
+            # The full tail ladder, not just p50/p99: with an
+            # attainment objective the SLO verdict pivots on the
+            # percentile AT the objective (p90 for 0.9), so the row
+            # records where each arm's distribution actually sits.
+            "ttft_p50_s": pct(ttfts, 50), "ttft_p90_s": pct(ttfts, 90),
+            "ttft_p95_s": pct(ttfts, 95), "ttft_p99_s": pct(ttfts, 99),
+            "max_gap_p90_s": pct(gaps, 90),
+            "max_gap_p99_s": pct(gaps, 99),
+            "chip_seconds": round(chip_s, 2),
+            "goodput_tokens_per_chip_s": (round(good_tokens / chip_s, 2)
+                                          if chip_s > 0 else None),
+            "members_final": pool.get("healthy"),
+            **({"scale": {
+                    "spawns": asc.get("spawns"),
+                    "drains": asc.get("drains"),
+                    "rebalances": asc.get("rebalances"),
+                    "target": asc.get("target"),
+                    "decisions": asc.get("actions", [])}}
+               if autoscaled else {}),
+        }
+
+    async def main() -> dict:
+        arms: dict[str, dict] = {}
+        # The autoscaled arm STARTS at the first static shape — the
+        # hand-picked constant the pool would otherwise run all day —
+        # with the controller closing the loop on it: right-size down
+        # through the troughs (floor 1×1), grow back if the trace
+        # demands it. The statics are the same shape(s) pinned for the
+        # whole trace; the only variable is whether the loop is closed.
+        m0, n0 = (int(x) for x in
+                  static_shapes[0].lower().split("x"))
+        shapes = [("autoscaled", m0, n0, True)]
+        for s in static_shapes:
+            m, n = (int(x) for x in s.lower().split("x"))
+            shapes.append((f"static-{m}x{n}", m, n, False))
+        for label, m, n, autoscaled in shapes:
+            print(f"[autoscale] arm {label}: {clients} clients, "
+                  f"{arrival} trace over {duration_s:g}s",
+                  file=sys.stderr)
+            arms[label] = await run_arm(label, m, n, autoscaled)
+            a = arms[label]
+            print(f"[autoscale] arm {label}: attainment "
+                  f"{a['slo_attainment']} ({'meets' if a['meets_slo'] else 'MISSES'} "
+                  f"SLO), {a['chip_seconds']} chip-s, goodput "
+                  f"{a['goodput_tokens_per_chip_s']} tok/chip-s",
+                  file=sys.stderr)
+        auto = arms["autoscaled"]
+        statics = [a for a in arms.values() if not a["autoscaled"]]
+        # Compare against the static shapes that also meet the SLOs —
+        # a cheaper static arm that misses them is not provisioning,
+        # it is failing. If none meet, compare against all.
+        comparators = [a for a in statics if a["meets_slo"]] or statics
+        best_static = min(comparators, key=lambda a: a["chip_seconds"])
+        wins = (auto["meets_slo"]
+                and auto["chip_seconds"] < best_static["chip_seconds"])
+        return {
+            "kind": "autoscale",
+            "metric": f"SLO goodput ({preset_name}, {clients} clients, "
+                      f"{arrival} arrivals over {duration_s:g}s, "
+                      f"ttft<={slo_ttft_s}s gap<={slo_chunk_s}s @ "
+                      f"{objective:.0%}, autoscaled from "
+                      f"{static_shapes[0]} vs static "
+                      f"{','.join(static_shapes)})",
+            "value": auto["goodput_tokens_per_chip_s"],
+            "unit": "tok/chip-s",
+            "goodput_tokens_per_chip_s":
+                auto["goodput_tokens_per_chip_s"],
+            "arrival": {"kind": arrival, "duration_s": duration_s,
+                        "seed": seed},
+            "slo": {"ttft_s": slo_ttft_s, "inter_chunk_s": slo_chunk_s,
+                    "objective": objective},
+            "arms": arms,
+            "autoscaled_chip_seconds": auto["chip_seconds"],
+            "best_static_chip_seconds": best_static["chip_seconds"],
+            "best_static_shape": best_static["shape"],
+            "verdict": ("autoscaled-wins" if wins else
+                        "static-wins" if auto["meets_slo"] else
+                        "autoscaled-misses-slo"),
+        }
+
+    return asyncio.new_event_loop().run_until_complete(main())
+
+
 def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             prompt_chars: int, max_seq: int, dtype_name: str, block: int,
             quant: str | None, kv_quant: bool, bucket: int,
@@ -560,7 +919,10 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             multi_turn: int = 1,
             metrics_out: str | None = None,
             profile_sample: int = 0,
-            pipeline_depth: int | None = None) -> dict:
+            pipeline_depth: int | None = None,
+            arrival: str | None = None,
+            arrival_duration_s: float = 45.0,
+            arrival_seed: int = 0) -> dict:
     """The NORTH-STAR measurement (BASELINE.json metric): aggregate WIRE
     tok/s and p50/p99 TTFT through the full serving path — server +
     tpu_native provider + N concurrent streaming clients over TCP
@@ -746,6 +1108,12 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
         ready = asyncio.Event()
         all_connected = asyncio.Event()
         connected = 0
+        # Open-loop arrival trace (--arrival): pre-computed send offsets
+        # replace the linear stagger ramp — same barrier, shaped release.
+        arrivals = (arrival_times(arrival, clients,
+                                  duration_s=arrival_duration_s,
+                                  seed=arrival_seed)
+                    if arrival else None)
 
         async def run_sharded_fleet(fleet_prompts: list[str],
                                     temperature: float = 0.7,
@@ -774,6 +1142,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                             "prompts": [fleet_prompts[i] for i in shard],
                             "max_new": max_new,
                             "stagger_s": stagger_s,
+                            **({"arrivals": [arrivals[i] for i in shard]}
+                               if arrivals is not None else {}),
                             "temperature": temperature,
                             **({"speculative": spec_flag}
                                if spec_flag is not None else {})}
@@ -854,7 +1224,8 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             if connected == clients:
                 all_connected.set()
             await ready.wait()
-            await asyncio.sleep(i * stagger_s)
+            await asyncio.sleep(arrivals[i] if arrivals is not None
+                                else i * stagger_s)
             history: list[dict] = []
             turn_ttfts: list[float] = []
             stamps: list[tuple[float, int]] = []  # (arrival, chars)
@@ -1553,8 +1924,10 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
         return {
             "metric": f"e2e serving tok/s ({preset_name} {dtype_label}, "
                       f"{clients} streaming clients over TCP"
-                      + (f" @ {stagger_s}s stagger" if stagger_s else
-                         " (burst)")
+                      + (f" ({arrival} arrivals over "
+                         f"{arrival_duration_s:g}s)" if arrival
+                         else f" @ {stagger_s}s stagger" if stagger_s
+                         else " (burst)")
                       + (", shared-prefix cached wave" if shared_prefix
                          else "")
                       + (f", speculative wave (k={draft_k})" if speculative
@@ -1585,6 +1958,10 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                                       if gap_p99 is not None else None),
             "phases": phases,
             **({"client_procs": client_procs} if client_procs > 1 else {}),
+            **({"arrival": {"kind": arrival,
+                            "duration_s": arrival_duration_s,
+                            "seed": arrival_seed}}
+               if arrival else {}),
             **({"admitted": len(results), "rejected": len(rejected),
                 "reject_p99_s": round(pct(rj, 0.99), 3)}
                if rejected else {}),
@@ -1803,6 +2180,53 @@ def main() -> None:
                          "crash lands a few event frames into the first "
                          "wave at the default chaos shape; retune nth "
                          "for bigger fleets")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="SLO-goodput autoscaling bench: replay one "
+                         "seeded --arrival trace (diurnal default) "
+                         "against an autoscaled 1x1 pool (tpu.autoscale "
+                         "closed loop, engine/disagg/autoscale.py) and "
+                         "each --autoscale-static MxN control in ONE "
+                         "invocation; per arm: SLO attainment, "
+                         "chip-seconds (Σ member-alive time), and "
+                         "goodput = SLO-attaining tokens per "
+                         "chip-second (BASELINE.md Round 18). Sized "
+                         "small by default (24 clients x 48 tok)")
+    ap.add_argument("--autoscale-static", default="1x1,2x1,2x2",
+                    metavar="MxN[,MxN...]",
+                    help="static control shapes for --autoscale; the "
+                         "verdict compares the autoscaled arm's "
+                         "chip-seconds against the cheapest control "
+                         "that also meets the SLOs")
+    ap.add_argument("--autoscale-max-members", type=int, default=2,
+                    help="per-tier member ceiling for the autoscaled "
+                         "arm (tpu.autoscale.max_members)")
+    ap.add_argument("--arrival", default=None,
+                    choices=("poisson", "diurnal", "burst"),
+                    help="open-loop arrival trace replacing the "
+                         "--stagger ramp: seeded per-client send "
+                         "offsets over --arrival-duration (poisson = "
+                         "memoryless steady load, diurnal = "
+                         "trough-peak-trough day curve, burst = 4 "
+                         "thundering-herd waves). Works under --e2e "
+                         "and --autoscale (where diurnal is the "
+                         "default)")
+    ap.add_argument("--arrival-duration", type=float, default=45.0,
+                    metavar="S",
+                    help="window the --arrival trace spans, seconds")
+    ap.add_argument("--arrival-seed", type=int, default=0,
+                    help="RNG seed for the --arrival trace (same seed "
+                         "= same offsets, across runs and arms)")
+    ap.add_argument("--slo-ttft", type=float, default=2.5, metavar="S",
+                    help="--autoscale TTFT target: a request attains "
+                         "its SLO only if first token lands within "
+                         "this; also the provider slo: block's ttft_s "
+                         "(the burn the controller scales on)")
+    ap.add_argument("--slo-chunk", type=float, default=1.5, metavar="S",
+                    help="--autoscale inter-chunk gap target "
+                         "(slo: inter_chunk_s)")
+    ap.add_argument("--slo-objective", type=float, default=0.9,
+                    help="fraction of requests that must attain their "
+                         "SLOs for an arm to count as meeting them")
     ap.add_argument("--multi-turn", type=int, default=1, metavar="N",
                     help="conversation workload (--e2e): every client "
                          "runs one N-turn session, re-submitting the "
@@ -1964,6 +2388,25 @@ def main() -> None:
                            else 128)
         args.max_seq = (args.max_seq if args.max_seq is not None
                         else 384)
+    if args.autoscale:
+        # Autoscale-mode defaults: topology economics, not throughput —
+        # a fleet the 1x1 trough shape serves comfortably but whose
+        # diurnal peak overloads it, so the static controls must
+        # overprovision to meet the SLOs.
+        args.arrival = args.arrival or "diurnal"
+        args.clients = args.clients if args.clients is not None else 24
+        args.slots = args.slots if args.slots is not None else 4
+        args.max_new = args.max_new if args.max_new is not None else 48
+        args.prompt_len = (args.prompt_len if args.prompt_len is not None
+                           else 128)
+        args.max_seq = (args.max_seq if args.max_seq is not None
+                        else 384)
+        for s in args.autoscale_static.split(","):
+            parts = s.lower().split("x")
+            if (len(parts) != 2 or not all(p.isdigit() for p in parts)
+                    or int(parts[0]) < 1 or int(parts[1]) < 1):
+                ap.error(f"--autoscale-static wants MxN[,MxN...] with "
+                         f"M,N >= 1, got {s!r}")
     if args.clients is None:
         args.clients = (32 if args.multi_turn > 1
                         else 96 if (args.shared_prefix or args.speculative)
@@ -2043,6 +2486,7 @@ def main() -> None:
     # conservative e2e retry, the engine-only fallback) rebuild
     # `mode`/`fp_cfg` so the stamp describes what actually ran.
     mode = ("smoke" if args.smoke else "chaos" if args.chaos
+            else "autoscale" if args.autoscale
             else "engine" if args.engine else "proxy" if args.proxy
             else "e2e")
 
@@ -2068,6 +2512,19 @@ def main() -> None:
                   "prompt_len": args.prompt_len, "max_seq": args.max_seq,
                   "dtype": args.dtype, "block": args.block,
                   "chaos_seam": args.chaos_seam}
+    elif mode == "autoscale":
+        fp_cfg = {"preset": args.preset, "clients": args.clients,
+                  "slots": args.slots, "max_new": args.max_new,
+                  "prompt_len": args.prompt_len, "max_seq": args.max_seq,
+                  "dtype": args.dtype, "block": args.block,
+                  "arrival": args.arrival,
+                  "arrival_duration": args.arrival_duration,
+                  "arrival_seed": args.arrival_seed,
+                  "slo_ttft": args.slo_ttft,
+                  "slo_chunk": args.slo_chunk,
+                  "slo_objective": args.slo_objective,
+                  "static_shapes": args.autoscale_static,
+                  "max_members": args.autoscale_max_members}
     elif mode == "engine":
         fp_cfg = engine_fp(args.preset, args.slots, args.steps,
                            args.prompt_len, args.max_seq, args.dtype,
@@ -2094,6 +2551,10 @@ def main() -> None:
             "disagg_transport": args.disagg_transport,
             "disagg_pool": args.disagg_pool,
             "multi_turn": args.multi_turn, "stagger": args.stagger,
+            **({"arrival": args.arrival,
+                "arrival_duration": args.arrival_duration,
+                "arrival_seed": args.arrival_seed}
+               if args.arrival else {}),
             "max_queue": args.max_queue, "max_ttft": args.max_ttft,
             "client_procs": args.client_procs,
             "tracing": not args.no_trace,
@@ -2117,6 +2578,18 @@ def main() -> None:
             max_seq=args.max_seq, dtype_name=args.dtype,
             block=args.block, bucket=args.prompt_len,
             seam=args.chaos_seam)
+    elif args.autoscale:
+        result = run_autoscale(
+            args.preset, clients=args.clients, slots=args.slots,
+            max_new=args.max_new,
+            prompt_chars=max(1, args.prompt_len - 24),
+            max_seq=args.max_seq, dtype_name=args.dtype,
+            block=args.block, bucket=args.prompt_len,
+            arrival=args.arrival, duration_s=args.arrival_duration,
+            seed=args.arrival_seed, slo_ttft_s=args.slo_ttft,
+            slo_chunk_s=args.slo_chunk, objective=args.slo_objective,
+            static_shapes=tuple(args.autoscale_static.split(",")),
+            max_members=args.autoscale_max_members)
     elif args.engine:
         result = engine_bench()
     elif args.proxy:
@@ -2157,7 +2630,10 @@ def main() -> None:
                 multi_turn=args.multi_turn,
                 metrics_out=args.metrics_out,
                 profile_sample=args.profile_sample,
-                pipeline_depth=args.pipeline_depth)
+                pipeline_depth=args.pipeline_depth,
+                arrival=args.arrival,
+                arrival_duration_s=args.arrival_duration,
+                arrival_seed=args.arrival_seed)
 
         try:
             result = e2e_attempt(args.max_seq, args.max_new)

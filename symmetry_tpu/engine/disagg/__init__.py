@@ -15,6 +15,10 @@ engine/disagg/net.py over the transport/ stack (MemoryTransport in
 tests, TCP in production, optional Noise encryption).
 """
 
+from symmetry_tpu.engine.disagg.autoscale import (
+    AutoscaleConfig,
+    PoolAutoscaler,
+)
 from symmetry_tpu.engine.disagg.broker import (
     DEFAULT_DECODE_PREFIX_MB,
     HandoffBroker,
@@ -40,8 +44,10 @@ from symmetry_tpu.engine.disagg.pool import (
 )
 
 __all__ = [
+    "AutoscaleConfig",
     "DEFAULT_DECODE_PREFIX_MB",
     "DecodeLink",
+    "PoolAutoscaler",
     "FrameError",
     "HandoffBroker",
     "KVHandoff",

@@ -25,8 +25,17 @@ loops) and drives the router through a narrow verb set, which is what
 makes every membership/placement rule unit-testable in microseconds:
 
   add_member / mark_joining / mark_healthy    join + hot-join/rejoin
-  drain(member)                               no NEW placements; in-flight
-                                              finishes (deliberate drain)
+  drain(member) -> bool                       no NEW placements; in-flight
+                                              finishes (deliberate drain).
+                                              REFUSED for the last
+                                              placeable member of a tier —
+                                              nothing may scale a tier to 0
+  retire(member) -> bool                      scaled-down member leaves the
+                                              registry for good (the
+                                              autoscaler's drain-before-
+                                              kill terminal); refused while
+                                              in-flight work remains;
+                                              chip-seconds are banked
   on_lost(member) -> [request ids]            node death / link loss: the
                                               in-flight work to RE-PLACE
                                               on a survivor (never failed
@@ -123,7 +132,8 @@ class PoolMember:
     __slots__ = ("member_id", "tier", "state", "in_flight", "placements",
                  "queue_depth", "burn_rate", "node_id", "joined_at",
                  "state_since", "losses", "restarts", "summary",
-                 "summary_at", "gauges_at", "hit_blocks")
+                 "summary_at", "gauges_at", "hit_blocks", "alive_since",
+                 "chip_s")
 
     def __init__(self, member_id: str, tier: str) -> None:
         self.member_id = member_id
@@ -147,6 +157,12 @@ class PoolMember:
         self.summary_at: float | None = None
         self.gauges_at: float | None = None
         self.hit_blocks = 0
+        # Chip-second accounting (the autoscaler's goodput denominator):
+        # `chip_s` accumulates completed alive intervals; `alive_since`
+        # is the open interval's start (router clock), None while lost.
+        # The router stamps these — the member never reads a clock.
+        self.alive_since: float | None = None
+        self.chip_s = 0.0
 
     @property
     def placeable(self) -> bool:
@@ -207,9 +223,14 @@ class PoolRouter:
         # no longer holds would corrupt adoption).
         self._ledger_epoch: dict[str, int] = {}
         self.counters = {"placements": 0, "re_placements": 0,
-                         "drains": 0, "losses": 0, "joins": 0,
+                         "drains": 0, "drain_refused": 0, "retires": 0,
+                         "losses": 0, "joins": 0,
                          "rejoins": 0, "affinity_hit": 0,
                          "affinity_cold": 0, "affinity_load_only": 0}
+        # Chip-seconds already banked by members retired out of the
+        # registry (scale-down) — live members' alive time stays on the
+        # member until then. chip_seconds() sums both.
+        self._chip_s_retired = 0.0
         self._m_members = METRICS.gauge(
             MetricName.POOL_MEMBERS, "pool members known (any state)",
             labels=("tier",))
@@ -258,6 +279,7 @@ class PoolRouter:
         m = self._members.get(member_id)
         if m is None:
             m = PoolMember(member_id, tier)
+            m.alive_since = self._clock()
             self._members[member_id] = m
         if node_id:
             m.node_id = node_id
@@ -266,6 +288,16 @@ class PoolRouter:
 
     def _set_state(self, m: PoolMember, state: str) -> None:
         if m.state != state:
+            # Chip-seconds tick only while the member is not lost: close
+            # the open alive interval on the way INTO lost, open a new
+            # one on the way out (rejoin). Joining/draining still count —
+            # a spawning or draining member occupies its chip.
+            now = self._clock()
+            if state == MemberState.LOST and m.alive_since is not None:
+                m.chip_s += max(now - m.alive_since, 0.0)
+                m.alive_since = None
+            elif m.state == MemberState.LOST and m.alive_since is None:
+                m.alive_since = now
             m.state = state
             m.state_since = time.monotonic()
         self._refresh_gauges(m)
@@ -297,15 +329,25 @@ class PoolRouter:
             self.counters["joins"] += 1
         self._set_state(m, MemberState.HEALTHY)
 
-    def drain(self, member_id: str) -> None:
+    def drain(self, member_id: str) -> bool:
         """Deliberate drain: excluded from NEW placements immediately;
         whatever is in flight finishes (or is re-placed by on_lost if
-        the node dies mid-drain)."""
+        the node dies mid-drain). REFUSED (returns False) when this is
+        the LAST placeable member of its tier — a drain there is a
+        self-inflicted outage, and the autoscaler (or an operator) must
+        never be able to scale a tier to zero; the caller retries after
+        a replacement joins. Returns True when the member is draining
+        (including when it already was)."""
         m = self._members[member_id]
-        if m.state not in (MemberState.DRAINING, MemberState.LOST):
-            self.counters["drains"] += 1
-            self._m_drains.inc()
-            self._set_state(m, MemberState.DRAINING)
+        if m.state in (MemberState.DRAINING, MemberState.LOST):
+            return True
+        if m.placeable and self.healthy_count(m.tier) <= 1:
+            self.counters["drain_refused"] += 1
+            return False
+        self.counters["drains"] += 1
+        self._m_drains.inc()
+        self._set_state(m, MemberState.DRAINING)
+        return True
 
     def on_lost(self, member_id: str) -> list[str]:
         """Node death / link loss / leave: capacity is gone NOW. Returns
@@ -338,6 +380,54 @@ class PoolRouter:
             if self._planned.get(req_id) == member_id:
                 self._planned.pop(req_id, None)
         return ids
+
+    def retire(self, member_id: str) -> bool:
+        """Remove a scaled-down member from the registry for good —
+        the terminal verb of a deliberate drain (the autoscaler's
+        drain-before-kill path), NOT of a loss: a lost member stays
+        registered so a rejoin finds its slot. Refused (False) while
+        the member still has in-flight work — retire only after the
+        drain ran dry. Banks the member's chip-seconds into the
+        retired total so the goodput denominator never loses the time
+        a scaled-away member burned."""
+        m = self._members.get(member_id)
+        if m is None:
+            return True
+        if m.in_flight:
+            return False
+        now = self._clock()
+        self._chip_s_retired += m.chip_s + (
+            max(now - m.alive_since, 0.0)
+            if m.alive_since is not None else 0.0)
+        self.counters["retires"] += 1
+        del self._members[member_id]
+        self._ledger_epoch.pop(member_id, None)
+        # Drop the per-member state series (a gauge for a retired
+        # member would export its last state forever) and recompute the
+        # tier counts it was part of.
+        self._m_state.remove(tier=m.tier, node=m.member_id)
+        for tier in (PREFILL, DECODE):
+            members = self.members(tier)
+            self._m_members.set(len(members), tier=tier)
+            self._m_healthy.set(
+                sum(1 for x in members if x.placeable), tier=tier)
+        return True
+
+    def member_chip_s(self, m: PoolMember) -> float:
+        """One member's chip-seconds so far: banked intervals plus the
+        open alive interval (router clock)."""
+        live = (max(self._clock() - m.alive_since, 0.0)
+                if m.alive_since is not None else 0.0)
+        return m.chip_s + live
+
+    def chip_seconds(self) -> float:
+        """Σ member-alive time across the pool's whole history —
+        retired members included. The denominator of SLO-goodput
+        (tokens per chip-second): scaling up buys capacity at the cost
+        of a faster-growing denominator, which is exactly the trade the
+        autoscaler is scored on."""
+        return self._chip_s_retired + sum(
+            self.member_chip_s(m) for m in self._members.values())
 
     def ledger_epoch(self, member_id: str) -> int:
         """Current shipped-block-ledger epoch for a member (0 until its
@@ -608,13 +698,18 @@ class PoolRouter:
         return sum(1 for m in self.members(tier) if m.placeable)
 
     def stats(self) -> dict[str, Any]:
+        members = {}
+        for mid, m in sorted(self._members.items()):
+            d = m.to_dict()
+            d["chip_s"] = round(self.member_chip_s(m), 3)
+            members[mid] = d
         return {
             **self.counters,
-            "members": {mid: m.to_dict()
-                        for mid, m in sorted(self._members.items())},
+            "members": members,
             "healthy": {PREFILL: self.healthy_count(PREFILL),
                         DECODE: self.healthy_count(DECODE)},
             "in_flight": {PREFILL: len(self._assigned),
                           DECODE: len(self._adopted)},
             "ledger_epochs": dict(sorted(self._ledger_epoch.items())),
+            "chip_seconds": round(self.chip_seconds(), 3),
         }

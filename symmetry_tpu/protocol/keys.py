@@ -98,7 +98,13 @@ class HostOp:
                             # an optional "ledger" rider ({member,
                             # epoch}) telling the prefill host which
                             # decode member's shipped-block ledger the
-                            # handoff should be keyed against.
+                            # handoff should be keyed against. The same
+                            # reply is the autoscaler's sensor feed:
+                            # "queue_depth" and the symprof "devprof"
+                            # block (device_s_total) are differenced
+                            # per heartbeat into the per-tier load and
+                            # measured-M:N-ratio inputs of
+                            # engine/disagg/autoscale.py.
     METRICS = "metrics"     # metrics-registry snapshot probe (echoed
                             # back with the host process's registry
                             # families + its tier role; the provider

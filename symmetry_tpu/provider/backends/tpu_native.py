@@ -43,7 +43,8 @@ class _DecodeMember:
 
     __slots__ = ("id", "proc", "reader", "clock_offset", "waiters",
                  "down", "dead", "engine_alive", "spawned_at",
-                 "respawn_failures", "circuit_open", "restarts")
+                 "respawn_failures", "circuit_open", "restarts",
+                 "supervisor")
 
     def __init__(self, member_id: str) -> None:
         self.id = member_id
@@ -60,6 +61,10 @@ class _DecodeMember:
         self.respawn_failures = 0
         self.circuit_open = False
         self.restarts = 0
+        # This member's respawn-loop task: the autoscaler's retire path
+        # must cancel exactly it (a supervisor left running would
+        # respawn the member it just scaled away).
+        self.supervisor: asyncio.Task | None = None
 
     @property
     def alive(self) -> bool:
@@ -160,6 +165,19 @@ class TpuNativeBackend(InferenceBackend):
         self._decode_members: dict[str, _DecodeMember] = {}
         self._pool_tasks: list[asyncio.Task] = []
         self._replace_tasks: set[asyncio.Task] = set()
+        # --- SLO-goodput autoscaler (tpu.autoscale, pool mode only) ---
+        # A PoolAutoscaler ticks inside the pool heartbeat and its
+        # decisions become real member lifecycle events through the
+        # member factory below: spawn = a fresh _DecodeMember /
+        # inline PrefillNode, drain = drain-before-kill + retire.
+        self._autoscaler = None
+        self._member_seq: dict[str, int] = {}   # next member index/tier
+        self._node_by_member: dict[str, Any] = {}  # prefill id -> node
+        self._retiring: set[str] = set()  # fence: leave/down callbacks
+                                          # of a deliberate retire are
+                                          # not churn
+        self._scale_task: asyncio.Task | None = None
+        self._prev_busy: dict[str, float] = {}  # member -> device_s_total
         # Gates the pool's supervision/heartbeat tasks: set before the
         # first member spawns (they must not bail while start() is
         # still assembling the pool) and cleared first thing in stop().
@@ -633,9 +651,8 @@ class TpuNativeBackend(InferenceBackend):
         own DecodeLink. A member that is not up yet is NOT fatal: it
         joins when it connects (hot-join), and until at least one
         prefill member is healthy submits shed retryable."""
-        import functools
-
-        from symmetry_tpu.engine.disagg.net import DecodeLink
+        from symmetry_tpu.engine.disagg.autoscale import (
+            AutoscaleConfig, PoolAutoscaler)
         from symmetry_tpu.engine.disagg.pool import PoolRouter
 
         tpu = self._config.tpu
@@ -645,6 +662,16 @@ class TpuNativeBackend(InferenceBackend):
                          else self._heartbeat_s),
             affinity_weight=float(
                 getattr(tpu, "pool_affinity_weight", 1.0)))
+        asc_cfg = AutoscaleConfig(getattr(tpu, "autoscale", None))
+        if asc_cfg.enabled:
+            # Remote prefill peers are machines this backend cannot
+            # conjure — the prefill tier then stays fixed and only the
+            # decode tier scales.
+            self._autoscaler = PoolAutoscaler(
+                asc_cfg, self._pool,
+                grow_prefill=self._pool_cfg.prefill_peers is None)
+        self._member_seq = {"prefill": self._pool_cfg.prefill_count,
+                            "decode": self._pool_cfg.decode_count}
         self._pool_active = True
         members = [_DecodeMember(f"decode-{i}")
                    for i in range(self._pool_cfg.decode_count)]
@@ -658,9 +685,9 @@ class TpuNativeBackend(InferenceBackend):
                                for m in members])
         for m in members:
             self._pool.mark_healthy(m.id)
-            self._pool_tasks.append(
-                asyncio.get_running_loop().create_task(
-                    self._supervise_decode_member(m)))
+            m.supervisor = asyncio.get_running_loop().create_task(
+                self._supervise_decode_member(m))
+            self._pool_tasks.append(m.supervisor)
         peers = self._pool_cfg.prefill_peers
         if peers is None:
             base = self._link_cfg.peer or "mem://disagg-pool"
@@ -671,25 +698,12 @@ class TpuNativeBackend(InferenceBackend):
             await asyncio.gather(*[node.start()
                                    for node in self._inline_nodes])
             peers = [node.address for node in self._inline_nodes]
+            for i, node in enumerate(self._inline_nodes):
+                self._node_by_member[f"prefill-{i}"] = node
         for i, addr in enumerate(peers):
             member_id = f"prefill-{i}"
             self._pool.add_member(member_id, "prefill", node_id=addr)
-            link = DecodeLink(
-                self._link_cfg.for_peer(
-                    addr, heartbeat_s=self._pool_cfg.heartbeat_s),
-                on_handoff=functools.partial(self._pool_handoff,
-                                             member_id),
-                on_event=self._link_event,
-                on_fail=self._link_fail,
-                on_down=functools.partial(self._pool_member_down,
-                                          member_id),
-                on_up=functools.partial(self._pool_member_up, member_id),
-                on_drain=functools.partial(self._pool_member_drain,
-                                           member_id),
-                on_leave=functools.partial(self._pool_member_leave,
-                                           member_id))
-            self._plinks[member_id] = link
-            await link.start()
+            await self._attach_prefill_link(member_id, addr)
         deadline = time.monotonic() + min(self._spawn_timeout_s, 120.0)
         while (self._pool.healthy_count("prefill") == 0
                and time.monotonic() < deadline):
@@ -704,11 +718,41 @@ class TpuNativeBackend(InferenceBackend):
                  f"{len(peers)}×prefill {self._pool_cfg.decode_count}"
                  f"×decode (inline nodes: {len(self._inline_nodes)})")
 
+    async def _attach_prefill_link(self, member_id: str,
+                                   addr: str) -> None:
+        """Create + start one prefill member's DecodeLink (startup and
+        autoscale-spawn share this): handoffs, events, and membership
+        callbacks all member-scoped."""
+        import functools
+
+        from symmetry_tpu.engine.disagg.net import DecodeLink
+
+        link = DecodeLink(
+            self._link_cfg.for_peer(
+                addr, heartbeat_s=self._pool_cfg.heartbeat_s),
+            on_handoff=functools.partial(self._pool_handoff, member_id),
+            on_event=self._link_event,
+            on_fail=self._link_fail,
+            on_down=functools.partial(self._pool_member_down, member_id),
+            on_up=functools.partial(self._pool_member_up, member_id),
+            on_drain=functools.partial(self._pool_member_drain,
+                                       member_id),
+            on_leave=functools.partial(self._pool_member_leave,
+                                       member_id))
+        self._plinks[member_id] = link
+        await link.start()
+
     async def _spawn_decode_member(self, m: _DecodeMember) -> None:
         """One decode member life: spawn, ready, clock offset, reader —
         the member-scoped twin of _spawn_host."""
         m.dead = False
         m.engine_alive = True
+        # Boot fence: spawned_at is None until READY lands, and the
+        # heartbeat's wedge probe skips booting members — a host still
+        # building/warming up cannot answer a stats probe, and killing
+        # it for that turned every slow (loaded-machine) autoscale
+        # spawn or respawn into a startup "wedge" (rc=-9).
+        m.spawned_at = None
         m.proc = await self._spawn_one(self._cfg_path)
         await self._await_ready(m.proc, f"decode member {m.id}")
         m.clock_offset = await self._clock_handshake(m.proc)
@@ -780,6 +824,11 @@ class TpuNativeBackend(InferenceBackend):
         if m.dead:
             return
         m.dead = True
+        if self._autoscaler is not None:
+            # Churn, not a scaling decision: the autoscaler pauses
+            # (cooldown) instead of mistaking respawn turbulence for
+            # load and flapping the shape.
+            self._autoscaler.note_churn()
         for req_id in self._pool.on_lost(m.id):
             self._shed_request(req_id, f"{reason} ({m.id})")
         for lst in m.waiters.values():
@@ -890,7 +939,8 @@ class TpuNativeBackend(InferenceBackend):
             # the others' wedge detection (or stale their gauges) by a
             # full probe timeout each — per-member failure domains
             # apply to the watchdog too.
-            decode = [m for m in self._decode_members.values() if m.alive]
+            decode = [m for m in self._decode_members.values()
+                      if m.alive and m.spawned_at is not None]
             plinks = [(mid, link) for mid, link in self._plinks.items()
                       if link.connected]
             replies = await asyncio.gather(
@@ -910,15 +960,23 @@ class TpuNativeBackend(InferenceBackend):
             # request-stream data instead of a forever-0 placeholder,
             # and a multi-provider router comparing pools sees honest
             # numbers. None (no monitor attached / no SLO configured)
-            # leaves the gauge untouched.
-            burn = (self._slo_monitor.burn_rate()
-                    if self._slo_monitor is not None else None)
+            # leaves the gauge untouched. The PER-SLO split feeds the
+            # autoscaler (ttft → prefill tier, inter_chunk → decode).
+            burns = (self._slo_monitor.burn_rates()
+                     if self._slo_monitor is not None else None)
+            burn = (max(burns.values(), default=0.0)
+                    if burns is not None else None)
+            # symprof's measured per-tier device cost: each member's
+            # devprof.device_s_total rider, differenced per heartbeat —
+            # the autoscaler's M:N ratio signal.
+            busy = {"prefill": 0.0, "decode": 0.0}
             for m, msg in zip(decode, replies[:len(decode)]):
                 if isinstance(msg, dict):
                     # Per-member journal rider: a member's death then
                     # stamps its streams' sheds with counts no staler
                     # than one pool heartbeat.
                     self._journal.merge(msg.get("journal"))
+                    busy["decode"] += self._busy_delta(m.id, msg)
                 if not isinstance(msg, dict) or not m.engine_alive:
                     if m.dead:
                         continue  # death path already ran
@@ -942,11 +1000,234 @@ class TpuNativeBackend(InferenceBackend):
                         if isinstance(reply, dict) else None) or {}
                 if isinstance(host, dict) \
                         and host.get("queue_depth") is not None:
+                    busy["prefill"] += self._busy_delta(member_id, host)
                     self._pool.update_summary(
                         member_id, host.get("prefix_summary"))
                     self._pool.update_gauges(
                         member_id, queue_depth=host["queue_depth"],
                         burn_rate=burn)
+            self._autoscale_tick(burns, busy)
+
+    def _busy_delta(self, member_id: str, msg: dict) -> float:
+        """One member's device-busy seconds since its last heartbeat,
+        from the symprof stats rider (devprof.device_s_total, present
+        when tpu.profile_sample > 0). A counter that went backwards is
+        a host restart — the new life's total IS the delta."""
+        dp = msg.get("devprof")
+        if not isinstance(dp, dict):
+            return 0.0
+        try:
+            total = float(dp.get("device_s_total") or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+        prev = self._prev_busy.get(member_id)
+        self._prev_busy[member_id] = total
+        if prev is None:
+            return max(total, 0.0)
+        return total if total < prev else total - prev
+
+    def _autoscale_tick(self, burns: dict | None, busy: dict) -> None:
+        """One controller step at the end of each pool heartbeat: feed
+        the sensor snapshot, apply at most one decision as a background
+        task (the heartbeat must keep probing while a spawn compiles),
+        and book every non-hold decision where the flight recorder can
+        see it."""
+        if self._autoscaler is None or not self._pool_active:
+            return
+        applying = (self._scale_task is not None
+                    and not self._scale_task.done())
+        decision = self._autoscaler.tick(
+            burn=burns, busy_delta_s=busy,
+            tokens_total=float(self.relay_stats["host_events"]),
+            applying=applying)
+        if decision["action"] == "hold":
+            return
+        log.info(f"autoscale: {decision['action']} — "
+                 f"{decision['reason']} "
+                 f"(goodput {decision['goodput_tokens_per_chip_s']} "
+                 f"tok/chip-s at {decision['chip_s']} chip-s)")
+        self._scale_task = asyncio.get_running_loop().create_task(
+            self._apply_scale(decision))
+
+    # --- autoscale actuators (member factory) -------------------------
+
+    async def _apply_scale(self, decision: dict) -> None:
+        """Turn one controller decision into member lifecycle events.
+        Failures cool the controller down (note_churn) instead of
+        retrying hot — the next tick re-evaluates from live sensors."""
+        action = decision["action"]
+        try:
+            if action == "spawn":
+                await self._scale_spawn(decision["tier"])
+            elif action == "drain":
+                await self._scale_drain(decision["tier"],
+                                        decision["member"])
+            elif action == "rebalance":
+                # Grow first, shrink second: capacity never dips below
+                # the pre-decision shape mid-rebalance.
+                await self._scale_spawn(decision["spawn_tier"])
+                await self._scale_drain(decision["drain_tier"],
+                                        decision["member"])
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — scaling must not crash
+            log.error(f"autoscale: applying {action} failed: {exc}")
+            if self._autoscaler is not None:
+                self._autoscaler.note_churn()
+
+    async def _scale_spawn(self, tier: str) -> None:
+        seq = self._member_seq.get(tier, 0)
+        self._member_seq[tier] = seq + 1
+        member_id = f"{tier}-{seq}"
+        if tier == "decode":
+            await self._grow_decode_member(member_id)
+        else:
+            await self._grow_prefill_member(member_id, seq)
+
+    async def _grow_decode_member(self, member_id: str) -> None:
+        """Autoscale spawn, decode tier: a fresh _DecodeMember with its
+        own reader + supervision domain, exactly like a startup member."""
+        import contextlib
+
+        m = _DecodeMember(member_id)
+        self._decode_members[member_id] = m
+        self._pool.add_member(member_id, "decode")
+        try:
+            await asyncio.wait_for(self._spawn_decode_member(m),
+                                   self._spawn_timeout_s)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — spawn failed
+            log.error(f"autoscale: spawn of {member_id} failed: {exc}")
+            if m.proc is not None:
+                if m.proc.returncode is None:
+                    with contextlib.suppress(ProcessLookupError):
+                        m.proc.kill()
+                with contextlib.suppress(Exception):
+                    await m.proc.wait()
+                m.proc = None
+            self._decode_members.pop(member_id, None)
+            self._pool.on_lost(member_id)
+            self._pool.retire(member_id)
+            raise
+        self._pool.mark_healthy(member_id)
+        m.supervisor = asyncio.get_running_loop().create_task(
+            self._supervise_decode_member(m))
+        self._pool_tasks.append(m.supervisor)
+        log.info(f"autoscale: decode member {member_id} joined")
+
+    async def _grow_prefill_member(self, member_id: str,
+                                   index: int) -> None:
+        """Autoscale spawn, prefill tier (inline nodes only — remote
+        peers gate grow_prefill off): a fresh PrefillNode through the
+        node factory, behind its own DecodeLink. The member goes
+        healthy when the link's hello lands (_pool_member_up), same as
+        a hot-join."""
+        base = self._link_cfg.peer or "mem://disagg-pool"
+        # count ≥ 2 forces a unique per-member address (mem:// suffix /
+        # tcp port 0) — the original member may own the base address.
+        listen = self._member_listen_addr(base, index, max(index + 1, 2))
+        node = self._node_factory(self._config, listen)
+        self._pool.add_member(member_id, "prefill")
+        try:
+            await node.start()
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — spawn failed
+            log.error(f"autoscale: prefill node {member_id} failed to "
+                      f"start: {exc}")
+            self._pool.on_lost(member_id)
+            self._pool.retire(member_id)
+            raise
+        self._inline_nodes.append(node)
+        self._node_by_member[member_id] = node
+        await self._attach_prefill_link(member_id, node.address)
+        log.info(f"autoscale: prefill member {member_id} spawned at "
+                 f"{node.address}")
+
+    async def _scale_drain(self, tier: str, member_id: str) -> None:
+        """Drain-before-kill: the router stops NEW placements (refusing
+        the last placeable member — the 1×1 floor holds even if the
+        controller mis-decides), in-flight work runs dry under the stop
+        grace, then the member retires out of the registry for good."""
+        ok = self._pool.drain(member_id)
+        if not ok:
+            log.warning(f"autoscale: drain of {member_id} refused "
+                        f"(last placeable member of {tier})")
+            return
+        if tier == "decode":
+            await self._retire_decode_member(member_id)
+        else:
+            await self._retire_prefill_member(member_id)
+
+    async def _wait_drained(self, member_id: str) -> None:
+        deadline = time.monotonic() + self._stop_grace_s
+        while time.monotonic() < deadline:
+            pm = self._pool.get(member_id)
+            if pm is None or not pm.in_flight:
+                return
+            await asyncio.sleep(0.05)
+
+    async def _retire_decode_member(self, member_id: str) -> None:
+        import contextlib
+
+        await self._wait_drained(member_id)
+        m = self._decode_members.pop(member_id, None)
+        self._prev_busy.pop(member_id, None)
+        if m is None:
+            return
+        m.dead = True  # fence the reader's death path: deliberate stop
+        if m.supervisor is not None:
+            m.supervisor.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await m.supervisor
+            if m.supervisor in self._pool_tasks:
+                self._pool_tasks.remove(m.supervisor)
+            m.supervisor = None
+        if m.reader is not None:
+            m.reader.cancel()
+            m.reader = None
+        if m.proc is not None:
+            with contextlib.suppress(ConnectionError, OSError):
+                await self._host_send({"op": HostOp.SHUTDOWN},
+                                      proc=m.proc)
+            try:
+                await asyncio.wait_for(m.proc.wait(), self._stop_grace_s)
+            except asyncio.TimeoutError:
+                m.proc.kill()
+                await m.proc.wait()  # reap — no zombie
+            m.proc = None
+        if not self._pool.retire(member_id):
+            # Grace expired with work still pinned there: shed it
+            # structured-retryable (clients fail over) and retire.
+            for req_id in self._pool.on_lost(member_id):
+                self._shed_request(
+                    req_id, f"decode member {member_id} scaled away")
+            self._pool.retire(member_id)
+        log.info(f"autoscale: decode member {member_id} retired")
+
+    async def _retire_prefill_member(self, member_id: str) -> None:
+        self._retiring.add(member_id)
+        try:
+            await self._wait_drained(member_id)
+            link = self._plinks.pop(member_id, None)
+            if link is not None:
+                await link.stop()
+            node = self._node_by_member.pop(member_id, None)
+            if node is not None:
+                await node.stop()
+                if node in self._inline_nodes:
+                    self._inline_nodes.remove(node)
+            self._prev_busy.pop(member_id, None)
+            if not self._pool.retire(member_id):
+                ids = self._member_lost_ids(member_id)
+                if ids:
+                    self._spawn_replace(
+                        ids, f"prefill member {member_id} scaled away")
+                self._pool.retire(member_id)
+            log.info(f"autoscale: prefill member {member_id} retired")
+        finally:
+            self._retiring.discard(member_id)
 
     # --- pool membership callbacks (link-driven) ----------------------
 
@@ -970,19 +1251,30 @@ class TpuNativeBackend(InferenceBackend):
         its in-flight migrations are RE-PLACED on a survivor — the shed
         only reaches the client when no survivor exists. The link keeps
         reconnecting; a successful reconnect is a rejoin."""
+        if member_id in self._retiring:
+            return  # deliberate retire tearing its own link down
+        if self._autoscaler is not None:
+            self._autoscaler.note_churn()
         ids = self._member_lost_ids(member_id)
         if ids:
             self._spawn_replace(ids, f"prefill member {member_id} lost: "
                                      f"{reason}")
 
     def _pool_member_drain(self, member_id: str, node: str) -> None:
-        self._pool.drain(member_id)
-        log.info(f"pool: prefill member {member_id} "
-                 f"({node or 'unnamed'}) draining")
+        ok = self._pool.drain(member_id)
+        if ok:
+            log.info(f"pool: prefill member {member_id} "
+                     f"({node or 'unnamed'}) draining")
+        else:
+            log.warning(f"pool: drain of prefill member {member_id} "
+                        f"({node or 'unnamed'}) REFUSED — last placeable "
+                        f"member of its tier")
 
     def _pool_member_leave(self, member_id: str, node: str) -> None:
         """Deliberate departure: account as churn; any straggler still
         in flight there is re-placed like a loss."""
+        if member_id in self._retiring:
+            return  # deliberate retire: the backend owns the teardown
         ids = self._member_lost_ids(member_id)
         log.info(f"pool: prefill member {member_id} "
                  f"({node or 'unnamed'}) left")
@@ -1166,6 +1458,8 @@ class TpuNativeBackend(InferenceBackend):
                    "clock_offset_s": round(m.clock_offset, 6)}
             for m in self._decode_members.values()}
         st["inline_nodes"] = len(self._inline_nodes)
+        if self._autoscaler is not None:
+            st["autoscale"] = self._autoscaler.stats()
         return st
 
     async def _clock_handshake(self, proc: asyncio.subprocess.Process,
@@ -1441,6 +1735,16 @@ class TpuNativeBackend(InferenceBackend):
             self._supervisor = None
         self._restarting = False
         self._pool_active = False
+        # Autoscale teardown first: a half-applied spawn/drain must not
+        # race the member teardown below.
+        if self._scale_task is not None:
+            self._scale_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._scale_task
+            self._scale_task = None
+        self._retiring.clear()
+        self._node_by_member.clear()
+        self._prev_busy.clear()
         # Pool teardown first: member supervision and replace tasks
         # must not race the shutdown, and no handoff may land on a
         # decode member that is draining away.

@@ -212,6 +212,23 @@ class TpuConfig:
     #   secret: str               identity seed name; peer_key: hex —
     #                             pin the expected remote static key
     disagg: dict[str, Any] | None = None
+    # SLO-goodput autoscaler for the elastic disagg pool
+    # (engine/disagg/autoscale.py): a controller tick inside the pool
+    # heartbeat turns SLO burn rates + queue gauges + symprof's measured
+    # per-tier device cost into real membership ops (spawn / drain /
+    # rebalance the M×N shape). None (default) → the pool shape stays
+    # whatever `disagg.pool` declared. Keys (all optional):
+    #   enabled: bool = true          master switch
+    #   max_members: int = 4          per-tier ceiling (floor is 1×1)
+    #   dwell_s: float = 30.0         min seconds between decisions
+    #   churn_cooldown_s: float = 60  scaling pause after a churn respawn
+    #   spawn_burn: float = 1.0       fast-window SLO burn → spawn
+    #   spawn_queue: float = 2.0      avg per-member load → spawn
+    #   drain_load: float = 0.25      avg load at/under which a tier idles
+    #   drain_ticks: int = 3          consecutive idle ticks → drain
+    #   min_busy_s: float = 0.05      device-busy floor for the measured
+    #                                 M:N rebalance signal
+    autoscale: dict[str, Any] | None = None
     # Engine-host supervision (process isolation only): a heartbeat
     # watchdog piggybacked on the host stats op detects crashes AND
     # wedges with a much tighter deadline than the 15 s provider health

@@ -175,6 +175,18 @@ class MetricName:
         "sym_pool_affinity_placements_total")                # {outcome}
     POOL_GOSSIP_AGE = "sym_pool_gossip_age_seconds"          # {tier,node}
 
+    # --- SLO-goodput autoscaler (engine/disagg/autoscale.py, provider
+    #     process). Decisions count only real topology changes —
+    #     hold/dwell/cooldown ticks stay out of the counter so
+    #     decisions/min in symtop means "the shape moved". Target vs
+    #     live membership is the convergence view; chip-seconds is
+    #     Σ member-alive time (the goodput denominator, gauge because
+    #     it is recomputed from the router's ledger each tick).
+    AUTOSCALE_DECISIONS = "sym_autoscale_decisions_total"    # {action,tier}
+    AUTOSCALE_TARGET = "sym_autoscale_target_members"        # {tier}
+    AUTOSCALE_CHIP_SECONDS = "sym_autoscale_chip_seconds"
+    AUTOSCALE_GOODPUT = "sym_autoscale_goodput_tokens_per_chip_s"
+
     # --- server registry (server/registry.py)
     SERVER_PROVIDERS_ONLINE = "sym_server_providers_online"
     SERVER_PROVIDER_QUEUED = "sym_server_provider_queued"    # {provider,model}
@@ -793,6 +805,24 @@ class SloMonitor:
                 burn, _n = fast_w.burn(budget)
                 worst = max(worst, burn)
         return worst
+
+    def burn_rates(self, now: float | None = None) -> dict[str, float]:
+        """Per-SLO fast-window burns, pruned live — the autoscaler's
+        tier-pressure input: `ttft` burn implicates the prefill tier,
+        `inter_chunk` the decode tier (burn_rate() collapses both into
+        one worst-case number, which can place but cannot steer).
+        Empty dict when no SLO is configured."""
+        if not self.targets:
+            return {}
+        now = self._clock() if now is None else now
+        budget = max(1.0 - self.objective, 1e-9)
+        out: dict[str, float] = {}
+        with self._lock:
+            for slo, (fast_w, _slow_w) in self._windows.items():
+                fast_w.prune(now)
+                burn, _n = fast_w.burn(budget)
+                out[slo] = burn
+        return out
 
     def evaluate(self, now: float | None = None) -> list[dict[str, Any]]:
         """Evaluate every rule (periodic path — observe() already

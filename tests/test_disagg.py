@@ -1356,6 +1356,21 @@ class TestPoolRouter:
         assert r.place("r9") == "p0"  # prefill tier untouched
         assert r.route_decode("r9") is None
 
+    def test_drain_refuses_last_healthy_member_of_tier(self):
+        """Draining the LAST healthy member of a tier would leave it
+        empty with no fault in sight — the router refuses and the
+        caller (autoscaler, operator) must grow first."""
+        r = healthy_pool(m_prefill=2, n_decode=1)
+        assert r.drain("d0") is False  # sole decode member: refused
+        assert r.get("d0").state is MemberState.HEALTHY
+        assert r.counters["drain_refused"] == 1
+        assert r.drain("p0") is True  # prefill has a survivor
+        assert r.drain("p0") is True  # idempotent on a draining member
+        assert r.drain("p1") is False  # p0 draining → p1 is now last
+        r.add_member("p9", "prefill")
+        r.mark_healthy("p9")
+        assert r.drain("p1") is True  # replacement arrived: allowed
+
     def test_exclude_walks_past_refusing_members(self):
         r = healthy_pool(m_prefill=3)
         got = set()

@@ -550,6 +550,54 @@ class TestSymtop:
         # shed is a RATE between polls, not the lifetime total
         assert rows[0]["shed"] == pytest.approx(5.0)
 
+    def test_target_and_scale_columns(self):
+        """Autoscaled pools surface TARGET (live MxN vs the
+        controller's desired MxN) and SCALE (booked decisions/minute)
+        on the provider row; non-autoscaled providers show dashes."""
+        import tools.symtop as symtop
+
+        r = MetricsRegistry()
+        r.counter(MetricName.PROVIDER_TOKENS_OUT, "t").inc(100)
+        r.gauge(MetricName.PROVIDER_UPTIME, "u").set(10.0)
+        tgt = r.gauge(MetricName.AUTOSCALE_TARGET, "tm",
+                      labels=("tier",))
+        tgt.set(2, tier="prefill")
+        tgt.set(1, tier="decode")
+        r.counter(MetricName.AUTOSCALE_DECISIONS, "d",
+                  labels=("action", "tier")).inc(
+                      3, action="spawn", tier="prefill")
+        st = r.gauge(MetricName.POOL_MEMBER_STATE, "s",
+                     labels=("tier", "node"))
+        st.set(1, tier="prefill", node="prefill-0")  # healthy
+        st.set(1, tier="decode", node="decode-0")
+        fams = symtop.families_from_snapshots(
+            [{"snapshot": r.snapshot(compact=True), "labels": {}}])
+        rows = symtop.build_rows("p", fams, None, now=0.0)
+        # live 1x1 still converging toward the desired 2x1
+        assert rows[0]["target"] == "1x1>2x1"
+        assert rows[0]["scale"] == 3  # first poll: lifetime total
+        rows2 = symtop.build_rows(
+            "p", fams, {"t": 0.0, "tok": 0.0, "shed": 0.0, "dec": 1.0},
+            now=30.0)
+        assert rows2[0]["scale"] == pytest.approx(4.0)  # 2 in 30s /min
+        rows[0].pop("_sample", None)
+        table = symtop.render_table(rows)
+        header = table.splitlines()[0]
+        assert "TARGET" in header and "SCALE" in header
+        assert "1x1>2x1" in table
+        # steady state collapses to one MxN; no autoscaler → dashes
+        st.set(1, tier="prefill", node="prefill-1")
+        fams = symtop.families_from_snapshots(
+            [{"snapshot": r.snapshot(compact=True), "labels": {}}])
+        assert symtop.build_rows("p", fams, None,
+                                 now=0.0)[0]["target"] == "2x1"
+        bare = MetricsRegistry()
+        bare.counter(MetricName.PROVIDER_TOKENS_OUT, "t").inc(1)
+        fams = symtop.families_from_snapshots(
+            [{"snapshot": bare.snapshot(compact=True), "labels": {}}])
+        row = symtop.build_rows("p", fams, None, now=0.0)[0]
+        assert row["target"] is None and row["scale"] is None
+
 
 # ---------------------------------------- resume / pool family exposition
 

@@ -67,6 +67,10 @@ POLICIES: list[tuple[re.Pattern, str, float]] = [
     # prefix). The pool-routing headline — a regression here means
     # follow-up turns stopped finding their cache.
     (re.compile(r"turn2plus_speedup$"), "higher", 0.05),
+    # Autoscaler headline: SLO-attaining tokens per chip-second. A
+    # regression means the controller is buying the same goodput with
+    # more chips (or shedding goodput to save them).
+    (re.compile(r"goodput_tokens_per_chip_s$"), "higher", 0.05),
     (re.compile(r"weight_stream_gbs$"), "higher", 0.05),
     (re.compile(r"acceptance_rate$"), "higher", 0.10),
     (re.compile(r"ttft[a-z0-9_]*_p\d+(_[a-z]+)?_s$"), "lower", 0.10),

@@ -2,9 +2,12 @@
 """symtop — live terminal fleet view over the telemetry layer.
 
 Polls one-or-many providers and renders a per-provider, per-tier table:
-tok/s, TTFT p50/p99, queue depth, in-flight, occupancy, shed count, and
-handoff-link health — the operator's answer to "is the fleet healthy
-RIGHT NOW", where bench.py answers "how fast was it over a run".
+tok/s, TTFT p50/p99, queue depth, in-flight, occupancy, shed count,
+handoff-link health, and — on autoscaled pools — TARGET (the
+controller's desired M×N vs the live topology, from
+sym_autoscale_target_members) and SCALE (booked scaling decisions per
+minute) — the operator's answer to "is the fleet healthy RIGHT NOW",
+where bench.py answers "how fast was it over a run".
 
 Two poll paths, mixable in one invocation:
 
@@ -47,8 +50,10 @@ from symmetry_tpu.utils.metrics import (  # noqa: E402
 
 COLUMNS = ("PROVIDER", "TIER", "TOK/S", "TTFT p50", "TTFT p99",
            "QUEUE", "INFL", "OCC", "GAP%", "DEPTH", "SHED", "RESUME",
-           "WASTED", "REUSED", "DUMPS", "LINK", "STATE", "SHARE", "HIT")
-WIDTHS = (22, 10, 9, 9, 9, 7, 6, 5, 5, 5, 7, 7, 7, 7, 6, 6, 9, 6, 6)
+           "WASTED", "REUSED", "DUMPS", "LINK", "STATE", "SHARE", "HIT",
+           "TARGET", "SCALE")
+WIDTHS = (22, 10, 9, 9, 9, 7, 6, 5, 5, 5, 7, 7, 7, 7, 6, 6, 9, 6, 6,
+          9, 6)
 
 # sym_pool_member_state gauge encoding (engine/disagg/pool.py
 # STATE_CODES) rendered back to the membership lifecycle names.
@@ -210,6 +215,7 @@ def build_rows(name: str, fams: dict,
     tok = _value(fams, "sym_provider_tokens_out_total", 0.0)
     shed = _value(fams, "sym_provider_sheds_total", 0.0)
     uptime = _value(fams, "sym_provider_uptime_seconds")
+    decisions = _value(fams, "sym_autoscale_decisions_total")
     if prev and now > prev["t"]:
         dt = now - prev["t"]
         tok_s = max(tok - prev["tok"], 0.0) / dt
@@ -218,10 +224,33 @@ def build_rows(name: str, fams: dict,
         # look like one actively shedding. --once / the first poll fall
         # back to the lifetime total.
         shed_disp = max(shed - prev["shed"], 0.0) / dt
+        # SCALE: autoscale decisions per MINUTE since the last poll
+        # (spawns + drains + rebalances — holds are not booked in the
+        # counter). A fleet that keeps flapping shows it here.
+        scale_disp = (None if decisions is None else
+                      max(decisions - prev.get("dec", 0.0), 0.0)
+                      * 60.0 / dt)
     else:
         tok_s = tok / max(uptime, 1e-9) if uptime else None
         shed_disp = shed
+        scale_disp = decisions  # lifetime total on the first poll
     link = _value(fams, "sym_link_connected")
+    # TARGET: the autoscaler's desired topology vs what is live —
+    # "live MxN>target MxN" while a decision is being actuated (or a
+    # member is mid-join/drain), collapsing to one MxN at steady state.
+    target = None
+    tgt_p = _value(fams, "sym_autoscale_target_members", tier="prefill")
+    tgt_d = _value(fams, "sym_autoscale_target_members", tier="decode")
+    if tgt_p is not None or tgt_d is not None:
+        live: dict[str, int] = {}
+        for s in (fams.get("sym_pool_member_state")
+                  or {"series": []})["series"]:
+            if not s.get("suffix") and s["value"] == 1:  # healthy
+                tier = s["labels"].get("tier", "")
+                live[tier] = live.get(tier, 0) + 1
+        live_mn = f"{live.get('prefill', 0):.0f}x{live.get('decode', 0):.0f}"
+        tgt_mn = f"{tgt_p or 0:.0f}x{tgt_d or 0:.0f}"
+        target = tgt_mn if live_mn == tgt_mn else f"{live_mn}>{tgt_mn}"
     rows = [{
         "provider": name, "tier": "",
         "tok_s": tok_s,
@@ -242,7 +271,9 @@ def build_rows(name: str, fams: dict,
         "dumps": _value(fams, "sym_provider_flight_dumps_total"),
         "link": (None if link is None else ("up" if link else "DOWN")),
         "state": None, "share": None,
-        "_sample": {"t": now, "tok": tok, "shed": shed or 0.0},
+        "target": target, "scale": scale_disp,
+        "_sample": {"t": now, "tok": tok, "shed": shed or 0.0,
+                    "dec": decisions or 0.0},
     }]
     for tier in _tiers(fams):
         rows.append({
@@ -312,7 +343,7 @@ def render_table(rows: list[dict[str, Any]]) -> str:
                  r.get("wasted"), r.get("reused"), r.get("dumps"),
                  r["link"] or "-",
                  r.get("state") or "-", r.get("share") or "-",
-                 r.get("hit"))
+                 r.get("hit"), r.get("target") or "-", r.get("scale"))
         out.append("  ".join(_fmt_cell(c, w)
                              for c, w in zip(cells, WIDTHS)))
     return "\n".join(out)
