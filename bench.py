@@ -298,6 +298,12 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
     # dequant A/B exists to raise (BASELINE.md decode-floor section).
     step_s = dt / done_steps
     weight_bytes = engine.weight_stream_bytes()
+    # Per-device stream: with the packed layout sharded over the mesh
+    # each chip reads only its weight shard per step — THIS is the
+    # number a per-chip HBM roofline bounds, and the TP A/B gate
+    # (BASELINE.md round-19) compares. Equals the aggregate figure
+    # on a single device.
+    weight_bytes_dev = engine.weight_stream_bytes_per_device()
     return {
         "metric": f"aggregate decode tok/s ({preset_name} {dtype_name}, "
                   f"{slots} slots, block {block}, "
@@ -310,6 +316,8 @@ def run_bench(preset_name: str, *, slots: int, steps: int, prompt_len: int,
         "decode_step_ms": round(1e3 * step_s, 2),
         "weight_bytes_per_step": weight_bytes,
         "weight_stream_gbs": round(weight_bytes / step_s / 1e9, 1),
+        "weight_stream_gbs_per_device": round(
+            weight_bytes_dev / step_s / 1e9, 1),
         "pipeline_depth": depth,
         "dispatch_thread_block_s": disp_wall,
         **({"devprof": devprof_block} if devprof_block else {}),
@@ -1596,7 +1604,7 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             # number now lands in every BENCH_r*.json engine block, not
             # only the engine-only bench (fused-dequant A/B reads it).
             for key in ("decode_step_ms", "weight_bytes_per_step",
-                        "weight_stream_gbs"):
+                        "weight_stream_gbs", "weight_stream_gbs_per_device"):
                 if engine_stats.get(key) is not None:
                     diag[key] = engine_stats[key]
             if diag.get("decode_step_ms") is not None:

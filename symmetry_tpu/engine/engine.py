@@ -209,31 +209,64 @@ class InferenceEngine:
         # type), so every trunk program built below (prefill, chunk,
         # decode, verify) traces fused with no extra knob plumbing, and
         # knob-off leaves every compiled program byte-identical to a
-        # build without the feature.
+        # build without the feature. On a mesh the pack happens AFTER
+        # the sharding decision: pack_params resolves each leaf's
+        # contraction/output mesh axes from the same logical-axis tree
+        # the dense placement used, picks tile blocks against the
+        # per-shard dims, and qmatmul routes the leaf through the
+        # shard_map'd per-shard kernel. Leaves that can't shard-pack
+        # degrade to the mixed dot — loudly (log + counter), never
+        # silently.
         self.fused_dequant = bool(fused_dequant)
         if self.fused_dequant:
             from symmetry_tpu.models.llama import pack_params
-            from symmetry_tpu.ops.quant import PackedQuantizedTensor
+            from symmetry_tpu.ops.quant import (
+                PackedQuantizedTensor, QuantizedTensor)
+            from symmetry_tpu.utils.logging import logger
+            from symmetry_tpu.utils.metrics import METRICS, MetricName
 
-            if mesh is not None:
-                # Same boundary as the fused KV append: the packed tile
-                # layout has no GSPMD partitioning rule. Loud, not
-                # silently inert — the operator asked for a fused build.
-                raise EngineError(
-                    "tpu.fused_dequant supports single-device engines "
-                    "only (the packed weight layout has no GSPMD "
-                    "partitioning rule); drop the knob or the mesh")
-            self.params = params = pack_params(params)
+            def is_qt(leaf):
+                return isinstance(leaf, QuantizedTensor)
 
-            def is_packed(leaf):
-                return isinstance(leaf, PackedQuantizedTensor)
-
-            if not any(is_packed(leaf) for leaf in
-                       jax.tree.leaves(params, is_leaf=is_packed)):
+            if not any(is_qt(leaf) for leaf in
+                       jax.tree.leaves(params, is_leaf=is_qt)):
                 raise EngineError(
                     "tpu.fused_dequant found no packable int8 weights — "
                     "it requires tpu.quantization: int8 (the knob would "
                     "otherwise be silently inert)")
+            fallback = METRICS.counter(
+                MetricName.QMM_FALLBACK,
+                "int8 leaves kept on the mixed dot at load",
+                labels=("reason",))
+            if _stage_rules(mesh) is not None:
+                # Pipeline stages run the trunk inside their own
+                # shard_map collectives; the fused kernel's per-shard
+                # dispatch cannot nest there. Degrade the whole tree —
+                # the engine serves unfused, and says so.
+                logger.warning(
+                    "tpu.fused_dequant: pipeline (stage axis > 1) keeps "
+                    "every int8 leaf on the mixed dot (reason: "
+                    "stage_axis)")
+                fallback.inc(reason="stage_axis")
+            else:
+                degrades: list[tuple[str, str]] = []
+                self.params = params = pack_params(
+                    params, config=config, mesh=mesh, report=degrades)
+                for path, reason in degrades:
+                    logger.warning(
+                        f"tpu.fused_dequant: {path} stays on the mixed "
+                        f"dot (reason: {reason})")
+                    fallback.inc(reason=reason)
+
+                def is_packed(leaf):
+                    return isinstance(leaf, PackedQuantizedTensor)
+
+                if not any(is_packed(leaf) for leaf in
+                           jax.tree.leaves(params, is_leaf=is_packed)):
+                    logger.warning(
+                        "tpu.fused_dequant: no int8 leaf packed on this "
+                        "mesh/backend — the engine runs entirely on the "
+                        "mixed dot (see the degrade reasons above)")
         # Pipeline-parallel serving (parallel/pipeline.py): a stage axis of
         # size > 1 routes prefill AND decode through the staged microbatch
         # schedule; params/cache must be stage-sharded (PIPELINE_RULES).
@@ -1670,6 +1703,67 @@ class InferenceEngine:
                     # concurrent-peak probe above).
                     np.asarray(toks)
 
+        # Dispatch-cache closure. Donation aliases output buffers to the
+        # donated inputs, so a state array's PHYSICAL provenance (which
+        # executable originally materialized its buffer) survives across
+        # program boundaries — and jaxlib's C++ fastpath keys on it. A
+        # state that flowed insert→decode→insert therefore dispatches
+        # under a different cache key than warmup's init→insert chain,
+        # even though every aval, sharding, and layout compares equal:
+        # the first serving burst grows _cache_size() without tracing or
+        # compiling anything. compile_cache_sizes() is the steady-state
+        # recompile tripwire (tests assert it stays flat under traffic),
+        # so warmup must populate those signature classes too: run real
+        # serving-shaped rounds — back-to-back inserts, decode-interleaved
+        # inserts, consecutive decodes — until the per-program variant
+        # counts reach a fixed point. The provenance-class graph is finite
+        # (one class per materializing executable), so this converges in
+        # a couple of rounds; every dispatch hits an already-compiled
+        # program, so the cost is a handful of device launches, not
+        # compiles.
+        if decode_side:
+            def _settle_insert(state, batch: int, bucket: int):
+                toks, prefix = self._prefill(
+                    self.params, jnp.zeros((batch, bucket), jnp.int32),
+                    jnp.ones((batch,), jnp.int32),
+                    jnp.zeros((batch,), jnp.float32),
+                    jnp.ones((batch,), jnp.float32),
+                    jnp.zeros((batch,), jnp.int32),
+                    jax.random.split(jax.random.key(0), batch),
+                    self._prefill_scratch_for(batch, bucket))
+                self._store_prefill_scratch(batch, bucket, prefix)
+                return self._insert_all(
+                    state, prefix, jnp.zeros((batch,), jnp.int32),
+                    jnp.zeros((batch,), jnp.int32), toks,
+                    jnp.zeros((batch,), jnp.float32),
+                    jnp.ones((batch,), jnp.float32),
+                    jnp.zeros((batch,), jnp.int32),
+                    jax.random.split(jax.random.key(0), batch))
+
+            for _ in range(6):
+                sizes = self.compile_cache_sizes()
+                for bucket in self.prefill_buckets:
+                    for batch in self.prefill_batches_for(bucket):
+                        if batch > self.max_slots:
+                            continue
+                        # burst admission: inserts back-to-back
+                        self.state = _settle_insert(self.state, batch,
+                                                    bucket)
+                        # steady decode between admissions
+                        self.state, _ = self._decode(self.params, self.state)
+                        self.state = _settle_insert(self.state, batch,
+                                                    bucket)
+                    # consecutive decode blocks (no admission between)
+                    self.state, _ = self._decode(self.params, self.state)
+                    self.state, _ = self._decode(self.params, self.state)
+                if self.spec is not None:
+                    self.verify_step(
+                        np.zeros((self.max_slots, self.spec.k_draft),
+                                 np.int32),
+                        np.zeros((self.max_slots,), np.int32))
+                if self.compile_cache_sizes() == sizes:
+                    break
+
     def verify_step_dispatch(self, draft: np.ndarray, n_draft: np.ndarray
                              ) -> tuple[jax.Array, jax.Array]:
         """Dispatch ONE speculative verify WITHOUT syncing: `draft`
@@ -1750,6 +1844,31 @@ class InferenceEngine:
         total = sum(leaf.nbytes for leaf in jax.tree.leaves(self.params))
         if not self.config.tie_embeddings:
             total -= self.params["embed"].nbytes
+        return total
+
+    def weight_stream_bytes_per_device(self) -> int:
+        """Per-device slice of weight_stream_bytes: each leaf counts its
+        LOCAL shard size (sharding.shard_shape), so TP sharded leaves
+        divide by the axis size while replicated leaves count in full on
+        every device — the actual per-chip HBM stream one decode step
+        costs, and the denominator bench.py's per-device
+        weight_stream_gbs reports. Metadata-only, safe from any thread;
+        on a single device this equals weight_stream_bytes."""
+
+        def local_nbytes(leaf) -> int:
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is None:
+                return leaf.nbytes
+            shard = sharding.shard_shape(leaf.shape)
+            n = leaf.dtype.itemsize
+            for d in shard:
+                n *= d
+            return n
+
+        total = sum(local_nbytes(leaf)
+                    for leaf in jax.tree.leaves(self.params))
+        if not self.config.tie_embeddings:
+            total -= local_nbytes(self.params["embed"])
         return total
 
     def compile_cache_sizes(self) -> dict[str, int]:

@@ -498,6 +498,13 @@ class Scheduler:
                 nbytes = int(wsb())
                 out["weight_bytes_per_step"] = nbytes
                 out["weight_stream_gbs"] = round(nbytes / step_s / 1e9, 1)
+                # Per-device shard stream (sharded packed layout): the
+                # per-chip HBM roofline number the TP A/B gate reads.
+                wsbd = getattr(self.engine,
+                               "weight_stream_bytes_per_device", None)
+                if wsbd is not None:
+                    out["weight_stream_gbs_per_device"] = round(
+                        int(wsbd()) / step_s / 1e9, 1)
         # symprof device-time attribution (utils/devprof.py,
         # tpu.profile_sample): per-dispatch-kind DEVICE-duration
         # percentiles + the dispatch-gap distribution/share, riding the

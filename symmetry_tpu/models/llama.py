@@ -582,17 +582,98 @@ def quantize_params(params: dict) -> dict:
     return quantize_tree(params, QUANT_KEYS)
 
 
-def pack_params(params: dict) -> dict:
+def pack_params(params: dict, *, config: ModelConfig | None = None,
+                mesh=None, rules: dict | None = None,
+                report: list | None = None) -> dict:
     """In-place tile-packing of quantized QUANT_KEYS leaves into the
     W8A16 fused-dequant kernel layout (`tpu.fused_dequant`; ops/quant.py
     pack_tree). Layout is routing: qmatmul sends PackedQuantizedTensor
     leaves through the Pallas kernel and leaves everything else on the
-    mixed dot, so per-leaf tileability fallback is automatic. Single-
-    device only — the packed layout has no GSPMD partitioning rule, and
-    the engine refuses the knob on a mesh."""
+    mixed dot, so per-leaf tileability fallback is automatic.
+
+    With `mesh` (+ `config`, required to resolve each leaf's logical
+    axes), packing happens AFTER the sharding decision: every leaf's
+    contraction/output mesh axes come from the SAME logical-axis tree +
+    rules the dense/int8 placement used (packed_shard_axes), tile blocks
+    are picked against the per-shard dims, and the leaf carries its axes
+    so qmatmul routes it through the shard_map'd per-shard kernel.
+    Leaves whose per-shard shape loses tileability stay flat on the
+    mixed dot; pass `report` to collect the (path, reason) degrades."""
     from symmetry_tpu.ops.quant import pack_tree
 
-    return pack_tree(params, QUANT_KEYS)
+    axes = None
+    if mesh is not None:
+        if config is None:
+            raise ValueError("pack_params needs `config` to resolve "
+                             "per-leaf shard axes when packing on a mesh")
+        axes = packed_shard_axes(config, mesh, rules)
+    return pack_tree(params, QUANT_KEYS, axes=axes, mesh=mesh,
+                     report=report)
+
+
+def packed_shard_axes(config: ModelConfig, mesh,
+                      rules: dict | None = None) -> dict:
+    """leaf name -> (k_mesh_axis, n_mesh_axis) for every QUANT_KEYS leaf,
+    resolved from param_logical_axes + the sharding rules — the packed
+    layout shards exactly the axes the flat int8 leaf already did
+    (megatron TP: wq/wk/wv/wg/wu/lm_head column-parallel over the output
+    dim, wo/wd row-parallel over the contraction dim). Mesh axes of size
+    1 resolve to None (nothing to shard)."""
+    from symmetry_tpu.parallel.sharding import DEFAULT_RULES
+
+    rules = DEFAULT_RULES if rules is None else rules
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out: dict = {}
+
+    def resolve(logical):
+        ax = rules.get(logical) if logical is not None else None
+        return ax if ax is not None and sizes.get(ax, 1) > 1 else None
+
+    def visit(node):
+        for name, child in node.items():
+            if isinstance(child, dict):
+                visit(child)
+            elif name in QUANT_KEYS:
+                out[name] = (resolve(child[-2]), resolve(child[-1]))
+
+    visit(param_logical_axes(config))
+    return out
+
+
+def packed_logical_axes(axes: dict, params: dict) -> dict:
+    """Map a dense logical-axes tree to one matching a (possibly packed)
+    params tree, so parallel/sharding.shardings_for composes for packed
+    trees exactly as it does for flat int8 ones. A packed q keeps the
+    dense dims' names on its tile-GRID dims and replicates the tile dims
+    — [.., K/bk, N/bn, bk, bn] gets dense axes + (None, None) — because
+    pack_quantized picks blocks against the per-shard dims, so sharding
+    the grid dims IS sharding the weight. The scale maps as in
+    quantized_logical_axes. Aux (mesh + axis names) is copied from the
+    params leaf so the two trees stay structurally identical (the aux
+    rides the treedef)."""
+    from symmetry_tpu.ops.quant import PackedQuantizedTensor
+
+    def visit(node, pnode):
+        out = {}
+        for name, child in node.items():
+            leaf = pnode.get(name) if isinstance(pnode, dict) else None
+            if isinstance(child, dict):
+                out[name] = visit(child, leaf if isinstance(leaf, dict)
+                                  else {})
+            elif isinstance(leaf, PackedQuantizedTensor):
+                out[name] = PackedQuantizedTensor(
+                    q=child + (None, None),
+                    scale=child[:-2] + child[-1:],
+                    k_axis=leaf.k_axis, n_axis=leaf.n_axis, mesh=leaf.mesh)
+            elif name in QUANT_KEYS and isinstance(
+                    leaf, QuantizedTensor):
+                out[name] = QuantizedTensor(
+                    q=child, scale=child[:-2] + child[-1:])
+            else:
+                out[name] = child
+        return out
+
+    return visit(axes, params)
 
 
 def quantized_logical_axes(axes: dict) -> dict:

@@ -188,7 +188,7 @@ def w8a16_supports(k: int, n: int, backend: str) -> bool:
 
 
 def _w8a16_kernel(x_ref, w_ref, ws_ref, o_ref, acc_scr, *, n_k: int,
-                  out_dtype):
+                  out_dtype, apply_scale: bool = True):
     k = pl.program_id(2)
 
     @pl.when(k == 0)
@@ -210,22 +210,32 @@ def _w8a16_kernel(x_ref, w_ref, ws_ref, o_ref, acc_scr, *, n_k: int,
 
     @pl.when(k == n_k - 1)
     def _():
-        o_ref[:] = (acc_scr[:] * ws_ref[:]).astype(out_dtype)
+        acc = acc_scr[:]
+        if apply_scale:
+            acc = acc * ws_ref[:]
+        o_ref[:] = acc.astype(out_dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("out_dtype", "apply_scale", "interpret"))
 def w8a16_matmul(
     x: jnp.ndarray,        # [M, K] float (bf16/f32)
     w_tiles: jnp.ndarray,  # [K//bk, N//bn, bk, bn] int8 (pack_quantized)
     w_scale: jnp.ndarray,  # [N] f32 per-output-channel
     *,
     out_dtype=None,
+    apply_scale: bool = True,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """x @ dequant(w) with the weight streamed as pre-packed int8 tiles
     and dequantized in VMEM — semantically identical to ops/quant.qmatmul
     on the unpacked QuantizedTensor: (x @ q) accumulated f32, scaled per
-    output channel, cast back to the activation dtype."""
+    output channel, cast back to the activation dtype.
+
+    apply_scale=False leaves the epilogue scale off (the f32 accumulator
+    casts out raw) — the row-parallel sharded path sums the per-shard
+    partials FIRST and scales after the reduce, matching the unfused
+    GSPMD mixed dot's reduce-then-scale order exactly."""
     M, K = x.shape
     n_kt, n_nt, bk, bn = w_tiles.shape
     assert n_kt * bk == K, (w_tiles.shape, x.shape)
@@ -239,7 +249,8 @@ def w8a16_matmul(
     ws = w_scale.astype(jnp.float32).reshape(1, N)
 
     return pl.pallas_call(
-        functools.partial(_w8a16_kernel, n_k=n_kt, out_dtype=out_dtype),
+        functools.partial(_w8a16_kernel, n_k=n_kt, out_dtype=out_dtype,
+                          apply_scale=apply_scale),
         grid=(M // bm, n_nt, n_kt),
         in_specs=[
             pl.BlockSpec((bm, bk), lambda m, n, k: (m, k)),
@@ -256,7 +267,8 @@ def w8a16_matmul(
 
 
 def w8a16_apply(x: jnp.ndarray, w_tiles: jnp.ndarray,
-                w_scale: jnp.ndarray) -> jnp.ndarray:
+                w_scale: jnp.ndarray, *, out_dtype=None,
+                apply_scale: bool = True) -> jnp.ndarray:
     """qmatmul's fused-path entry: any leading batch shape on `x`,
     flattened to rows for the kernel. Falls back to the mixed dot on an
     unpacked view for row counts the kernel can't tile (never an engine
@@ -267,13 +279,66 @@ def w8a16_apply(x: jnp.ndarray, w_tiles: jnp.ndarray,
         M *= d
     n_kt, n_nt, bk, bn = w_tiles.shape
     N = n_nt * bn
+    out_dtype = out_dtype or x.dtype
     if M > W8A16_BLOCK_M and pick_w8a16_block(M, W8A16_BLOCK_M,
                                               floor=64) is None:
-        from symmetry_tpu.ops.quant import (
-            PackedQuantizedTensor, qmatmul, unpack_quantized)
-
-        return qmatmul(x, unpack_quantized(
-            PackedQuantizedTensor(q=w_tiles, scale=w_scale)))
+        # Mixed dot on an unpacked view, honouring the same out_dtype /
+        # apply_scale contract as the kernel path.
+        q = jnp.swapaxes(w_tiles, -3, -2).reshape(K, N)
+        y = jax.lax.dot_general(
+            x, q,
+            dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if apply_scale:
+            y = y * w_scale
+        return y.astype(out_dtype)
     out = w8a16_matmul(x.reshape(M, K), w_tiles, w_scale,
+                       out_dtype=out_dtype, apply_scale=apply_scale,
                        interpret=jax.default_backend() != "tpu")
     return out.reshape(*lead, N)
+
+
+def w8a16_apply_sharded(x: jnp.ndarray, w) -> jnp.ndarray:
+    """qmatmul's fused path for a mesh-sharded PackedQuantizedTensor
+    (ops/quant.py — the leaf carries mesh + axis names as static aux):
+    one shard_map whose body runs the SAME per-shard kernel on the local
+    tiles. Column-parallel (n_axis set): every shard holds the full K
+    and its N-slice — no collective, the output stays N-sharded, exactly
+    where megatron TP wants wq/wk/wv/wg/wu/lm_head outputs. Row-parallel
+    (k_axis set): each shard contracts its K-slice with the epilogue
+    scale OFF, the f32 partials psum over the axis, and the per-output-
+    channel scale applies after the reduce — the identical reduce-then-
+    scale order the unfused GSPMD mixed dot lowers to, so fused and
+    unfused mesh builds agree token for token.
+
+    Specs are rebuilt from the leaf's static aux at trace time (ndim is
+    all that varies — lax.scan strips the layers dim off the arrays but
+    not the aux), which is what lets the same leaf serve every trunk
+    program (prefill/chunk/decode/verify) with zero extra plumbing."""
+    from jax.sharding import PartitionSpec as P
+
+    from symmetry_tpu.utils.compat import shard_map
+
+    mesh, k_ax, n_ax = w.mesh, w.k_axis, w.n_axis
+    data = dict(zip(mesh.axis_names, mesh.devices.shape)).get("data", 1)
+    # Keep activations batch-sharded through the kernel when they are
+    # (trace-time static shapes); otherwise run full rows per shard.
+    bspec = ("data" if data > 1 and x.ndim >= 2 and x.shape[0] % data == 0
+             else None)
+    lead = (None,) * (x.ndim - 2)
+    x_spec = P(bspec, *lead, k_ax)
+    q_spec = P(*(None,) * (w.q.ndim - 4), k_ax, n_ax, None, None)
+    s_spec = P(*(None,) * (w.scale.ndim - 1), n_ax)
+    o_spec = P(bspec, *lead, n_ax)
+
+    def body(xl, ql, sl):
+        if k_ax is None:
+            return w8a16_apply(xl, ql, sl)
+        part = w8a16_apply(xl, ql, sl, out_dtype=jnp.float32,
+                           apply_scale=False)
+        y = jax.lax.psum(part, k_ax)
+        return (y * sl).astype(x.dtype)
+
+    return shard_map(body, mesh=mesh,
+                     in_specs=(x_spec, q_spec, s_spec),
+                     out_specs=o_spec, check_rep=False)(x, w.q, w.scale)

@@ -24,17 +24,47 @@ class QuantizedTensor(NamedTuple):
     scale: jnp.ndarray  # f32, weight shape minus the contraction dim
 
 
-class PackedQuantizedTensor(NamedTuple):
+@jax.tree_util.register_pytree_node_class
+class PackedQuantizedTensor:
     """Tile-packed int8 weight for the fused W8A16 dequant matmul
     (ops/qmm.py w8a16_matmul, `tpu.fused_dequant`): the flat [.., K, N]
     int8 payload re-laid-out as [.., K/bk, N/bn, bk, bn] so each kernel
     grid step DMAs ONE contiguous tile from HBM. Same pytree discipline
     as QuantizedTensor — stacks under lax.scan (the leading layers dim
     strips off both leaves together) and donates like a dense leaf. The
-    scale stays the flat per-output-channel [.., N]."""
+    scale stays the flat per-output-channel [.., N].
 
-    q: jnp.ndarray      # int8 [.., K/bk, N/bn, bk, bn] tile layout
-    scale: jnp.ndarray  # f32 [.., N] per-output-channel
+    Mesh-aware: `k_axis`/`n_axis` name the MESH axes the weight's
+    contraction/output dims are sharded over (None = replicated), and
+    `mesh` is the Mesh itself. They ride the treedef as static aux data
+    — lax.scan strips the stacked layers dim off the arrays while the
+    axis names survive untouched, so qmatmul can rebuild per-rank
+    PartitionSpecs from ndim at trace time and route the leaf through
+    its shard_map'd per-shard kernel (ops/qmm.py w8a16_apply_sharded).
+    A leaf packed without a mesh (or with both axes None) keeps the
+    plain single-device dispatch."""
+
+    __slots__ = ("q", "scale", "k_axis", "n_axis", "mesh")
+
+    def __init__(self, q, scale, *, k_axis: str | None = None,
+                 n_axis: str | None = None, mesh=None):
+        self.q = q          # int8 [.., K/bk, N/bn, bk, bn] tile layout
+        self.scale = scale  # f32 [.., N] per-output-channel
+        self.k_axis = k_axis
+        self.n_axis = n_axis
+        self.mesh = mesh
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.k_axis, self.n_axis, self.mesh)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        q, scale = children
+        return cls(q, scale, k_axis=aux[0], n_axis=aux[1], mesh=aux[2])
+
+    def __repr__(self):
+        return (f"PackedQuantizedTensor(q={self.q!r}, scale={self.scale!r}, "
+                f"k_axis={self.k_axis!r}, n_axis={self.n_axis!r})")
 
 
 def quantize(w: jnp.ndarray, *, contract_axis: int = -2) -> QuantizedTensor:
@@ -80,8 +110,10 @@ def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
     noise for zero measured gain, the mixed dot serves the default path.
     """
     if isinstance(w, PackedQuantizedTensor):
-        from symmetry_tpu.ops.qmm import w8a16_apply
+        from symmetry_tpu.ops.qmm import w8a16_apply, w8a16_apply_sharded
 
+        if w.mesh is not None and (w.k_axis or w.n_axis):
+            return w8a16_apply_sharded(x, w)
         return w8a16_apply(x, w.q, w.scale)
     if isinstance(w, QuantizedTensor):
         y = jax.lax.dot_general(
@@ -121,45 +153,122 @@ def quantize_tree(params: dict, keys: tuple[str, ...]) -> dict:
 # bit-equivalent to its flat original (unpack_quantized round-trips).
 
 
+def _pack_body(q: jnp.ndarray, bk: int, bn: int) -> jnp.ndarray:
+    *lead, K, N = q.shape
+    q = q.reshape(*lead, K // bk, bk, N // bn, bn)
+    return jnp.swapaxes(q, -3, -2)
+
+
 @functools.partial(jax.jit, static_argnames=("bk", "bn"))
 def _pack_leaf(q: jnp.ndarray, bk: int, bn: int) -> jnp.ndarray:
     """[.., K, N] int8 → [.., K/bk, N/bn, bk, bn]. The tile transpose is
     a real copy; pack_tree replaces each leaf as it goes, so the flat
     original is freed right after and peak HBM overhead stays one int8
     leaf (~0.5 GB for an 8B lm_head), paid once at load."""
-    *lead, K, N = q.shape
-    q = q.reshape(*lead, K // bk, bk, N // bn, bn)
-    return jnp.swapaxes(q, -3, -2)
+    return _pack_body(q, bk, bn)
 
 
-def pack_quantized(qt: QuantizedTensor, *, bk: int | None = None,
-                   bn: int | None = None):
-    """Pack one QuantizedTensor into the fused kernel's tile layout, or
-    return it unchanged when its shape doesn't tile on this backend (the
-    leaf then keeps the XLA mixed dot — per-leaf fallback, no all-or-
-    nothing). Explicit bk/bn override the kernel defaults (probe sweeps).
-    """
+def packed_q_spec(ndim: int, k_axis: str | None, n_axis: str | None):
+    """PartitionSpec for a packed q of `ndim` dims ([.., K/bk, N/bn, bk,
+    bn]): the K-grid dim carries the contraction shard, the N-grid dim
+    the output shard, tile dims never shard. Because the per-shard tile
+    counts divide (pack_quantized picks bk/bn against PER-SHARD K/N),
+    slicing the global packed array along the grid dims IS the pack of
+    the flat local shard — shard-wise bit-identical layouts."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(*(None,) * (ndim - 4), k_axis, n_axis, None, None)
+
+
+def packed_scale_spec(ndim: int, n_axis: str | None):
+    """PartitionSpec for a packed scale [.., N]: with the output channels."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(*(None,) * (ndim - 1), n_axis)
+
+
+def _axis_size(mesh, axis: str | None) -> int:
+    if mesh is None or axis is None:
+        return 1
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+
+
+def _pack_quantized_report(
+    qt: QuantizedTensor, *, bk: int | None = None, bn: int | None = None,
+    k_axis: str | None = None, n_axis: str | None = None, mesh=None,
+) -> tuple:
+    """pack_quantized plus the degrade reason: returns (leaf, reason)
+    where reason is None when the leaf packed, else one of
+    "untileable" (single-device shape the kernel can't tile),
+    "shard_indivisible" (mesh axis doesn't divide K/N at all), or
+    "shard_untileable" (per-shard K/N loses tileability)."""
     from symmetry_tpu.ops import qmm
 
+    # A mesh axis of size 1 shards nothing — treat as replicated so the
+    # leaf keeps the cheaper single-device dispatch.
+    k_axis = k_axis if _axis_size(mesh, k_axis) > 1 else None
+    n_axis = n_axis if _axis_size(mesh, n_axis) > 1 else None
+    if k_axis is None and n_axis is None:
+        mesh = None
+    k_parts = _axis_size(mesh, k_axis)
+    n_parts = _axis_size(mesh, n_axis)
+
     *_, K, N = qt.q.shape
+    if K % k_parts or N % n_parts:
+        return qt, "shard_indivisible"
+    K_loc, N_loc = K // k_parts, N // n_parts
     if bk is None and bn is None:
-        if not qmm.w8a16_supports(K, N, jax.default_backend()):
-            return qt
+        # Blocks are chosen against the PER-SHARD dims so the tile grid
+        # [K/bk, N/bn] divides evenly across the mesh axes — that is
+        # what makes the sharded packed layout equal the per-shard pack.
         floor_k = qmm._TPU_MIN_BK if jax.default_backend() == "tpu" else 8
         floor_n = qmm._TPU_MIN_BN if jax.default_backend() == "tpu" else 8
-        bk = qmm.pick_w8a16_block(K, qmm.W8A16_BLOCK_K, floor=floor_k)
-        bn = qmm.pick_w8a16_block(N, qmm.W8A16_BLOCK_N, floor=floor_n)
+        bk = qmm.pick_w8a16_block(K_loc, qmm.W8A16_BLOCK_K, floor=floor_k)
+        bn = qmm.pick_w8a16_block(N_loc, qmm.W8A16_BLOCK_N, floor=floor_n)
+        if bk is None or bn is None:
+            return qt, ("shard_untileable" if mesh is not None
+                        else "untileable")
     elif bk is None or bn is None:
         raise ValueError("pack_quantized tile override needs BOTH bk and "
                          "bn (a partial override would mix a default-"
                          "derived block with the explicit one)")
-    elif K % bk or N % bn:
+    elif K_loc % bk or N_loc % bn:
         # Explicit overrides (probe sweeps) fail loudly, not deep inside
         # the jitted reshape — the default path's fallback-to-flat is for
         # load-time packing only.
         raise ValueError(f"tiles ({bk}, {bn}) do not divide weight "
-                         f"({K}, {N})")
-    return PackedQuantizedTensor(q=_pack_leaf(qt.q, bk, bn), scale=qt.scale)
+                         f"({K}, {N}) per-shard ({K_loc}, {N_loc})")
+    if mesh is None:
+        tiles = _pack_leaf(qt.q, bk, bn)
+    else:
+        # Repack WITH the output placement declared, so the tile copy
+        # lands shard-local instead of gathering and re-scattering.
+        from jax.sharding import NamedSharding
+
+        spec = packed_q_spec(qt.q.ndim + 2, k_axis, n_axis)
+        tiles = jax.jit(
+            functools.partial(_pack_body, bk=bk, bn=bn),
+            out_shardings=NamedSharding(mesh, spec))(qt.q)
+    return PackedQuantizedTensor(q=tiles, scale=qt.scale, k_axis=k_axis,
+                                 n_axis=n_axis, mesh=mesh), None
+
+
+def pack_quantized(qt: QuantizedTensor, *, bk: int | None = None,
+                   bn: int | None = None, k_axis: str | None = None,
+                   n_axis: str | None = None, mesh=None):
+    """Pack one QuantizedTensor into the fused kernel's tile layout, or
+    return it unchanged when its shape doesn't tile on this backend (the
+    leaf then keeps the XLA mixed dot — per-leaf fallback, no all-or-
+    nothing). Explicit bk/bn override the kernel defaults (probe sweeps).
+
+    With `mesh` + `k_axis`/`n_axis` (mesh axis names for the contraction
+    and output dims), the pack happens AFTER the sharding decision: tile
+    blocks are picked against the per-shard K/N, the repack jit declares
+    the packed NamedSharding, and the leaf carries the axis names so
+    qmatmul routes it through the shard_map'd per-shard kernel."""
+    leaf, _ = _pack_quantized_report(qt, bk=bk, bn=bn, k_axis=k_axis,
+                                     n_axis=n_axis, mesh=mesh)
+    return leaf
 
 
 def unpack_quantized(pt: PackedQuantizedTensor) -> QuantizedTensor:
@@ -169,21 +278,43 @@ def unpack_quantized(pt: PackedQuantizedTensor) -> QuantizedTensor:
     return QuantizedTensor(q=q, scale=pt.scale)
 
 
-def pack_tree(params: dict, keys: tuple[str, ...]) -> dict:
+def pack_tree(params: dict, keys: tuple[str, ...], *,
+              axes: dict | None = None, mesh=None,
+              report: list | None = None) -> dict:
     """Pack the named QuantizedTensor leaves of a params dict in place
     (mirrors quantize_tree). Only 2-D weights and [L, K, N] layer stacks
     pack — MoE expert stacks ([L, E, K, N]) and untileable shapes keep
-    the flat layout and the mixed dot."""
+    the flat layout and the mixed dot.
 
-    def visit(node):
+    `axes` maps leaf name -> (k_mesh_axis, n_mesh_axis) for mesh-aware
+    packing (models/llama.py pack_params resolves it from the logical-
+    axis tree + sharding rules); `report`, when given, collects
+    (path, reason) for every int8 leaf that stayed flat so the caller
+    can log and count the degrades instead of silently eating them."""
+
+    def note(path, reason):
+        if report is not None:
+            report.append((path, reason))
+
+    def visit(node, prefix):
         for name, child in list(node.items()):
             if isinstance(child, dict):
-                visit(child)
-            elif (name in keys and isinstance(child, QuantizedTensor)
-                  and child.q.ndim in (2, 3)):
-                node[name] = pack_quantized(child)
+                visit(child, prefix + (name,))
+            elif name in keys and isinstance(child, QuantizedTensor):
+                path = "/".join(prefix + (name,))
+                if child.q.ndim not in (2, 3):
+                    # MoE expert stacks [L, E, K, N]: the kernel has no
+                    # expert grid dim; the mixed dot serves them.
+                    note(path, "expert_stack")
+                    continue
+                k_ax, n_ax = (axes or {}).get(name, (None, None))
+                leaf, reason = _pack_quantized_report(
+                    child, k_axis=k_ax, n_axis=n_ax, mesh=mesh)
+                node[name] = leaf
+                if reason is not None:
+                    note(path, reason)
 
-    visit(params)
+    visit(params, ())
     return params
 
 

@@ -64,7 +64,10 @@ def shardings_for(
     # A logical-axes LEAF is a plain tuple of axis names. QuantizedTensor
     # is also a tuple (NamedTuple) but is a CONTAINER here — its q/scale
     # fields each hold their own axes tuple — so it must be recursed into,
-    # not handed to logical_to_spec whole.
+    # not handed to logical_to_spec whole. PackedQuantizedTensor is a
+    # registered pytree node (not a tuple), so tree.map recurses into it
+    # on its own — models/llama.py packed_logical_axes builds axes trees
+    # with packed containers and this map composes unchanged.
     return jax.tree.map(
         lambda axes: NamedSharding(mesh, logical_to_spec(axes, rules)),
         logical_axes,

@@ -48,10 +48,13 @@ class TpuConfig:
     # pre-packed into the kernel's tile layout at load and dequantized in
     # VMEM inside the double-buffered DMA/matmul pipeline, instead of
     # XLA's full bf16 weight materialization per decode step (the
-    # rounds-3/4 convert wall). Requires quantization: int8 and a
-    # single-device engine (no GSPMD rule for the packed layout). Off by
-    # default pending the on-chip A/B (BASELINE.md decode-floor section;
-    # bench.py --fused-dequant and tools/probe_w8a16.py measure it).
+    # rounds-3/4 convert wall). Requires quantization: int8; composes
+    # with tpu.mesh — tiles pack against the PER-SHARD dims after the
+    # sharding decision, column-/row-parallel leaves run a shard_map'd
+    # per-shard kernel, and a leaf whose shard loses tileability keeps
+    # the mixed dot (counted in sym_qmm_fallback_total, never silent).
+    # Off by default pending the on-chip A/B (BASELINE.md decode-floor
+    # section; bench.py --fused-dequant / tools/probe_w8a16.py).
     fused_dequant: bool = False
     max_batch_size: int = 8            # decode slots (continuous batching)
     max_seq_len: int = 2048            # KV capacity per slot

@@ -132,6 +132,15 @@ class MetricName:
     PREFIX_BLOCKS_EVICTED = "sym_prefix_blocks_evicted_total"
     PREFIX_HIT_DEPTH = "sym_prefix_radix_hit_depth_blocks"
 
+    # --- fused-dequant degrade ledger (engine/engine.py): one count per
+    #     int8 weight leaf that stays on the XLA mixed dot instead of
+    #     the packed W8A16 kernel at load, labeled with the degrade
+    #     reason (untileable | shard_indivisible | shard_untileable |
+    #     expert_stack | stage_axis). Booked so a mesh build that quietly
+    #     lost its fused leaves shows up in symtop, never as a silent
+    #     bandwidth regression.
+    QMM_FALLBACK = "sym_qmm_fallback_total"                  # {reason}
+
     # --- engine host pipe (engine/host.py)
     HOST_PIPE_WRITES = "sym_host_pipe_writes_total"
     HOST_PIPE_BYTES = "sym_host_pipe_bytes_total"

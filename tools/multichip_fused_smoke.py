@@ -1,0 +1,106 @@
+"""Multichip fused-dequant smoke: token identity pinned three ways.
+
+Boots three tiny engines on a virtual 8-device CPU mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=8, self-pinned in a
+subprocess so the ambient backend doesn't matter):
+
+  1. fused TP=2   — packed tile layout sharded over the model axis,
+                    shard_map'd Pallas kernel (interpret mode on CPU)
+  2. unfused TP=2 — same mesh, same shardings, XLA mixed dot
+  3. fused TP=1   — single device, the pre-mesh packed path
+
+and asserts, over a greedy prompt + 10 decode steps:
+
+  * token identity across all three builds — the sharded fused kernel
+    changes the schedule, never the numbers (psum-then-scale matches
+    the mixed dot's reduce order, see ops/qmm.py w8a16_apply_sharded);
+  * zero steady-state recompiles on every build: compile_cache_sizes()
+    taken after warmup must equal the counts after real traffic — the
+    engine warmup's dispatch-cache closure pass covers the serving
+    signature classes (engine.py warmup).
+
+CI runs this on every push (ci.yml "Multichip fused smoke").
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SNIPPET = r"""
+import jax, jax.numpy as jnp, numpy as np
+jax.config.update("jax_default_matmul_precision", "highest")
+from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
+from symmetry_tpu.engine.tokenizer import ByteTokenizer
+from symmetry_tpu.models import init_params, param_logical_axes, preset
+from symmetry_tpu.models.llama import quantize_params
+from symmetry_tpu.parallel import MeshSpec, build_mesh, shardings_for
+
+assert jax.device_count() == 8, jax.device_count()
+
+def run(fused, tp):
+    cfg = preset("tiny-mha")
+    params = init_params(cfg, jax.random.key(0), jnp.float32)
+    mesh = None
+    if tp > 1:
+        mesh = build_mesh(MeshSpec(data=1, model=tp))
+        params = jax.device_put(
+            params, shardings_for(param_logical_axes(cfg), mesh))
+    params = quantize_params(params)
+    eng = InferenceEngine(cfg, params, ByteTokenizer(), mesh=mesh,
+                          max_slots=2, max_seq_len=64,
+                          prefill_buckets=(16,), cache_dtype=jnp.float32,
+                          fused_dequant=fused)
+    eng.warmup()
+    warm = eng.compile_cache_sizes()
+    toks = [eng.prefill_and_insert(0, list(b"mesh parity"),
+                                   SamplingParams())]
+    for _ in range(10):
+        toks.append(int(eng.decode_steps()[0][0]))
+    # a second admission + decode wave, so the steady-state check sees
+    # both burst and in-flight signature classes
+    eng.prefill_and_insert(1, list(b"second"), SamplingParams())
+    for _ in range(3):
+        eng.decode_steps()
+    served = eng.compile_cache_sizes()
+    assert served == warm, (
+        f"steady-state recompile (fused={fused}, tp={tp}): "
+        f"{warm} -> {served}")
+    return toks
+
+tp2_fused = run(True, 2)
+tp2_unfused = run(False, 2)
+single_fused = run(True, 1)
+assert tp2_fused == tp2_unfused, (tp2_fused, tp2_unfused)
+assert tp2_fused == single_fused, (tp2_fused, single_fused)
+print("MULTICHIP_FUSED_OK toks=%s" % (tp2_fused,))
+"""
+
+
+def main() -> int:
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+           and not k.startswith("TPU")
+           and not k.startswith("PJRT")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.run([sys.executable, "-c", _SNIPPET], env=env,
+                          cwd=REPO, text=True, capture_output=True,
+                          timeout=900)
+    sys.stdout.write(proc.stdout)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-3000:])
+        print("[multichip_fused_smoke] FAILED", file=sys.stderr)
+        return 1
+    assert "MULTICHIP_FUSED_OK" in proc.stdout
+    print("[multichip_fused_smoke] three-way token identity + zero "
+          "steady-state recompiles: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
