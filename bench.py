@@ -407,10 +407,15 @@ def run_e2e_client_worker() -> int:
             finally:
                 await session.close()
             t_done = _time.monotonic()
+            # symledger cost block from the end frame (tpu.ledger on):
+            # the request's attributed device time rides the capture so
+            # the parent can report cost percentiles + wasted share.
+            costs = getattr(session, "last_costs", None)
             return {"ttft": (t_first or t_done) - t_send,
                     "e2e": t_done - t_send, "chars": chars,
                     "tokens": tokens, "t_first": t_first or t_done,
-                    "t_done": t_done, "stamps": stamps}
+                    "t_done": t_done, "stamps": stamps,
+                    **({"costs": costs} if costs else {})}
 
         sessions_up = [0]
         all_connected = asyncio.Event()
@@ -1237,6 +1242,7 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             history: list[dict] = []
             turn_ttfts: list[float] = []
             stamps: list[tuple[float, int]] = []  # (arrival, chars)
+            cost_blocks: list[dict] = []  # per-turn symledger blocks
             tokens = 0
             t_first_any = None
             t_begin = _time.perf_counter()
@@ -1264,6 +1270,9 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                             stamps.append((now, len(delta)))
                         tokens += int(
                             (session.last_usage or {}).get("tokens", 0))
+                        costs = getattr(session, "last_costs", None)
+                        if costs:
+                            cost_blocks.append(costs)
                     except ProviderBusyError as exc:
                         # Overload shedding: an explicit, immediate
                         # rejection — the bounded-latency alternative to
@@ -1282,7 +1291,9 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
             return {"ttft": turn_ttfts[0], "e2e": t_done - t_begin,
                     "chars": sum(c for _, c in stamps), "tokens": tokens,
                     "t_first": t_first_any or t_done, "t_done": t_done,
-                    "stamps": stamps, "turn_ttfts": turn_ttfts}
+                    "stamps": stamps, "turn_ttfts": turn_ttfts,
+                    **({"cost_blocks": cost_blocks} if cost_blocks
+                       else {})}
 
         engine_stats: dict | None = None
         provider_stats: dict | None = None
@@ -1929,6 +1940,51 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                           f"high-water {px['hbm_high_water_bytes']} B, "
                           f"hit rate {px['hit_rate']}", file=sys.stderr)
 
+        # symledger rollup: per-request cost blocks from the end frames
+        # (client-observed, so percentiles are over exactly the admitted
+        # fleet) + the provider's own SLO-gated goodput window. Absent
+        # when tpu.ledger is off — the A/B overhead run's other arm.
+        ledger_block = None
+        cost_blocks = [r["costs"] for r in results if r.get("costs")]
+        for r in results:
+            cost_blocks.extend(r.get("cost_blocks") or [])
+        if cost_blocks:
+            devs = sorted(float(c.get("device_total_s") or 0.0)
+                          for c in cost_blocks)
+            queues = sorted(float(c.get("queue_s") or 0.0)
+                            for c in cost_blocks)
+            device = sum(devs)
+            wasted = sum(float(c.get("wasted_total_s") or 0.0)
+                         for c in cost_blocks)
+            saved = sum(float(c.get("saved_s") or 0.0)
+                        for c in cost_blocks)
+            ctokens = sum(int(c.get("tokens") or 0) for c in cost_blocks)
+            ledger_block = {
+                "requests": len(cost_blocks),
+                "source": cost_blocks[0].get("source"),
+                "device_s_p50": round(pct(devs, 0.50), 6),
+                "device_s_p99": round(pct(devs, 0.99), 6),
+                "device_s_total": round(device, 6),
+                "queue_s_p99": round(pct(queues, 0.99), 6),
+                "wasted_s_total": round(wasted, 6),
+                "wasted_share": (round(wasted / (device + wasted), 4)
+                                 if device + wasted > 0 else None),
+                "saved_s_total": round(saved, 6),
+                "goodput_tokens_per_device_s": (
+                    round(ctokens / device, 2) if device > 0 else None),
+            }
+            gp = (provider_stats or {}).get("goodput")
+            if gp:
+                # The provider-side verdict (SLO-attaining tokens only)
+                # next to the raw client-side ratio above.
+                ledger_block["slo_goodput"] = gp
+            print(f"[bench] ledger ({ledger_block['source']}): device "
+                  f"p50/p99 {ledger_block['device_s_p50']}/"
+                  f"{ledger_block['device_s_p99']}s per request | wasted "
+                  f"share {ledger_block['wasted_share']} | goodput "
+                  f"{ledger_block['goodput_tokens_per_device_s']} "
+                  f"tok/device-s", file=sys.stderr)
+
         return {
             "metric": f"e2e serving tok/s ({preset_name} {dtype_label}, "
                       f"{clients} streaming clients over TCP"
@@ -1978,6 +2034,9 @@ def run_e2e(preset_name: str, *, clients: int, slots: int, max_new: int,
                if speculative_block else {}),
             **({"multi_turn": multi_turn_block} if multi_turn_block
                else {}),
+            # symledger rollup: cost percentiles, wasted share, and the
+            # goodput row — the capture's attribution headline.
+            **({"ledger": ledger_block} if ledger_block else {}),
             # Satellite of the speculative PR: the per-stage TTFT
             # breakdown lands in the JSON capture, not just stderr text.
             **({"ttft_stages": ttft_stages} if ttft_stages else {}),

@@ -138,6 +138,22 @@ SPECS: tuple[ResourceSpec, ...] = (
         receiver_hint="journal",
         releases=(ReleaseSpec(frozenset({"release"}), idempotent=True),),
     ),
+    ResourceSpec(
+        # The symledger cost account (engine/ledger.py): track() opens
+        # a request's entry (None while tpu.ledger is off), finish()
+        # builds its wire costs block, release() folds a handoff
+        # without one — both idempotent, so every exit path may close
+        # unconditionally. A leaked entry is a request whose device
+        # seconds never fold into the aggregates: conservation silently
+        # stops closing. The receiver hint keeps this spec off the
+        # resume journal's same-named `track`.
+        name="ledger-entry",
+        acquire=frozenset({"track"}),
+        receiver_hint="ledger",
+        optional=True,
+        releases=(ReleaseSpec(frozenset({"finish", "release"}),
+                              idempotent=True),),
+    ),
 )
 
 _ALL_ACQUIRES = frozenset().union(*(s.acquire for s in SPECS))

@@ -212,7 +212,11 @@ class ProviderSession:
         self._peer = peer
         self._details = details
         # Usage of the last completed chat, from inferenceEnded:
-        # {"tokens": N, "chunks": M} (engine backends count exact tokens).
+        # {"tokens": N, "chunks": M} (engine backends count exact
+        # tokens), plus — when the provider runs with tpu.ledger on —
+        # a "costs" block: the request's symledger attribution
+        # (device_s{phase}, wasted_s{reason}, queue_s, emit_s, saved_s)
+        # as the scheduler booked it. See last_costs.
         self.last_usage: dict | None = None
         self._queues: dict[str, asyncio.Queue] = {}
         self._stats_q: asyncio.Queue = asyncio.Queue()
@@ -232,6 +236,16 @@ class ProviderSession:
         self.tracer = tracer if tracer is not None else Tracer()
         self.clock_offset: float | None = None
         self._clock_rtt = float("inf")
+
+    @property
+    def last_costs(self) -> dict | None:
+        """The last completed chat's symledger cost block — what the
+        request actually cost in attributed device time, as stamped on
+        its end frame. None when the provider serves with tpu.ledger
+        off (or no chat has completed on this session)."""
+        usage = self.last_usage
+        costs = usage.get("costs") if isinstance(usage, dict) else None
+        return costs if isinstance(costs, dict) else None
 
     def _ensure_reader(self) -> None:
         if self._reader is None:

@@ -183,7 +183,11 @@ class PoolAutoscaler:
             "pool chip-seconds (sum of member-alive time)")
         self._m_goodput = METRICS.gauge(
             MetricName.AUTOSCALE_GOODPUT,
-            "tokens per chip-second at last tick")
+            "SLO-attaining tokens per chip-second at last tick")
+        self._m_tokens_raw = METRICS.gauge(
+            MetricName.AUTOSCALE_TOKENS_RAW,
+            "cumulative raw token count (pre-ledger goodput numerator, "
+            "kept for series continuity)")
 
     # ----------------------------------------------------------- sensors
 
@@ -202,14 +206,21 @@ class PoolAutoscaler:
     def tick(self, *, burn: dict[str, float] | None = None,
              busy_delta_s: dict[str, float] | None = None,
              tokens_total: float | None = None,
+             tokens_raw: float | None = None,
              applying: bool = False) -> dict[str, Any]:
         """One control step. Inputs: per-SLO fast-window burns
         (SloMonitor.burn_rates()), per-tier device-busy-second deltas
         since the last tick (symprof's measured ratio signal), the
-        cumulative token count (goodput numerator), and whether the
-        previous decision is still being applied. Returns the decision
-        record — every tick produces one, holds included; only non-hold
-        records change the topology (and the decision counter)."""
+        cumulative SLO-ATTAINING token count (the goodput numerator —
+        the ledger's per-request attainment fold; ROADMAP item 5 and
+        DistServe define goodput over tokens that met their SLO, not
+        all tokens), the raw cumulative count (`tokens_raw`, kept as
+        the sym_autoscale_tokens_raw continuity series — pre-ledger
+        callers that still pass only tokens_total get the old
+        behavior), and whether the previous decision is still being
+        applied. Returns the decision record — every tick produces
+        one, holds included; only non-hold records change the topology
+        (and the decision counter)."""
         now = self._clock()
         cfg = self.config
         self.counters["ticks"] += 1
@@ -246,6 +257,8 @@ class PoolAutoscaler:
             "busy_s": {t: round(self._busy[t], 4) for t in TIERS},
             "tokens_total": tokens_total,
         }
+        if tokens_raw is not None:
+            inputs["tokens_raw"] = tokens_raw
 
         # Streaks advance every tick, decision or not. IDLE: a tier is
         # idle when its load sits under the drain floor AND its burn is
@@ -328,6 +341,8 @@ class PoolAutoscaler:
         self._m_chip.set(round(chip_s, 3))
         if goodput is not None:
             self._m_goodput.set(goodput)
+        if tokens_raw is not None:
+            self._m_tokens_raw.set(round(float(tokens_raw), 1))
         return record
 
     # ----------------------------------------------------------- policy

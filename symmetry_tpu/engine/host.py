@@ -292,6 +292,12 @@ class EngineHost:
             out["finish_reason"] = ev.finish_reason
             if ev.error:
                 out["error"] = ev.error
+            if ev.costs is not None:
+                # symledger terminal rider (engine/ledger.py): the
+                # request's attributed cost block rides its finish
+                # event to the provider, which stamps it on the final
+                # stream frame behind tpu.ledger.
+                out["costs"] = ev.costs
             self._reported.pop(req_id, None)
             self._cancelled.discard(req_id)
         return out
@@ -343,7 +349,9 @@ class EngineHost:
             pipeline_depth=int(getattr(self._config.tpu,
                                        "pipeline_depth", 2)),
             handoff=(self._handoff_sink if self._role == "prefill"
-                     else None))
+                     else None),
+            ledger_enabled=bool(getattr(self._config.tpu,
+                                        "ledger", True)))
         # tpu.tracing=False empties every ring (the bench A/B knob); the
         # default leaves the bounded always-on recorder running.
         tracing = bool(getattr(self._config.tpu, "tracing", True))

@@ -32,6 +32,7 @@ from typing import Any, Callable
 import numpy as np
 
 from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
+from symmetry_tpu.engine.ledger import RequestLedger
 from symmetry_tpu.engine.tokenizer import StreamDecoder
 from symmetry_tpu.utils.faults import FAULTS, InjectedFault
 from symmetry_tpu.utils.logging import logger as log
@@ -84,6 +85,10 @@ class GenRequest:
     # and a resume admission with reused > 0 is the cheap seeded
     # re-prefill the resume path exists for (vs a full re-prefill).
     reused_tokens: int = 0
+    # symledger cost account (engine/ledger.py), opened by submit()
+    # while tpu.ledger is on; None otherwise — every booking site is
+    # then one `is not None` branch (the disabled-mode contract).
+    ledger: Any = None
     enqueued_at: float = field(default_factory=time.monotonic)
     # Stamped when the request enters a placement group (the admission
     # moment); re-stamped on re-pick after a budget deferral, so
@@ -125,6 +130,11 @@ class TokenEvent:
     # offset-dedup input).
     tokens_reused: int | None = None
     resumed_from: int | None = None
+    # Terminal-event-only symledger cost block (engine/ledger.py):
+    # device_s{phase} / queue_s / emit_s / wasted_s{reason} / saved_s,
+    # attributed at dispatch granularity. None mid-stream, and None on
+    # terminal events while tpu.ledger is off.
+    costs: dict | None = None
 
 
 @dataclass
@@ -152,7 +162,8 @@ class Scheduler:
                      [list[tuple[GenRequest, TokenEvent]]], None]
                  | None = None,
                  handoff: Callable[[int, GenRequest, int], None]
-                 | None = None) -> None:
+                 | None = None,
+                 ledger_enabled: bool = True) -> None:
         self.engine = engine
         # Disaggregated tier role (engine/disagg/): mirrors the engine's.
         # "prefill" replaces slot activation with the handoff sink — a
@@ -392,6 +403,16 @@ class Scheduler:
         self._spec_emit_hist = Histogram()
         self._last_sync_done: float | None = None
         self._last_sync_kind: str | None = None
+        # symledger (engine/ledger.py, tpu.ledger): per-request device-
+        # time attribution. Source flag: symprof sampling armed makes
+        # the dispatch walls probe-synced ("probed"); otherwise they are
+        # dispatch-thread block time ("blocked"). Disabled cost is one
+        # guarded branch per dispatch — track() returns None, and every
+        # booking site checks `req.ledger is not None` / ledger.enabled.
+        dp0 = getattr(engine, "devprof", None)
+        self.ledger = RequestLedger(
+            enabled=ledger_enabled,
+            measured=dp0 is not None and dp0.enabled)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -434,6 +455,11 @@ class Scheduler:
             self.metrics["resumed_tokens"] += req.resume_offset
             self._m_resumes.inc()
             self._m_resumed_tokens.inc(req.resume_offset)
+        # Cost account opens at submission (None while tpu.ledger is
+        # off). Stored on the request: ownership rides the request
+        # through every exit path, and the terminal-event seams
+        # (_finish / _emit_cb) close it wherever the request dies.
+        req.ledger = self.ledger.track(req.id)
         self._inbox.put(req)
 
     @property
@@ -543,6 +569,12 @@ class Scheduler:
                 "verify_s": round(self.metrics["spec_verify_s"], 3),
                 "tokens_per_dispatch": self._spec_emit_hist.to_dict(),
             }
+        # symledger rider (engine/ledger.py): bounded finished-request
+        # ring + cumulative attribution aggregates, riding the same
+        # host STATS op → provider engine block → bench JSON as every
+        # other block above. Absent entirely while tpu.ledger is off.
+        if self.ledger.enabled:
+            out["ledger"] = self.ledger.stats()
         return out
 
     def trace_export(self) -> dict[str, Any]:
@@ -559,9 +591,14 @@ class Scheduler:
         except BaseException as exc:  # noqa: BLE001 — fatal engine failure
             log.error(f"engine loop died: {exc!r}; failing open streams")
             for slot, active in list(self._slots.items()):
-                self._emit(active, TokenEvent(
+                ev = TokenEvent(
                     text="", token_id=None, done=True, finish_reason="error",
-                    error=f"engine failure: {exc}"))
+                    error=f"engine failure: {exc}")
+                if active.req.ledger is not None:
+                    # Engine death is an exit path too: the entry closes
+                    # and the error event still carries its costs.
+                    ev.costs = active.req.ledger.finish("error")
+                self._emit(active, ev)
                 del self._slots[slot]
             while self._deferred:
                 self._emit_cb(self._deferred.popleft(), TokenEvent(
@@ -652,6 +689,15 @@ class Scheduler:
         dt = time.monotonic() - t0
         self._wmetrics["offloaded_s"] += dt
         self._m_offloaded.observe(dt)
+        if self.ledger.enabled and dt > 0.0:
+            # Best-effort emit attribution: this flush's wall splits
+            # evenly over its events. A request whose finish rode this
+            # very batch already closed its entry (book_emit no-ops) —
+            # emit_s covers the pre-terminal flushes.
+            per = dt / len(batch)
+            for req, _ev in batch:
+                if req.ledger is not None:
+                    req.ledger.book_emit(per)
 
     def _submit_job(self, job: tuple) -> None:
         """Route one emit/bookkeep job: buffered for the worker while
@@ -684,13 +730,14 @@ class Scheduler:
                 text=text, token_id=last_tok,
                 tokens_generated=gen, tokens_emitted=emitted))
         if kind == "finish":
-            _k, active, run, tok, reason, ttft, gen, emitted = job
+            _k, active, run, tok, reason, ttft, gen, emitted, costs = job
             toks = run.tolist() if hasattr(run, "tolist") else list(run)
             text = active.decoder.push_many(toks) if toks else ""
             tail = text + active.decoder.flush()
             return self._decorate(active, TokenEvent(
                 text=tail, token_id=tok, done=True, finish_reason=reason,
-                ttft_s=ttft, tokens_generated=gen, tokens_emitted=emitted))
+                ttft_s=ttft, tokens_generated=gen, tokens_emitted=emitted,
+                costs=costs))
         if kind == "first":
             _k, active, first, ttft = job
             text = active.decoder.push(first)
@@ -882,8 +929,23 @@ class Scheduler:
                 if n_draft[slot]:
                     self._spec_emit_hist.observe(int(n_emit[slot]))
                     self.metrics["spec_tokens"] += int(n_emit[slot])
+            spec_reject = None
+            if self.ledger.enabled:
+                # Per-slot rejected-draft fraction: of the 1 + k_draft
+                # positions this slot's verify lane computed, the ones
+                # past the acceptance point were device work rolled
+                # back — that share of the slot's block attribution is
+                # booked wasted_s{spec_rejected} in _process_block.
+                spec_reject = {}
+                for slot in snapshot:
+                    nd = int(n_draft[slot])
+                    if nd:
+                        rej = nd - (int(n_emit[slot]) - 1)
+                        if rej > 0:
+                            spec_reject[slot] = (rej / (1.0 + nd), rej)
             self._process_block(toks_dev, snapshot, n_valid=n_emit,
-                                dispatched_at=t0m, kind="verify")
+                                dispatched_at=t0m, kind="verify",
+                                spec_reject=spec_reject)
         else:
             self._process_block(toks_dev, snapshot, dispatched_at=t0m)
 
@@ -891,7 +953,9 @@ class Scheduler:
                        snapshot: dict[int, _ActiveSlot],
                        n_valid: np.ndarray | None = None,
                        dispatched_at: float | None = None,
-                       kind: str = "decode_block") -> None:
+                       kind: str = "decode_block",
+                       spec_reject: dict[int, tuple[float, int]]
+                       | None = None) -> None:
         """Sync one decode block to host and stream its tokens out.
 
         Batched pass (the block-granular emit path): ONE vectorized EOS
@@ -954,12 +1018,35 @@ class Scheduler:
         K = toks.shape[0]
         eos_mask = (np.isin(toks, self._eos_arr) if self._eos_arr.size
                     else np.zeros(toks.shape, dtype=bool))
+        # symledger block attribution: the sync wall splits EQUALLY over
+        # the snapshot lanes still live at sync (occupancy split — every
+        # live lane's tokens rode the same device pass). One guarded
+        # branch per dispatch when the ledger is off. A block whose
+        # every lane went stale still burned the wall: booked
+        # unattributed so conservation closes.
+        led_share = 0.0
+        led_phase = "verify" if kind == "verify" else "decode"
+        if self.ledger.enabled:
+            wall = t1 - t0
+            n_live = sum(1 for s, a in snapshot.items()
+                         if self._slots.get(s) is a)
+            if n_live:
+                led_share = wall / n_live
+            else:
+                self.ledger.book_unattributed(wall)
         block_tokens = 0
         for slot, active in snapshot.items():
             if self._slots.get(slot) is not active:
                 continue  # finished in an earlier block; lane is stale
             if active.req.cancelled():
                 # Discard the whole block remainder past the cancel.
+                if active.req.ledger is not None:
+                    # The cancelled lane's share of this block computed
+                    # tokens the client will never see.
+                    v_disc = K if n_valid is None else int(n_valid[slot])
+                    active.req.ledger.book_device(led_phase, led_share)
+                    active.req.ledger.book_wasted(
+                        "cancelled", led_share, v_disc)
                 self._finish(slot, active, "cancelled", None, ())
                 continue
             # The request consumes tokens until the first EOS, its token
@@ -986,6 +1073,13 @@ class Scheduler:
             active.generated += consumed
             active.emitted += n_push
             block_tokens += n_push
+            if active.req.ledger is not None:
+                led = active.req.ledger
+                led.book_device(led_phase, led_share, tokens=n_push)
+                if spec_reject is not None and slot in spec_reject:
+                    frac, rej = spec_reject[slot]
+                    led.book_wasted("spec_rejected",
+                                    led_share * frac, rej)
             # TWO dispatches' writes must stay within capacity after a
             # continue decision — the next block's (whose tokens we may
             # consume) plus one of margin (cache holds prompt_len +
@@ -1153,6 +1247,14 @@ class Scheduler:
                     # alike (both pop through here).
                     self.metrics["deadline_shed"] += 1
                     self._m_deadline_sheds.inc()
+                    if item.ledger is not None:
+                        # Zero device seconds by construction (the shed
+                        # IS the work avoided) — booked so the waste
+                        # class is visible, with the queue wait the
+                        # request burned getting nothing.
+                        item.ledger.book_queue(
+                            time.monotonic() - item.enqueued_at)
+                        item.ledger.book_wasted("deadline_shed", 0.0)
                     late = time.monotonic() - item.deadline_at
                     self._emit_cb(item, TokenEvent(
                         text="", token_id=None, done=True,
@@ -1205,6 +1307,10 @@ class Scheduler:
         hit_units: dict[tuple, tuple[Any, list[tuple[int, GenRequest]]]] = {}
         for slot, req in group:
             req.picked_at = now
+            if req.ledger is not None:
+                # Set-not-add: a budget deferral re-picks, and the
+                # latest pick is the true scheduler queue wait.
+                req.ledger.book_queue(now - req.enqueued_at)
             hit = None
             try:
                 if req.adopt is not None:
@@ -1369,6 +1475,23 @@ class Scheduler:
                 self.tracer.record("prefill_dispatch", t0m, dt, n=len(sub),
                                    cached=hit is not None)
                 self._m_dispatch.observe(dt, kind="prefill")
+            if self.ledger.enabled and dt > 0.0:
+                # Prefill/adopt attribution is EXACT (the dispatch names
+                # its requests): the unit wall splits across members by
+                # suffix length, and a radix hit's avoided prefix is
+                # priced at this very dispatch's per-token rate.
+                phase = ("adopt" if hit is not None
+                         and self._role == "decode" else "prefill")
+                sfx = [max(1, len(req.prompt_ids) - req.reused_tokens)
+                       for _s, req in sub]
+                rate = dt / sum(sfx)
+                for (slot_i, req), n_sfx in zip(sub, sfx):
+                    if req.ledger is not None:
+                        req.ledger.book_device(phase, rate * n_sfx)
+                        if req.reused_tokens:
+                            req.ledger.book_saved(
+                                rate * req.reused_tokens,
+                                req.reused_tokens)
             for (slot, req), first in zip(sub, firsts):
                 self._activate(slot, req, first)
         return n_dispatches
@@ -1396,6 +1519,12 @@ class Scheduler:
             if req.cancelled():
                 self._prefill_jobs.pop(0)
                 self._free.append(job.slot)
+                if req.ledger is not None:
+                    # Killed in-flight partial prefill: every chunk
+                    # dispatched so far built a prefix nobody will
+                    # decode from — the whole accumulated device time
+                    # is waste.
+                    req.ledger.waste_all_device("killed_prefill")
                 self._emit_cb(req, TokenEvent(
                     text="", token_id=None, done=True,
                     finish_reason="cancelled"))
@@ -1419,10 +1548,21 @@ class Scheduler:
             self.tracer.record("chunk_dispatch", t0m, dt,
                                request_id=req.id, trace_id=req.trace_id)
             self._m_dispatch.observe(dt, kind="chunk")
+            if req.ledger is not None:
+                req.ledger.book_device("chunk", dt)
             progressed += 1
             budget -= 1
             if first is not None:
                 self._prefill_jobs.pop(0)
+                if req.ledger is not None and req.reused_tokens:
+                    # Seeded chunked prefill (radix hit with a long
+                    # suffix): the avoided prefix is priced at this
+                    # request's own measured chunk rate, known only now
+                    # that the chunks have run.
+                    req.ledger.book_saved_at_phase_rate(
+                        "chunk",
+                        len(req.prompt_ids) - req.reused_tokens,
+                        req.reused_tokens)
                 self._activate(job.slot, req, first)
 
     def _activate(self, slot: int, req: GenRequest, first: int) -> None:
@@ -1523,6 +1663,11 @@ class Scheduler:
         finally:
             self._free.append(slot)
             self.engine.release_slot(slot)
+            if req.ledger is not None:
+                # Prefill-tier terminal: the decode tier owns the finish
+                # event; this host's attribution folds into aggregates.
+                # Idempotent after the error path's finish() above.
+                req.ledger.release("handoff")
 
     def _finish(self, slot: int, active: _ActiveSlot, reason: str,
                 tok: int | None, run) -> None:
@@ -1541,8 +1686,20 @@ class Scheduler:
                                request_id=active.req.id,
                                trace_id=active.req.trace_id,
                                tokens=active.generated, finish=reason)
+        costs = None
+        if active.req.ledger is not None:
+            costs = active.req.ledger.finish(reason,
+                                             tokens=active.emitted)
+            if self.tracer.enabled:
+                # Per-request attribution counter tracks: cumulative
+                # attributed/wasted device seconds stamped at every
+                # finish — the Perfetto cost staircase, one ring append
+                # pair per request lifetime.
+                dev_t, waste_t = self.ledger.totals_brief()
+                self.tracer.counter("ledger_device_s", round(dev_t, 6))
+                self.tracer.counter("ledger_wasted_s", round(waste_t, 6))
         self._submit_job(("finish", active, run, tok, reason, ttft,
-                          active.generated, active.emitted))
+                          active.generated, active.emitted, costs))
         del self._slots[slot]
         self._free.append(slot)
         if self._drafter is not None:
@@ -1582,7 +1739,15 @@ class Scheduler:
     def _emit_cb(self, req: GenRequest, ev: TokenEvent) -> None:
         """Queue a pre-built event with no slot attached (admission
         errors, queued cancels, deadline sheds). All job submissions
-        happen on the engine thread, so the buffers need no lock."""
+        happen on the engine thread, so the buffers need no lock.
+
+        Terminal events close the request's cost account HERE — the one
+        choke point every slotless exit path already goes through — so
+        a request that sheds, errors, or cancels on ANY path still
+        releases its ledger entry and ships its costs block (finish()
+        is idempotent; a path that closed earlier books nothing twice)."""
+        if ev.done and req.ledger is not None:
+            ev.costs = req.ledger.finish(ev.finish_reason or "error")
         self._submit_job(("raw", req, ev))
 
     def _flush_events(self) -> None:
@@ -1608,8 +1773,13 @@ class Scheduler:
                 self._emit_batch(batch)
             except Exception as exc:  # noqa: BLE001 — must never kill the loop
                 log.error(f"emit batch sink failed: {exc}")
-            self.tracer.record("emit_flush", t0, time.monotonic() - t0,
-                               events=len(batch))
+            dt = time.monotonic() - t0
+            self.tracer.record("emit_flush", t0, dt, events=len(batch))
+            if self.ledger.enabled and dt > 0.0:
+                per = dt / len(batch)
+                for req, _ev in batch:
+                    if req.ledger is not None:
+                        req.ledger.book_emit(per)
             return
         for req, ev in batch:
             try:

@@ -71,6 +71,12 @@ class StreamChunk:
     # token, src/provider.ts:243-246). None and 0 differ on purpose:
     # 0 is an exact "no new tokens", None is "unknown, estimate".
     tokens: int | None = None
+    # symledger cost block (engine/ledger.py), stamped on the done
+    # chunk only: device_s{phase}/queue_s/emit_s/wasted_s{reason}/
+    # saved_s as attributed by the scheduler (source "probed"/"blocked")
+    # or estimated by a proxy backend (source "estimated"). None
+    # mid-stream, and None everywhere while tpu.ledger is off.
+    costs: dict | None = None
 
 
 class ResumeJournal:

@@ -82,7 +82,25 @@ class EchoBackend(InferenceBackend):
             emitted += 1
             if self._delay:
                 await asyncio.sleep(self._delay)
-        self.tracer.record("echo_stream", t0, time.monotonic() - t0,
+        wall = time.monotonic() - t0
+        self.tracer.record("echo_stream", t0, wall,
                            trace_id=request.trace_id, tokens=emitted,
                            resumed_from=n_words)
-        yield StreamChunk(raw="data: [DONE]", text="", done=True)
+        # Minimal symledger costs block (source "estimated": no device
+        # behind this backend — the stream wall stands in for decode
+        # time) so the fleet wiring (costs on the final frame, provider
+        # sym_request_* fold, goodput window) is exercisable without an
+        # engine. Shape-compatible with engine/ledger.py costs().
+        costs = {
+            "device_s": {"decode": round(wall, 6)},
+            "device_total_s": round(wall, 6),
+            "queue_s": 0.0,
+            "emit_s": 0.0,
+            "wasted_s": {},
+            "wasted_total_s": 0.0,
+            "tokens": emitted,
+            "source": "estimated",
+            "finish": "stop",
+        }
+        yield StreamChunk(raw="data: [DONE]", text="", done=True,
+                          costs=costs)

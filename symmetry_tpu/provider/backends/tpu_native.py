@@ -261,6 +261,14 @@ class TpuNativeBackend(InferenceBackend):
         # its job (one pipe read fans out a whole decode block).
         self.relay_stats = {"host_frames": 0, "host_events": 0,
                             "host_batched_frames": 0}
+        # symledger fold (provider-fed): per-request cost blocks ride
+        # the done chunks; the provider judges SLO attainment against
+        # its configured targets and calls note_request_cost() with the
+        # verdict. The autoscaler's goodput numerator counts ONLY
+        # attained tokens — the raw relayed-event count it used before
+        # stays exported as sym_autoscale_tokens_raw for continuity.
+        self.ledger_stats = {"attained_tokens": 0, "raw_tokens": 0,
+                             "device_s": 0.0, "requests": 0}
         # Stream resumption: the per-request emitted-token journal (what
         # each live stream has relayed — the death paths stamp `emitted`
         # from it into their restarting sheds, so a seeded resume knows
@@ -1026,6 +1034,19 @@ class TpuNativeBackend(InferenceBackend):
             return max(total, 0.0)
         return total if total < prev else total - prev
 
+    def note_request_cost(self, attained_tokens: int, raw_tokens: int,
+                          device_s: float) -> None:
+        """Provider fold hook: one finished request's SLO-attainment
+        verdict plus its ledger-attributed device seconds. Feeds the
+        autoscaler's goodput numerator — only tokens whose request met
+        every configured SLO target count (a completion the client's
+        deadline already discarded is cost, not goodput)."""
+        ls = self.ledger_stats
+        ls["attained_tokens"] += max(0, int(attained_tokens))
+        ls["raw_tokens"] += max(0, int(raw_tokens))
+        ls["device_s"] += max(0.0, float(device_s))
+        ls["requests"] += 1
+
     def _autoscale_tick(self, burns: dict | None, busy: dict) -> None:
         """One controller step at the end of each pool heartbeat: feed
         the sensor snapshot, apply at most one decision as a background
@@ -1036,9 +1057,22 @@ class TpuNativeBackend(InferenceBackend):
             return
         applying = (self._scale_task is not None
                     and not self._scale_task.done())
+        # Goodput numerator = SLO-attaining tokens from the provider's
+        # per-request fold. The old numerator — raw relayed host events,
+        # which counted deadline-missed and discarded tokens as goodput
+        # — survives as the tokens_raw series so dashboards keep their
+        # history while the headline switches to the honest count.
+        # Until the first fold arrives (ledger off, or no request has
+        # finished yet) fall back to the raw count rather than starving
+        # the controller of a throughput signal.
+        ls = self.ledger_stats
+        raw = float(self.relay_stats["host_events"])
+        attained = (float(ls["attained_tokens"]) if ls["requests"]
+                    else raw)
         decision = self._autoscaler.tick(
             burn=burns, busy_delta_s=busy,
-            tokens_total=float(self.relay_stats["host_events"]),
+            tokens_total=attained,
+            tokens_raw=raw,
             applying=applying)
         if decision["action"] == "hold":
             return
@@ -2277,6 +2311,8 @@ class TpuNativeBackend(InferenceBackend):
             out = {k: v for k, v in msg.items() if k != "op"}
             out["relay"] = dict(self.relay_stats)
             out["resume"] = dict(self.resume_stats)
+            if self.ledger_stats["requests"]:
+                out["ledger_fold"] = dict(self.ledger_stats)
             out["clock_offset_s"] = round(self._clock_offset, 6)
             out["stages"] = {name: h.to_dict()
                              for name, h in self.stage_hists.items()
@@ -2326,6 +2362,8 @@ class TpuNativeBackend(InferenceBackend):
         out = (stats() if stats is not None
                else dict(self._scheduler.metrics))
         out["resume"] = dict(self.resume_stats)
+        if self.ledger_stats["requests"]:
+            out["ledger_fold"] = dict(self.ledger_stats)
         return out
 
     async def _pool_engine_stats(self) -> dict:
@@ -2342,6 +2380,8 @@ class TpuNativeBackend(InferenceBackend):
                 out = {k: v for k, v in msg.items() if k != "op"}
         out["relay"] = dict(self.relay_stats)
         out["resume"] = dict(self.resume_stats)
+        if self.ledger_stats["requests"]:
+            out["ledger_fold"] = dict(self.ledger_stats)
         out["stages"] = {name: h.to_dict()
                          for name, h in self.stage_hists.items()
                          if h.count}
@@ -2501,7 +2541,13 @@ class TpuNativeBackend(InferenceBackend):
                     yield StreamChunk(
                         raw=chunk_line({}, finish=ev.finish_reason or "stop"),
                         text="")
-                    yield StreamChunk(raw="data: [DONE]", text="", done=True)
+                    # symledger: the scheduler's finish event carries the
+                    # request's attributed cost block; ride it out on the
+                    # terminal chunk so the provider folds per-request
+                    # device time / waste / goodput. None while
+                    # tpu.ledger is off.
+                    yield StreamChunk(raw="data: [DONE]", text="",
+                                      done=True, costs=ev.costs)
         finally:
             session.cancel()  # no-op if complete; frees the slot if client left
 
@@ -2605,6 +2651,7 @@ class TpuNativeBackend(InferenceBackend):
         # relay, so a resume never replays received tokens even when the
         # serving host floored its continuation below the client's count.
         drop_left: int | None = None
+        dedup_dropped = 0  # tokens dropped here → resume_discarded waste
         try:
             try:
                 submit = {
@@ -2754,6 +2801,7 @@ class TpuNativeBackend(InferenceBackend):
                         # delivers its finish below: swallowing it would
                         # hang the stream on a queue nobody feeds.
                         drop_left -= n_new
+                        dedup_dropped += n_new
                         self.resume_stats["dedup_dropped"] += n_new
                         self._m_resume_wasted.inc(n_new)
                         if not ev.get("done"):
@@ -2786,8 +2834,31 @@ class TpuNativeBackend(InferenceBackend):
                             request_id, created, {},
                             finish=ev.get("finish_reason") or "stop"),
                         text="")
-                    yield StreamChunk(raw="data: [DONE]", text="",
-                                      done=True)
+                    costs = ev.get("costs")
+                    if isinstance(costs, dict) and dedup_dropped:
+                        # Relay-side dedup discarded tokens the device
+                        # already paid for: price them at this request's
+                        # own decode rate and book resume_discarded —
+                        # the scheduler cannot see this class (the drop
+                        # happens here), so the relay is its one true
+                        # booking site. Mutating the relayed block is
+                        # safe: it crossed the pipe, nothing else holds
+                        # a reference.
+                        dev = costs.get("device_s") or {}
+                        toks = int(costs.get("tokens") or 0)
+                        rate = (float(dev.get("decode", 0.0))
+                                / toks if toks > 0 else 0.0)
+                        wasted = costs.setdefault("wasted_s", {})
+                        wasted["resume_discarded"] = round(
+                            wasted.get("resume_discarded", 0.0)
+                            + rate * dedup_dropped, 6)
+                        costs["wasted_total_s"] = round(
+                            sum(wasted.values()), 6)
+                        costs["wasted_tokens"] = int(
+                            costs.get("wasted_tokens") or 0) + dedup_dropped
+                    yield StreamChunk(
+                        raw="data: [DONE]", text="", done=True,
+                        costs=costs if isinstance(costs, dict) else None)
                     return
         finally:
             # Journal release AFTER the stream settles: every death path

@@ -159,6 +159,18 @@ class TpuConfig:
     # decode block); False empties the rings entirely — the bench A/B
     # knob for proving the overhead stays under 1%.
     tracing: bool = True
+    # symledger per-request cost attribution (engine/ledger.py): the
+    # scheduler apportions every dispatch's measured wall to the
+    # requests it served (prefill/chunk exact, decode/verify blocks by
+    # active-slot occupancy), each finish event carries a `costs` block
+    # (device_s{phase}/queue_s/emit_s/wasted_s{reason}/saved_s), the
+    # host STATS reply ships a bounded ring + aggregates, and the
+    # provider folds per-request SLO attainment into windowed goodput
+    # (sym_goodput_tokens_per_device_second) and feeds the autoscaler's
+    # SLO-attaining numerator. False disables: one guarded branch per
+    # dispatch (same overhead contract as metrics.enabled and
+    # tpu.faults; BASELINE.md Round 20 pre-registers the ≤1% A/B).
+    ledger: bool = True
     # TTFT-bounded admission: shed a new request when the provider's
     # ESTIMATED first-token wait (requests awaiting their first token ÷
     # recent first-token rate) exceeds this many seconds. Catches the

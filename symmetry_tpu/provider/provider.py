@@ -240,6 +240,35 @@ class SymmetryProvider:
         self._m_resume_ttft = METRICS.histogram(
             MetricName.RESUME_TTFT,
             "time to first continuation token of a resume request")
+        # symledger fold (`tpu.ledger` knob, on by default): engine
+        # backends stamp a per-request cost block on their terminal
+        # stream chunk; this side judges SLO attainment for the request
+        # (EVERY configured slo: target met — ttft, e2e, worst
+        # inter-chunk gap; no targets configured ⇒ trivially attained),
+        # exports the per-request attribution families, and maintains
+        # the goodput headline: SLO-attaining tokens per attributed
+        # device second over the last `maxlen` finished requests. With
+        # the knob off no cost blocks arrive and the fold is one dead
+        # branch per request.
+        self._ledger_on = bool(getattr(
+            getattr(self.config, "tpu", None), "ledger", True))
+        self._m_req_device_s = METRICS.histogram(
+            MetricName.REQUEST_DEVICE_SECONDS,
+            "attributed device seconds per finished request",
+            labels=("phase",))
+        self._m_req_wasted_s = METRICS.counter(
+            MetricName.REQUEST_WASTED_SECONDS,
+            "device seconds spent on work no client kept",
+            labels=("reason",))
+        self._m_goodput = METRICS.gauge(
+            MetricName.GOODPUT_TOKENS_PER_DEVICE_S,
+            "windowed SLO-attaining tokens per attributed device second")
+        # (tokens, device_s, attained) per finished request — the
+        # goodput gauge's window; the cost ring is the flight
+        # recorder's per-request attribution tail.
+        self._goodput_window: deque[tuple[int, float, bool]] = deque(
+            maxlen=256)
+        self._cost_ring: deque[dict] = deque(maxlen=64)
         # SLO burn-rate monitor (`slo:` config block, utils/metrics.py):
         # continuous evaluation over the request stream; a budget burn
         # triggers the flight recorder + a structured log event — SLO
@@ -657,9 +686,30 @@ class SymmetryProvider:
                     out[k] += ws.get(k, 0)
         return out
 
+    def _goodput_stats(self) -> dict[str, Any] | None:
+        """Windowed goodput snapshot from the per-request cost folds:
+        SLO-attaining tokens over attributed device seconds. None until
+        the first cost block arrives (ledger off / nothing finished)."""
+        if not self._goodput_window:
+            return None
+        window = list(self._goodput_window)
+        good = sum(t for t, _d, a in window if a)
+        total = sum(t for t, _d, _a in window)
+        dev_s = sum(d for _t, d, _a in window)
+        return {
+            "window_requests": len(window),
+            "attained_requests": sum(1 for _t, _d, a in window if a),
+            "attained_tokens": good,
+            "tokens": total,
+            "device_s": round(dev_s, 6),
+            **({"tokens_per_device_s": round(good / dev_s, 3)}
+               if dev_s > 0 else {}),
+        }
+
     def stats(self) -> dict[str, Any]:
         """Serving metrics snapshot: counters, tok/s, TTFT/e2e percentiles."""
         uptime = max(time.monotonic() - self._started_at, 1e-9)
+        goodput = self._goodput_stats()
         slots = getattr(self.backend, "slots", None)
         return {
             "requests": self.metrics["requests"],
@@ -685,6 +735,9 @@ class SymmetryProvider:
             "tok_s": round(self.metrics["tokens_out"] / uptime, 2),
             "ttft_s": self.tracer.histogram("ttft_s").to_dict(),
             "e2e_s": self.tracer.histogram("inference_s").to_dict(),
+            # symledger headline: windowed SLO-goodput from the
+            # per-request cost folds (absent until one arrives).
+            **({"goodput": goodput} if goodput is not None else {}),
             # False when recent DHT announce rounds were fully rejected
             # (clock skew → silently undiscoverable; network/dht.py).
             **({"dht_discoverable": self._dht.is_discoverable}
@@ -722,6 +775,11 @@ class SymmetryProvider:
         if engine_stats is not None:
             with contextlib.suppress(Exception):
                 stats["engine"] = await engine_stats()
+        if self._cost_ring:
+            # symledger tail: the last requests' attributed cost blocks
+            # — the dump answers "what was the device doing" per
+            # request, not just in aggregate.
+            stats["ledger_tail"] = list(self._cost_ring)
         try:
             path = self.flight.dump(reason, payload["components"],
                                     stats=stats)
@@ -1117,6 +1175,11 @@ class SymmetryProvider:
         # cancellation can land before the stream loop assigns anything
         n_chunks = 0
         n_tokens = 0
+        # symledger: the backend's cost block (terminal chunk rider) and
+        # the worst inter-chunk stall — the gap input to this request's
+        # SLO-attainment verdict.
+        req_costs: dict | None = None
+        max_gap_s = 0.0
         # Every log record of this request (including the backend's,
         # which runs inside this task) carries the trace/request ids —
         # logs and the Perfetto timeline then correlate by the same keys.
@@ -1172,7 +1235,10 @@ class SymmetryProvider:
                         gap = now_chunk - last_chunk_at
                         self._m_inter_chunk.observe(gap)
                         self.slo.observe("inter_chunk", gap)
+                        max_gap_s = max(max_gap_s, gap)
                     last_chunk_at = now_chunk
+                if self._ledger_on and chunk.costs is not None:
+                    req_costs = chunk.costs
                 # Raw passthrough; Connection.send awaits drain = backpressure
                 # (reference's write/drain discipline, src/provider.ts:248-252).
                 await peer.send(MessageKey.TOKEN_CHUNK,
@@ -1182,7 +1248,13 @@ class SymmetryProvider:
             if not peer.closed:
                 await peer.send(
                     MessageKey.INFERENCE_ENDED,
-                    {"chunks": n_chunks, "tokens": n_tokens, **tag},
+                    # symledger: the attributed cost block rides the end
+                    # frame so the CLIENT sees what its request cost —
+                    # absent (not empty) while tpu.ledger is off.
+                    {"chunks": n_chunks, "tokens": n_tokens,
+                     **({"costs": req_costs} if req_costs is not None
+                        else {}),
+                     **tag},
                 )
             self.metrics["tokens_out"] += n_tokens
             if n_tokens:
@@ -1190,6 +1262,12 @@ class SymmetryProvider:
             e2e_s = time.monotonic() - start
             self._m_e2e.observe(e2e_s)
             self.slo.observe("e2e", e2e_s)
+            if req_costs is not None:
+                self._fold_request_cost(
+                    req_costs, n_tokens,
+                    attained=self._slo_attained(first_token_s, e2e_s,
+                                                max_gap_s),
+                    request_id=str(req_id or request_id))
             self.tracer.record("inference", start, e2e_s,
                                request_id=request_id, trace_id=trace_id,
                                tokens=n_tokens, chunks=n_chunks)
@@ -1298,6 +1376,62 @@ class SymmetryProvider:
                 # token) — still waiting from the estimator's view.
                 self._unstarted -= 1
             self._pending_gauges()
+
+    def _slo_attained(self, ttft_s: float | None, e2e_s: float,
+                      max_gap_s: float) -> bool:
+        """One request's SLO verdict: every configured `slo:` target
+        met. This is the goodput numerator's gate — a completion that
+        blew its latency target is device time spent, not goodput. No
+        targets configured ⇒ trivially attained (goodput degenerates to
+        plain tokens per device second). A request that never streamed
+        a token (ttft None) fails any TTFT target by definition."""
+        targets = self.slo.targets
+        if not targets:
+            return True
+        t = targets.get("ttft")
+        if t is not None and (ttft_s is None or ttft_s > t):
+            return False
+        t = targets.get("e2e")
+        if t is not None and e2e_s > t:
+            return False
+        t = targets.get("inter_chunk")
+        if t is not None and max_gap_s > t:
+            return False
+        return True
+
+    def _fold_request_cost(self, costs: dict, tokens: int, *,
+                           attained: bool, request_id: str) -> None:
+        """Fold one finished request's ledger block into the always-on
+        families, the goodput window, and the backend's autoscale
+        accumulator. Runs once per request, only when a cost block
+        arrived (tpu.ledger on + engine-shaped backend)."""
+        device = costs.get("device_s")
+        if isinstance(device, dict):
+            for phase, seconds in device.items():
+                self._m_req_device_s.observe(float(seconds),
+                                             phase=str(phase))
+        wasted = costs.get("wasted_s")
+        if isinstance(wasted, dict):
+            for reason, seconds in wasted.items():
+                self._m_req_wasted_s.inc(float(seconds),
+                                         reason=str(reason))
+        try:
+            device_total = float(costs.get("device_total_s") or 0.0)
+        except (TypeError, ValueError):
+            device_total = 0.0
+        self._goodput_window.append((int(tokens), device_total, attained))
+        good = sum(t for t, _d, a in self._goodput_window if a)
+        dev_s = sum(d for _t, d, _a in self._goodput_window)
+        if dev_s > 0:
+            self._m_goodput.set(round(good / dev_s, 3))
+        self._cost_ring.append(
+            {"id": request_id, "attained": attained, "tokens": tokens,
+             **costs})
+        # Autoscale goodput numerator (tpu_native pool mode): only an
+        # attained request's tokens count toward the scale signal.
+        note = getattr(self.backend, "note_request_cost", None)
+        if note is not None:
+            note(tokens if attained else 0, tokens, device_total)
 
     async def _report_completion(self, data: dict, tokens: int) -> None:
         token = data.get("sessionToken") or {}

@@ -195,6 +195,22 @@ class MetricName:
     AUTOSCALE_TARGET = "sym_autoscale_target_members"        # {tier}
     AUTOSCALE_CHIP_SECONDS = "sym_autoscale_chip_seconds"
     AUTOSCALE_GOODPUT = "sym_autoscale_goodput_tokens_per_chip_s"
+    # Pre-ledger continuity series: the raw cumulative token count the
+    # goodput numerator used before symledger wired SLO attainment in
+    # (PR 20) — dashboards comparing old and new goodput read both.
+    AUTOSCALE_TOKENS_RAW = "sym_autoscale_tokens_raw"
+
+    # --- symledger per-request cost attribution (engine/ledger.py →
+    #     provider/provider.py, tpu.ledger). device_seconds is a
+    #     histogram per phase (prefill/chunk/decode/verify/adopt);
+    #     wasted_seconds counts device time spent on output nobody
+    #     consumed, per reason (spec_rejected/resume_discarded/
+    #     deadline_shed/killed_prefill/cancelled); goodput is the
+    #     windowed SLO objective — SLO-attaining tokens over attributed
+    #     device seconds (DistServe's goodput, per request).
+    REQUEST_DEVICE_SECONDS = "sym_request_device_seconds"    # {phase}
+    REQUEST_WASTED_SECONDS = "sym_request_wasted_seconds"    # {reason}
+    GOODPUT_TOKENS_PER_DEVICE_S = "sym_goodput_tokens_per_device_second"
 
     # --- server registry (server/registry.py)
     SERVER_PROVIDERS_ONLINE = "sym_server_providers_online"

@@ -1140,6 +1140,40 @@ class TestLifecycle:
         })
         assert lifecycle_codes(root) == set()
 
+    def test_ledger_entry_leak_flags_L401(self, tmp_path):
+        # The symledger protocol (PR 20): a tracked cost account that
+        # no path finishes or releases never folds its device seconds —
+        # conservation silently stops closing.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/sched.py": (
+                "def run(self, req):\n"
+                "    entry = self.ledger.track(req.id)\n"
+                "    if entry is not None:\n"
+                "        entry.book_device('decode', 0.1)\n"
+                "    return 1\n"),
+        })
+        assert "L401" in lifecycle_codes(root)
+
+    def test_ledger_entry_finish_or_release_clean(self, tmp_path):
+        # Either closer resolves the entry (both idempotent), and the
+        # optional acquire means the None miss path needs no close.
+        root = write_tree(tmp_path, {
+            "symmetry_tpu/sched.py": (
+                "def run(self, req, handoff):\n"
+                "    entry = self.ledger.track(req.id)\n"
+                "    if entry is None:\n"
+                "        return 0\n"
+                "    try:\n"
+                "        entry.book_device('decode', 0.1)\n"
+                "    finally:\n"
+                "        if handoff:\n"
+                "            entry.release('handoff')\n"
+                "        else:\n"
+                "            entry.finish('stop')\n"
+                "    return 1\n"),
+        })
+        assert lifecycle_codes(root) == set()
+
     def test_double_commit_flags_L403(self, tmp_path):
         root = write_tree(tmp_path, {
             "symmetry_tpu/engine.py": (

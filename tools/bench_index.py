@@ -1,0 +1,238 @@
+#!/usr/bin/env python3
+"""bench_index — the capture ledger: every BENCH_*/MULTICHIP_*/
+AUTOSCALE_* JSON in one fingerprint-grouped trend table.
+
+benchdiff.py answers "did THIS run regress against THAT one"; twenty
+rounds of captures also need the longitudinal answer — "what has this
+metric been doing across the campaign, per configuration". This tool
+indexes every capture in the repo (or --dir), groups them by
+`config_fingerprint` (captures of different knob sets must never share
+a trend line — the same guard benchdiff enforces pairwise), and renders
+per-group trends for the policied metrics using benchdiff's own series
+machinery (flatten, POLICIES, median/IQR noise bands): the newest
+capture in each group gets a verdict against the median of its
+predecessors, exactly like a benchdiff series run.
+
+    python tools/bench_index.py                     # markdown to stdout
+    python tools/bench_index.py --json              # JSON instead
+    python tools/bench_index.py --out BENCH_INDEX.md --json-out idx.json
+
+Pre-schema captures (no fingerprint stamp) are indexed too — grouped
+per file-prefix under an `unstamped:` key so their headline numbers
+stay visible — but get no verdicts: an unstamped trend line cannot
+prove its runs shared a config.
+
+Exit code 1 when any group's newest capture REGRESSED a policied
+metric beyond its noise band (a CI step can gate on the index the same
+way it gates on a pairwise diff), else 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from benchdiff import _iqr, _median, flatten, policy_for  # noqa: E402
+
+CAPTURE_GLOBS = ("BENCH_*.json", "MULTICHIP_*.json", "AUTOSCALE_*.json")
+
+# Trend rows are limited to policied metrics plus these always-shown
+# headline leaves; unpolicied counters scale with workload size and
+# would bury the table.
+_HEADLINE = re.compile(r"^(value|vs_baseline|steady_state_tok_s)$")
+
+
+def _round_key(path: str) -> tuple[str, int, str]:
+    """Sort captures campaign-order: prefix, then round number."""
+    base = os.path.basename(path)
+    m = re.match(r"([A-Z_]+?)_r?(\d+)", base)
+    if m:
+        return (m.group(1), int(m.group(2)), base)
+    return (base, 0, base)
+
+
+def load_capture(path: str) -> dict | None:
+    """One capture's metric dict. Smoke-runner wrappers ({"parsed":
+    ...}) are unwrapped; files with no recognizable metric payload
+    (fit tables, dry runs) index as headline-only."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError) as exc:
+        print(f"[bench_index] skipping {path}: {exc}", file=sys.stderr)
+        return None
+    if not isinstance(data, dict):
+        return None
+    parsed = data.get("parsed")
+    if isinstance(parsed, dict) and ("value" in parsed
+                                     or "metric" in parsed):
+        return parsed
+    return data
+
+
+def index_captures(paths: list[str]) -> dict[str, list[dict]]:
+    """Group capture records by config fingerprint. Record: {file,
+    fingerprint, mode, sha, written_at, headline, flat}."""
+    groups: dict[str, list[dict]] = {}
+    for path in sorted(paths, key=_round_key):
+        cap = load_capture(path)
+        if cap is None:
+            continue
+        fp = cap.get("config_fingerprint")
+        prefix = _round_key(path)[0]
+        key = str(fp) if fp else f"unstamped:{prefix}"
+        groups.setdefault(key, []).append({
+            "file": os.path.basename(path),
+            "fingerprint": fp,
+            "mode": (cap.get("config") or {}).get("mode"),
+            "sha": (cap.get("git_sha") or "")[:12] or None,
+            "written_at": cap.get("written_at"),
+            "headline": {"metric": cap.get("metric"),
+                         "value": cap.get("value"),
+                         "unit": cap.get("unit")},
+            "flat": flatten(cap),
+        })
+    return groups
+
+
+def trend_rows(records: list[dict], *, judge: bool = True) -> list[dict]:
+    """Per-metric trend over one fingerprint group, campaign order.
+    The LAST capture is judged against the median of the earlier ones
+    with the benchdiff noise band (max(min_effect x |median|, IQR));
+    single-capture groups and unpolicied metrics carry no verdict, and
+    judge=False (unstamped groups — config parity unproven) suppresses
+    verdicts entirely so the trend stays informational."""
+    flats = [r["flat"] for r in records]
+    paths = sorted(set().union(*flats) if flats else ())
+    rows: list[dict] = []
+    for path in paths:
+        series = [f.get(path) for f in flats]
+        present = [v for v in series if v is not None]
+        if len(present) < 1:
+            continue
+        pol = policy_for(path)
+        if pol is None and not _HEADLINE.search(path):
+            continue
+        row: dict[str, Any] = {"metric": path, "series": series}
+        if judge and pol is not None and len(present) >= 2:
+            direction, min_effect = pol
+            base = present[:-1]
+            newest = present[-1]
+            ref = _median(base)
+            band = max(min_effect * abs(ref), _iqr(base))
+            delta = newest - ref
+            worse = delta < 0 if direction == "higher" else delta > 0
+            row.update(
+                direction=direction, median=ref,
+                band=round(band, 6), delta=round(delta, 6),
+                verdict=("ok" if abs(delta) <= band
+                         else "REGRESSED" if worse else "improved"))
+        rows.append(row)
+    order = {"REGRESSED": 0, "improved": 1, "ok": 2, None: 3}
+    rows.sort(key=lambda r: (order.get(r.get("verdict"), 3), r["metric"]))
+    return rows
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return str(int(v)) if v == int(v) and abs(v) < 1e12 else f"{v:.4g}"
+    return str(v)
+
+
+def render_markdown(groups: dict[str, list[dict]]) -> str:
+    lines = ["# bench index", ""]
+    n_caps = sum(len(v) for v in groups.values())
+    lines.append(f"{n_caps} captures in {len(groups)} fingerprint "
+                 f"group(s).")
+    for key in sorted(groups, key=lambda k: groups[k][0]["file"]):
+        records = groups[key]
+        head = records[-1]["headline"]
+        lines += ["", f"## `{key}`", ""]
+        lines.append("- captures: " + ", ".join(
+            f"`{r['file']}`" + (f" @ `{r['sha']}`" if r["sha"] else "")
+            for r in records))
+        if head.get("metric"):
+            lines.append(f"- latest headline: {head['metric']} = "
+                         f"{_fmt(head.get('value'))} "
+                         f"{head.get('unit') or ''}".rstrip())
+        rows = trend_rows(records,
+                          judge=records[0]["fingerprint"] is not None)
+        if not rows:
+            continue
+        lines += ["", "| metric | trend | median | Δ(last) | band "
+                      "| verdict |", "|---|---|---|---|---|---|"]
+        for r in rows:
+            trend = " → ".join(_fmt(v) for v in r["series"])
+            verdict = r.get("verdict")
+            lines.append(
+                f"| `{r['metric']}` | {trend} | {_fmt(r.get('median'))} "
+                f"| {_fmt(r.get('delta'))} | {_fmt(r.get('band'))} "
+                f"| {('**' + verdict + '**') if verdict == 'REGRESSED' else (verdict or '-')} |")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_index", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding the capture JSONs (default: repo root)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="also write the markdown table here")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the index as JSON instead of markdown")
+    ap.add_argument("--json-out", default=None, metavar="PATH",
+                    help="also write the JSON index here")
+    args = ap.parse_args(argv)
+
+    paths: list[str] = []
+    for pattern in CAPTURE_GLOBS:
+        paths.extend(glob.glob(os.path.join(args.dir, pattern)))
+    if not paths:
+        print(f"[bench_index] no captures under {args.dir}",
+              file=sys.stderr)
+        return 0
+    groups = index_captures(paths)
+    payload = {
+        "schema": 1,
+        "groups": {key: {"captures": [
+                        {k: v for k, v in r.items() if k != "flat"}
+                        for r in records],
+                    "trends": trend_rows(
+                        records,
+                        judge=records[0]["fingerprint"] is not None)}
+                   for key, records in groups.items()},
+    }
+    regressed = any(
+        row.get("verdict") == "REGRESSED"
+        for g in payload["groups"].values() for row in g["trends"])
+    payload["regressed"] = regressed
+    md = render_markdown(groups)
+    if args.as_json:
+        print(json.dumps(payload, indent=1))
+    else:
+        print(md, end="")
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(md)
+        print(f"[bench_index] trend table → {args.out}", file=sys.stderr)
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"[bench_index] JSON index → {args.json_out}",
+              file=sys.stderr)
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

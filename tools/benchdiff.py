@@ -71,6 +71,13 @@ POLICIES: list[tuple[re.Pattern, str, float]] = [
     # regression means the controller is buying the same goodput with
     # more chips (or shedding goodput to save them).
     (re.compile(r"goodput_tokens_per_chip_s$"), "higher", 0.05),
+    # symledger rollup (bench.py `ledger` block): attributed device
+    # seconds per request and the wasted share are costs (lower); the
+    # true-goodput headline — tokens per attributed device second —
+    # must not fall.
+    (re.compile(r"goodput_tokens_per_device_s$"), "higher", 0.05),
+    (re.compile(r"ledger\.device_s_p\d+$"), "lower", 0.10),
+    (re.compile(r"ledger\.wasted_share$"), "lower", 0.15),
     (re.compile(r"weight_stream_gbs$"), "higher", 0.05),
     (re.compile(r"acceptance_rate$"), "higher", 0.10),
     (re.compile(r"ttft[a-z0-9_]*_p\d+(_[a-z]+)?_s$"), "lower", 0.10),

@@ -1,0 +1,271 @@
+#!/usr/bin/env python3
+"""CI smoke for the symledger layer (no TPU, no subprocess engines).
+
+Phase 1 — attribution on a live scheduler: a tiny inproc engine serves
+real traffic (plain prompts, a chunked long prompt, a mid-stream
+cancel); every terminal event must carry a costs block (source
+"blocked", finish matching the event), and the books must balance —
+per-request device seconds plus the unattributed residue reconstruct
+the scheduler's own dispatch walls within 5%.
+
+Phase 2 — the fleet wire: client → server → provider on the in-memory
+transport with an echo backend (source "estimated"). The final stream
+frame's costs block must surface as `session.last_costs`, the provider
+must fold it — `sym_request_device_seconds` and
+`sym_goodput_tokens_per_device_second` in the Prometheus exposition,
+a `goodput` block in stats() — and `symtop --once` must render real
+COST / WASTE% / GPUT cells from the same scrape.
+
+Phase 3 — the knob: a provider with `tpu: {ledger: false}` must ship
+NO costs on the wire (`session.last_costs` is None) — the disabled
+mode's one-guarded-branch contract, observable end to end.
+
+Exit 0 on success; exit 1 with a reason otherwise.
+
+Run: python tools/ledger_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import os
+import sys
+import threading
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def log(msg: str) -> None:
+    print(f"[ledger_smoke] {msg}", flush=True)
+
+
+def phase1_scheduler_conservation() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from symmetry_tpu.engine.engine import InferenceEngine, SamplingParams
+    from symmetry_tpu.engine.scheduler import GenRequest, Scheduler
+    from symmetry_tpu.engine.tokenizer import ByteTokenizer
+    from symmetry_tpu.models import init_params, preset
+
+    cfg = preset("tiny")
+    params = init_params(cfg, jax.random.key(0), jnp.float32)
+    engine = InferenceEngine(
+        cfg, params, ByteTokenizer(), max_slots=2, max_seq_len=96,
+        prefill_buckets=(16, 48), cache_dtype=jnp.float32,
+        decode_block=2, prefill_chunk=16)
+    engine.warmup()
+    sched = Scheduler(engine, debug_invariants=True)
+
+    results: dict[int, list] = {0: [], 1: [], 2: []}
+    done = {i: threading.Event() for i in results}
+    cancel = threading.Event()
+    prompts = [list(b"hello symledger"), list(b"cancelled stream"),
+               # > prefill_chunk: the chunk phase gets attributed too.
+               list(b"a long prompt that needs chunked prefill here..")]
+    for i, ids in enumerate(prompts):
+        def emit(ev, i=i):
+            results[i].append(ev)
+            if i == 1 and len(results[1]) >= 3:
+                # Cancel from inside r1's own stream: guaranteed to
+                # land mid-decode, with blocks still in flight.
+                cancel.set()
+            if ev.done:
+                done[i].set()
+        sched.submit(GenRequest(
+            prompt_ids=ids, sampling=SamplingParams(),
+            max_new_tokens=24 if i != 1 else 64, emit=emit, id=f"r{i}",
+            cancelled=(cancel.is_set if i == 1 else (lambda: False))))
+    sched.start()
+    for i, ev in done.items():
+        assert ev.wait(180), f"r{i} did not complete"
+    sched.stop()
+
+    finals = {f"r{i}": evs[-1] for i, evs in results.items()}
+    for rid, ev in finals.items():
+        costs = ev.costs
+        assert isinstance(costs, dict), \
+            f"{rid} terminal event carries no costs block: {ev}"
+        assert costs["source"] == "blocked", (rid, costs)
+        assert costs["finish"] == ev.finish_reason, (rid, costs)
+        assert costs["device_total_s"] > 0, (rid, costs)
+    assert finals["r1"].finish_reason == "cancelled", finals["r1"]
+    assert finals["r1"].costs["wasted_s"].get("cancelled", 0) > 0, \
+        f"mid-decode cancel booked no cancelled waste: {finals['r1'].costs}"
+    assert finals["r2"].costs["device_s"].get("chunk", 0) > 0, \
+        f"chunked prefill not attributed: {finals['r2'].costs}"
+
+    stats = sched.stats()
+    led = stats.get("ledger")
+    assert led and led["enabled"], "stats carry no ledger rider"
+    m = sched.metrics
+    rhs = m["admit_s"] + m["adopt_s"] + m["chunk_s"] + m["sync_s"]
+    lhs = led["device_total_s"]
+    assert rhs > 0 and abs(lhs - rhs) <= max(0.05 * rhs, 1e-4), \
+        f"conservation broke: attributed {lhs:.6f}s vs walls {rhs:.6f}s"
+    assert led["finished"] == 3 and led["live"] == 0, led
+    assert len(led["ring"]) == 3, led["ring"]
+    log(f"phase 1 OK: attributed {lhs * 1e3:.1f}ms vs walls "
+        f"{rhs * 1e3:.1f}ms, wasted {led['wasted_total_s'] * 1e3:.2f}ms "
+        f"({sorted(led['wasted_s'])})")
+
+
+async def _echo_provider(hub, server_ident, name, tpu_overrides):
+    from symmetry_tpu.identity import Identity
+    from symmetry_tpu.provider.backends.echo import EchoBackend
+    from symmetry_tpu.provider.config import ConfigManager
+    from symmetry_tpu.provider.provider import SymmetryProvider
+
+    cfg = ConfigManager(config={
+        "name": name,
+        "public": True,
+        "serverKey": server_ident.public_hex,
+        "modelName": f"echo:{name}",
+        "apiProvider": "echo",
+        "dataCollectionEnabled": False,
+        "metrics": {"port": 0},
+        # Loose-but-armed SLO targets: the echo stream meets them, so
+        # the goodput fold counts its tokens as attaining.
+        "slo": {"ttft_s": 30.0, "inter_chunk_s": 30.0,
+                "objective": 0.99, "min_samples": 1000},
+        **({"tpu": tpu_overrides} if tpu_overrides else {}),
+    })
+    provider = SymmetryProvider(
+        cfg, transport=hub, identity=Identity.from_name(name),
+        backend=EchoBackend(delay_s=0.01),
+        server_address="mem://ledger-server")
+    await provider.start(f"mem://{name}")
+    await provider.wait_registered()
+    return provider
+
+
+async def phases_2_3(tmp_dir: str) -> None:
+    import contextlib
+
+    from symmetry_tpu.client.client import SymmetryClient
+    from symmetry_tpu.identity import Identity
+    from symmetry_tpu.server.broker import SymmetryServer
+    from symmetry_tpu.transport.memory import MemoryTransport
+    from symmetry_tpu.utils.metrics import parse_prometheus_text
+
+    hub = MemoryTransport()
+    server_ident = Identity.from_name("ledger-smoke-server")
+    server = SymmetryServer(server_ident, hub, ping_interval_s=30.0)
+    await server.start("mem://ledger-server")
+
+    provider = await _echo_provider(
+        hub, server_ident, "ledger-smoke-prov", None)
+    assert provider.metrics_server is not None, "metrics endpoint not up"
+    url = f"http://127.0.0.1:{provider.metrics_server.port}/metrics"
+
+    client = SymmetryClient(Identity.from_name("ledger-smoke-cli"), hub)
+    details = await client.request_provider(
+        "mem://ledger-server", server_ident.public_key,
+        "echo:ledger-smoke-prov")
+    session = await client.connect(details)
+    try:
+        prompt = " ".join(f"w{i}" for i in range(24))
+        for _ in range(2):
+            text = "".join([d async for d in session.chat(
+                [{"role": "user", "content": prompt}])])
+            assert text == prompt, f"echo mismatch: {text[:60]!r}"
+        costs = session.last_costs
+        assert isinstance(costs, dict), \
+            f"final frame carried no costs block: {session.last_usage}"
+        assert costs["source"] == "estimated", costs
+        assert costs["tokens"] > 0 and costs["device_total_s"] > 0, costs
+
+        def _scrape_blocking() -> dict:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return parse_prometheus_text(resp.read().decode())
+
+        fams = await asyncio.to_thread(_scrape_blocking)
+        fam = fams.get("sym_request_device_seconds")
+        assert fam, "sym_request_device_seconds missing from exposition"
+        phases = {s["labels"].get("phase") for s in fam["series"]
+                  if s.get("suffix") == "_count"}
+        n = sum(s["value"] for s in fam["series"]
+                if s.get("suffix") == "_count")
+        assert n >= 2 and phases, (n, phases)
+        gp = fams.get("sym_goodput_tokens_per_device_second")
+        assert gp and gp["series"][0]["value"] > 0, \
+            f"goodput gauge missing or zero: {gp}"
+
+        stats = await session.stats()
+        goodput = stats.get("goodput")
+        assert goodput, f"stats carry no goodput block: {sorted(stats)}"
+        assert goodput["window_requests"] >= 2, goodput
+        assert goodput["attained_tokens"] > 0, goodput
+        log(f"phase 2 OK: {int(n)} folded requests (phases {sorted(phases)}), "
+            f"goodput {goodput.get('tokens_per_device_s')} tok/dev-s")
+    finally:
+        await session.close()
+
+    # symtop --once renders COST / WASTE% / GPUT from the same scrape.
+    import tools.symtop as symtop
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = await asyncio.to_thread(
+            symtop.main, ["--once", "--metrics-url", url])
+    table = buf.getvalue()
+    assert rc == 0, "symtop --once failed"
+    header, row = table.splitlines()[0], table.splitlines()[1]
+    assert "COST" in header and "WASTE%" in header and "GPUT" in header, \
+        f"symtop header lacks ledger columns: {header!r}"
+
+    def cell(line: str, name: str) -> str:
+        # Fixed-width columns, "  " separators — slice, don't split
+        # (headers like "TTFT p50" contain spaces).
+        i = symtop.COLUMNS.index(name)
+        start = sum(w + 2 for w in symtop.WIDTHS[:i])
+        return line[start:start + symtop.WIDTHS[i]].strip()
+
+    cost_cell, gput_cell = cell(row, "COST"), cell(row, "GPUT")
+    assert cost_cell not in ("-", ""), f"COST cell empty: {row!r}"
+    assert float(gput_cell) > 0, f"GPUT cell not positive: {row!r}"
+    log(f"phase 2 OK: symtop row COST={cost_cell} GPUT={gput_cell}")
+    await provider.stop()
+
+    # ---- phase 3: tpu.ledger=false ships no costs ----------------------
+    provider_off = await _echo_provider(
+        hub, server_ident, "ledger-smoke-off", {"ledger": False})
+    details = await client.request_provider(
+        "mem://ledger-server", server_ident.public_key,
+        "echo:ledger-smoke-off")
+    session = await client.connect(details)
+    try:
+        text = "".join([d async for d in session.chat(
+            [{"role": "user", "content": "knob off"}])])
+        assert text == "knob off", text
+        assert session.last_costs is None, \
+            f"tpu.ledger=false still shipped costs: {session.last_costs}"
+    finally:
+        await session.close()
+    log("phase 3 OK: tpu.ledger=false ships no costs block")
+    await provider_off.stop()
+    await server.stop()
+
+
+def main() -> int:
+    import tempfile
+
+    try:
+        phase1_scheduler_conservation()
+        with tempfile.TemporaryDirectory(prefix="ledger_smoke_") as tmp:
+            asyncio.new_event_loop().run_until_complete(
+                asyncio.wait_for(phases_2_3(tmp), 120))
+    except AssertionError as exc:
+        print(f"ledger smoke FAILED: {exc}", file=sys.stderr)
+        return 1
+    log("all phases OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

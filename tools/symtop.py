@@ -50,10 +50,10 @@ from symmetry_tpu.utils.metrics import (  # noqa: E402
 
 COLUMNS = ("PROVIDER", "TIER", "TOK/S", "TTFT p50", "TTFT p99",
            "QUEUE", "INFL", "OCC", "GAP%", "DEPTH", "SHED", "RESUME",
-           "WASTED", "REUSED", "DUMPS", "LINK", "STATE", "SHARE", "HIT",
-           "TARGET", "SCALE")
-WIDTHS = (22, 10, 9, 9, 9, 7, 6, 5, 5, 5, 7, 7, 7, 7, 6, 6, 9, 6, 6,
-          9, 6)
+           "WASTED", "REUSED", "DUMPS", "COST", "WASTE%", "GPUT",
+           "LINK", "STATE", "SHARE", "HIT", "TARGET", "SCALE")
+WIDTHS = (22, 10, 9, 9, 9, 7, 6, 5, 5, 5, 7, 7, 7, 7, 6, 7, 6, 7, 6,
+          9, 6, 6, 9, 6)
 
 # sym_pool_member_state gauge encoding (engine/disagg/pool.py
 # STATE_CODES) rendered back to the membership lifecycle names.
@@ -131,6 +131,26 @@ def _quantile(fams: dict, name: str, q: float,
 
     ordered = sorted(buckets.items(), key=lambda kv: _key(kv[0]))
     return histogram_quantile([(le, c) for le, c in ordered], q)
+
+
+def _ledger_cost(fams: dict) -> tuple[float | None, float]:
+    """(total attributed device seconds, finished-request count) from
+    the sym_request_device_seconds histogram. The count is the largest
+    per-phase observation count — every finished request observes each
+    phase it ran, so the busiest phase (decode for almost all traffic)
+    counts the requests."""
+    fam = fams.get("sym_request_device_seconds")
+    if fam is None:
+        return None, 0.0
+    total = 0.0
+    counts: dict[str, float] = {}
+    for s in fam["series"]:
+        if s.get("suffix") == "_sum":
+            total += s["value"]
+        elif s.get("suffix") == "_count":
+            phase = s["labels"].get("phase", "")
+            counts[phase] = counts.get(phase, 0.0) + s["value"]
+    return total, max(counts.values(), default=0.0)
 
 
 def _tiers(fams: dict) -> list[str]:
@@ -214,6 +234,8 @@ def build_rows(name: str, fams: dict,
     is the previous poll's {"t", "tok", "shed"} for rate deltas."""
     tok = _value(fams, "sym_provider_tokens_out_total", 0.0)
     shed = _value(fams, "sym_provider_sheds_total", 0.0)
+    cost_total, cost_n = _ledger_cost(fams)
+    wasted_s = _value(fams, "sym_request_wasted_seconds")
     uptime = _value(fams, "sym_provider_uptime_seconds")
     decisions = _value(fams, "sym_autoscale_decisions_total")
     if prev and now > prev["t"]:
@@ -269,6 +291,18 @@ def build_rows(name: str, fams: dict,
         "wasted": _value(fams, "sym_resume_wasted_tokens_total"),
         "reused": None,
         "dumps": _value(fams, "sym_provider_flight_dumps_total"),
+        # symledger attribution (tpu.ledger families): COST = mean
+        # attributed device seconds per finished request, WASTE% =
+        # share of device time spent on work no client kept (rejected
+        # drafts, sheds, kills, resume overlap), GPUT = the windowed
+        # SLO-goodput gauge — attaining tokens per device second, the
+        # honest throughput headline.
+        "cost": (cost_total / cost_n if cost_total is not None and cost_n
+                 else None),
+        "waste": (_fmt_pct(wasted_s / (cost_total + wasted_s))
+                  if wasted_s is not None and cost_total
+                  else None),
+        "gput": _value(fams, "sym_goodput_tokens_per_device_second"),
         "link": (None if link is None else ("up" if link else "DOWN")),
         "state": None, "share": None,
         "target": target, "scale": scale_disp,
@@ -341,6 +375,7 @@ def render_table(rows: list[dict[str, Any]]) -> str:
                  r["occupancy"], r.get("gap"), r.get("depth"),
                  r["shed"], r.get("resume"),
                  r.get("wasted"), r.get("reused"), r.get("dumps"),
+                 r.get("cost"), r.get("waste") or "-", r.get("gput"),
                  r["link"] or "-",
                  r.get("state") or "-", r.get("share") or "-",
                  r.get("hit"), r.get("target") or "-", r.get("scale"))
